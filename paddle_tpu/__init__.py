@@ -1,0 +1,77 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle (the reference at /root/reference), built on JAX/XLA/Pallas.
+
+Top-level namespace mirrors `paddle.*` (ref: python/paddle/__init__.py):
+tensor creation/math/manipulation/linalg ops, nn, optimizer, io, amp,
+distributed, jit, vision.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import warnings as _warnings
+
+import jax as _jax
+
+# fp32 matmuls accumulate in full precision by default (the reference's cuBLAS
+# fp32 GEMMs do); bf16 inputs still ride the MXU at full rate. Perf-sensitive
+# code paths opt into lower precision per-call via jax.default_matmul_precision.
+_jax.config.update("jax_default_matmul_precision", "float32")
+
+# TPU/XLA runs with 32-bit index types by default (jax x64 disabled); the
+# paddle-style API nominally uses int64 indices, which JAX silently narrows.
+_warnings.filterwarnings(
+    "ignore", message="Explicitly requested dtype int64")
+_warnings.filterwarnings(
+    "ignore", message="Explicitly requested dtype float64")
+
+# dtypes
+from .core.dtype import (  # noqa: F401
+    bool_ as bool8, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128,
+    get_default_dtype, set_default_dtype,
+)
+from .core import dtype as dtype_module  # noqa: F401
+from .core.dtype import bool_  # noqa: F401
+
+# core tensor + autograd
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .core.autograd import no_grad, enable_grad, is_grad_enabled, grad  # noqa: F401
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core.device import (  # noqa: F401
+    set_device, get_device, device_count, is_compiled_with_cuda,
+    is_compiled_with_tpu, CPUPlace, TPUPlace, Place,
+)
+
+# functional ops (also patches Tensor methods)
+from .ops import *  # noqa: F401,F403
+from .ops import cast, increment  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import jit  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import metric  # noqa: F401
+from . import linalg  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .hapi.model import Model, summary  # noqa: F401
+
+# paddle-style `paddle.disable_static()` no-ops: we are always "dygraph with
+# compilation underneath" (SURVEY.md §7 design stance).
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has a single execution path (trace->StableHLO->XLA); "
+        "use paddle_tpu.jit.to_static for compiled execution.")
+
+
+def in_dynamic_mode():
+    return True

@@ -1,0 +1,76 @@
+"""Functional higher-order autograd: jacobian/hessian/vjp/jvp.
+
+ref: python/paddle/incubate/autograd/functional.py. On TPU these map directly
+onto jax.jacobian / jax.hessian / jax.vjp / jax.jvp over the pure function.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _unwrap(xs):
+    if isinstance(xs, Tensor):
+        return xs._data
+    if isinstance(xs, (tuple, list)):
+        return type(xs)(_unwrap(x) for x in xs)
+    return xs
+
+
+def _wrap(xs):
+    if isinstance(xs, (tuple, list)):
+        return type(xs)(_wrap(x) for x in xs)
+    return Tensor(xs) if not isinstance(xs, Tensor) else xs
+
+
+def _pure(func):
+    def f(*args):
+        out = func(*[Tensor(a) for a in args])
+        return _unwrap(out)
+    return f
+
+
+def jacobian(func, xs, is_batched=False):
+    args = xs if isinstance(xs, (tuple, list)) else (xs,)
+    raw = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    jac = jax.jacobian(_pure(func), argnums=tuple(range(len(raw))))(*raw)
+    if len(raw) == 1 and not isinstance(xs, (tuple, list)):
+        jac = jac[0]
+    return _wrap(jac)
+
+
+def hessian(func, xs, is_batched=False):
+    args = xs if isinstance(xs, (tuple, list)) else (xs,)
+    raw = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    hes = jax.hessian(_pure(func), argnums=tuple(range(len(raw))))(*raw)
+    if len(raw) == 1 and not isinstance(xs, (tuple, list)):
+        hes = hes[0][0]
+    return _wrap(hes)
+
+
+def vjp(func, xs, v=None):
+    args = xs if isinstance(xs, (tuple, list)) else (xs,)
+    raw = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    out, vjp_fn = jax.vjp(_pure(func), *raw)
+    if v is None:
+        v = jnp.ones_like(out)
+    else:
+        v = _unwrap(v)
+    grads = vjp_fn(v)
+    if len(raw) == 1 and not isinstance(xs, (tuple, list)):
+        grads = grads[0]
+    return _wrap(out), _wrap(grads)
+
+
+def jvp(func, xs, v=None):
+    args = xs if isinstance(xs, (tuple, list)) else (xs,)
+    raw = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in raw)
+    else:
+        vv = v if isinstance(v, (tuple, list)) else (v,)
+        tangents = tuple(_unwrap(t) for t in vv)
+    out, tangent_out = jax.jvp(_pure(func), tuple(raw), tangents)
+    return _wrap(out), _wrap(tangent_out)

@@ -1,0 +1,96 @@
+"""PyLayer: user-defined forward/backward pairs on the eager tape.
+
+ref: python/paddle/autograd/py_layer.py (+ C++ side paddle/fluid/eager/pylayer/).
+The TPU-native version plugs a user backward directly in as a GradNode's vjp.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..core.autograd import GradNode, is_grad_enabled, no_grad
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        pass
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        requires = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args)
+
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        outs_t = tuple(outs) if multi else (outs,)
+
+        if not requires:
+            return outs
+
+        diff_inputs = tuple(
+            t for t in tensor_args
+            if not t.stop_gradient and jnp.issubdtype(
+                jnp.result_type(t._data), jnp.inexact))
+        out_avals = tuple(
+            jnp.zeros((), o.dtype) if False else
+            type("A", (), {"shape": tuple(o.shape), "dtype": o.dtype})()
+            for o in outs_t)
+
+        def vjp_fn(cts):
+            grads = cls.backward(ctx, *[Tensor(c) for c in cts])
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            # positional map: backward returns one grad per tensor input
+            by_tensor = {}
+            for t, g in zip(tensor_args, grads):
+                by_tensor[id(t)] = g
+            out = []
+            for t in diff_inputs:
+                g = by_tensor.get(id(t))
+                if g is None:
+                    out.append(jnp.zeros(t._data.shape, t._data.dtype))
+                else:
+                    out.append(g._data if isinstance(g, Tensor) else g)
+            return tuple(out)
+
+        node = GradNode(vjp_fn, diff_inputs, out_avals, cls.__name__)
+        wrapped = tuple(
+            Tensor(o._data if isinstance(o, Tensor) else o,
+                   stop_gradient=False, node=node, out_index=k)
+            for k, o in enumerate(outs_t))
+        return wrapped if multi else wrapped[0]

@@ -1,0 +1,357 @@
+"""Eager autograd engine.
+
+The reference implements define-by-run autograd with generated C++ GradNodes
+and a queue-based backward (ref: paddle/fluid/eager/grad_node_info.h:197,
+paddle/fluid/eager/backward.cc:105 RunBackward). The TPU-native design keeps
+the same user semantics (``stop_gradient``, ``.grad`` accumulation,
+``loss.backward()``, hooks) but each op's gradient comes from ``jax.vjp`` of
+its pure-JAX implementation taken at forward time — no per-op handwritten
+grad kernels, and the residuals live in the vjp closure (the analog of the
+reference's TensorWrapper saved-tensor scheme, ref: eager/tensor_wrapper.h).
+
+Under ``jax.jit`` tracing (the performance path) this tape is bypassed
+entirely: gradients come from ``jax.grad`` over the functionalized program.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+    "GradNode", "apply_op", "backward", "grad",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class _GradModeGuard:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with type(self)(self._mode):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def no_grad(func=None):
+    """Context manager / decorator disabling tape recording.
+    ref: python/paddle/base/dygraph/base.py no_grad_
+    """
+    guard = _GradModeGuard(False)
+    if func is not None:
+        return guard(func)
+    return guard
+
+
+def enable_grad(func=None):
+    guard = _GradModeGuard(True)
+    if func is not None:
+        return guard(func)
+    return guard
+
+
+class GradNode:
+    """One recorded op: holds the vjp closure and edges to input tensors.
+    ref-analog: paddle/fluid/eager/grad_node_info.h GradNodeBase + Edge.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # tuple of differentiable input Tensors
+        self.out_avals = out_avals    # ShapeDtypeStruct per output
+        self.name = name
+
+    def __repr__(self):
+        return f"GradNode({self.name})"
+
+
+def _zeros_ct(aval):
+    if jnp.issubdtype(aval.dtype, jnp.inexact):
+        return jnp.zeros(aval.shape, aval.dtype)
+    return np.zeros(aval.shape, jax.dtypes.float0)
+
+
+def _is_diff_dtype(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
+    """Run ``fn`` (a pure JAX function) on mixed Tensor/raw args, recording a
+    GradNode when grad is enabled and any Tensor input requires grad.
+
+    Returns Tensor or tuple-of-Tensor mirroring fn's output structure.
+    This is the analog of a generated ``*_ad_func`` forward
+    (ref: fluid/eager/api/manual/eager_manual/forwards/multiply_fwd_func.cc:68).
+    """
+    from .tensor import Tensor  # local import; tensor.py imports us too
+
+    name = op_name or getattr(fn, "__name__", "op")
+    datas = [a._data if isinstance(a, Tensor) else a for a in args]
+
+    diff_idx = [
+        i for i, a in enumerate(args)
+        if isinstance(a, Tensor) and not a.stop_gradient
+        and _is_diff_dtype(a._data)
+    ]
+    record = _state.enabled and bool(diff_idx)
+
+    if not record:
+        out = fn(*datas, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        return wrapped if multi else wrapped[0]
+
+    struct = {"multi": False}
+
+    def f(*primals):
+        call = list(datas)
+        for i, p in zip(diff_idx, primals):
+            call[i] = p
+        res = fn(*call, **kwargs)
+        struct["multi"] = isinstance(res, (tuple, list))
+        return tuple(res) if struct["multi"] else (res,)
+
+    primals = [datas[i] for i in diff_idx]
+    outs, vjp_fn = jax.vjp(f, *primals)
+    multi = struct["multi"]
+
+    out_avals = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs)
+    node = GradNode(vjp_fn, tuple(args[i] for i in diff_idx), out_avals, name)
+
+    wrapped = tuple(
+        Tensor(o, stop_gradient=False, node=node, out_index=k)
+        for k, o in enumerate(outs))
+    if not multi:
+        return wrapped[0]
+    return wrapped
+
+
+def _ensure_jnp(g, aval):
+    if g is None:
+        return _zeros_ct(aval)
+    from .tensor import Tensor
+    if isinstance(g, Tensor):
+        g = g._data
+    return jnp.asarray(g, aval.dtype) if jnp.issubdtype(
+        aval.dtype, jnp.inexact) else g
+
+
+def _topo_order(root_node: GradNode) -> List[GradNode]:
+    """Reverse postorder over the node DAG: every consumer precedes its
+    producers, so cotangents are fully accumulated before a node runs."""
+    order: List[GradNode] = []
+    visited = set()
+    stack: List[Tuple[GradNode, int]] = [(root_node, 0)]
+    # iterative DFS with explicit postorder
+    while stack:
+        node, phase = stack.pop()
+        if phase == 0:
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, 1))
+            for t in node.inputs:
+                child = t._node
+                if child is not None and id(child) not in visited:
+                    stack.append((child, 0))
+        else:
+            order.append(node)
+    order.reverse()
+    return order
+
+
+def _run_backward(roots, root_grads, accumulate_into_grad: bool,
+                  wanted: Optional[Sequence] = None):
+    """Core backward walk shared by Tensor.backward() and paddle.grad().
+
+    ref-analog: eager/backward.cc RunBackward — queue-based topological walk
+    routing grads along edges into GradTensorHolder accumulators.
+    """
+    from .tensor import Tensor
+
+    node_cts: Dict[int, List[Any]] = {}
+    node_by_id: Dict[int, GradNode] = {}
+    results: Dict[int, Any] = {}
+    wanted_ids = {id(t) for t in wanted} if wanted is not None else None
+
+    def seed(node, idx, g):
+        node_by_id[id(node)] = node
+        cts = node_cts.setdefault(id(node), [None] * len(node.out_avals))
+        cts[idx] = g if cts[idx] is None else cts[idx] + g
+
+    order: List[GradNode] = []
+    seen = set()
+    for t, g in zip(roots, root_grads):
+        if t._node is None:
+            # a leaf root: its grad is just the seed
+            _accumulate_leaf(t, g, accumulate_into_grad, results, wanted_ids)
+            continue
+        seed(t._node, t._out_index, g)
+        for n in _topo_order(t._node):
+            if id(n) not in seen:
+                seen.add(id(n))
+                order.append(n)
+
+    # In multi-root cases, a merged order must still satisfy consumer-before-
+    # producer; re-sort by a global DFS from a virtual root.
+    if len([t for t in roots if t._node is not None]) > 1:
+        virt = GradNode(None, tuple(t for t in roots if t._node is not None),
+                        (), "virtual_root")
+        order = [n for n in _topo_order(virt) if n is not virt]
+
+    for node in order:
+        cts = node_cts.get(id(node))
+        if cts is None:
+            continue  # unreachable from seeds
+        full = tuple(
+            _ensure_jnp(c, a) for c, a in zip(cts, node.out_avals))
+        in_grads = node.vjp_fn(full)
+        for t, g in zip(node.inputs, in_grads):
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            g = _apply_hooks(t, g)
+            if t._node is not None:
+                seed(t._node, t._out_index, g)
+                if t._retain_grads or (wanted_ids and id(t) in wanted_ids):
+                    _accumulate_leaf(t, g, accumulate_into_grad, results,
+                                     wanted_ids, force=True)
+            else:
+                _accumulate_leaf(t, g, accumulate_into_grad, results,
+                                 wanted_ids)
+        # free residuals as we go unless the caller wants to re-run
+        node_cts.pop(id(node), None)
+    return results
+
+
+def _apply_hooks(t, g):
+    from .tensor import Tensor
+    if t._hooks:
+        tg = Tensor(g, stop_gradient=True)
+        for hook in list(t._hooks.values()):
+            r = hook(tg)
+            if r is not None:
+                tg = r if isinstance(r, Tensor) else Tensor(r, stop_gradient=True)
+        g = tg._data
+    return g
+
+
+def _accumulate_leaf(t, g, accumulate_into_grad, results, wanted_ids,
+                     force=False):
+    from .tensor import Tensor
+    is_wanted = wanted_ids is not None and id(t) in wanted_ids
+    if wanted_ids is not None and not is_wanted and not force:
+        return
+    if is_wanted or force:
+        prev = results.get(id(t))
+        results[id(t)] = g if prev is None else prev + g
+    if accumulate_into_grad and not t.stop_gradient:
+        # ref-analog: GradNodeAccumulation writing param.grad
+        if t.grad is None:
+            t.grad = Tensor(g, stop_gradient=True)
+        else:
+            t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward. ref: python/paddle/autograd/autograd.py"""
+    from .tensor import Tensor
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward root")
+            g = jnp.ones(t.shape, t.dtype)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        seeds.append(g)
+    _run_backward(tensors, seeds, accumulate_into_grad=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Functional gradient API. ref: python/paddle/base/dygraph/base.py grad
+
+    create_graph is not yet supported on the eager tape (the returned grads
+    are detached); use paddle_tpu.autograd.jacobian/hessian or jax.grad over
+    a functionalized program for higher-order derivatives.
+    """
+    from .tensor import Tensor
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported by the eager tape; use "
+            "paddle_tpu.autograd.{jacobian,hessian,vjp} for higher-order "
+            "gradients (they compose jax.vjp/jax.jacobian directly).")
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            g = jnp.ones(t.shape, t.dtype)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        seeds.append(g)
+    results = _run_backward(outputs, seeds, accumulate_into_grad=False,
+                            wanted=inputs)
+    out = []
+    for t in inputs:
+        g = results.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; "
+                    "pass allow_unused=True to return None for it")
+            out.append(None)
+        else:
+            out.append(Tensor(g, stop_gradient=True))
+    return out

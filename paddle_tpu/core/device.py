@@ -1,0 +1,90 @@
+"""Device management.
+
+TPU-native analog of the reference's Place/DeviceContext machinery
+(ref: paddle/phi/backends/device_manager.h, paddle/phi/common/place.h).
+On TPU the runtime (PJRT, via JAX) owns streams/allocators, so this layer is a
+thin facade: named places, device listing, and the default-device switch.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    """A device place, e.g. Place('tpu', 0). ref: paddle/phi/common/place.h"""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_of(d) == self.device_type]
+        if not devs:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def CPUPlace(device_id: int = 0) -> Place:
+    return Place("cpu", device_id)
+
+
+def _platform_of(d) -> str:
+    p = d.platform
+    # axon tunnel exposes the real chip under an experimental platform name
+    return "tpu" if p in ("tpu", "axon") else p
+
+
+_current_device: Place | None = None
+
+
+def set_device(device: str) -> Place:
+    """set_device('tpu') / 'tpu:0' / 'cpu'. ref: python/paddle/device/__init__.py"""
+    global _current_device
+    if ":" in device:
+        kind, idx = device.split(":", 1)
+        _current_device = Place(kind, int(idx))
+    else:
+        _current_device = Place(device, 0)
+    return _current_device
+
+
+def get_device() -> str:
+    p = _get_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def _get_place() -> Place:
+    global _current_device
+    if _current_device is None:
+        plat = _platform_of(jax.devices()[0])
+        _current_device = Place(plat, 0)
+    return _current_device
+
+
+def device_count(device_type: str | None = None) -> int:
+    if device_type is None:
+        return len(jax.devices())
+    return len([d for d in jax.devices() if _platform_of(d) == device_type])
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_platform_of(d) == "tpu" for d in jax.devices())

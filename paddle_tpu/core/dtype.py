@@ -1,0 +1,86 @@
+"""Dtype registry for paddle_tpu.
+
+Canonical dtype objects are plain ``jnp.dtype``s so they interop freely with
+JAX; the string names mirror the reference framework's public dtype surface
+(ref: paddle/phi/common/data_type.h via python/paddle/framework/dtype.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Public dtype singletons ----------------------------------------------------
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = np.dtype(jnp.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGRAL = {uint8, int8, int16, int32, int64}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype):
+    """Normalize a user-supplied dtype (str, np.dtype, jnp type) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise TypeError(f"Unsupported dtype name: {dtype!r}")
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    for name, v in _NAME_TO_DTYPE.items():
+        if v == d:
+            return name
+    return str(d)
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTEGRAL
+
+
+def set_default_dtype(d):
+    """ref: python/paddle/framework/framework.py set_default_dtype"""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            f"set_default_dtype only supports floating dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

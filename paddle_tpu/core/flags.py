@@ -1,0 +1,81 @@
+"""Runtime flag registry.
+
+Mirrors the reference's exported-flag system (ref: paddle/common/flags.h:336-375,
+flags_native.cc): flags are declared with a type + default, overridable from the
+environment as ``FLAGS_<name>`` and at runtime via set_flags/get_flags
+(ref: python/paddle/base/framework.py set_flags).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in _BOOL_TRUE
+
+
+@dataclass
+class _FlagInfo:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    help: str
+    value: Any = None
+
+
+_registry: Dict[str, _FlagInfo] = {}
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    if isinstance(default, bool):
+        parser: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    info = _FlagInfo(name, default, parser, help, default)
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        info.value = parser(env)
+    _registry[name] = info
+
+
+def get_flags(flags):
+    """get_flags('FLAGS_x') or get_flags(['FLAGS_x', ...]) -> dict"""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f[len("FLAGS_"):] if f.startswith("FLAGS_") else f
+        if key not in _registry:
+            raise ValueError(f"Unknown flag {f}")
+        out[f] = _registry[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for f, v in flags.items():
+        key = f[len("FLAGS_"):] if f.startswith("FLAGS_") else f
+        if key not in _registry:
+            raise ValueError(f"Unknown flag {f}")
+        info = _registry[key]
+        info.value = info.parser(v) if isinstance(v, str) else v
+
+
+def flag_value(name: str):
+    return _registry[name].value
+
+
+# Core flags (subset of the reference's ~180; ref: paddle/common/flags.cc)
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode")
+define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (no-op on TPU; XLA owns memory)")
+define_flag("use_bf16_matmul", True, "Prefer bfloat16 matmul accumulation defaults")
+define_flag("log_level", 0, "Framework verbosity")
+define_flag("benchmark", False, "Synchronize after each op for timing")
+define_flag("retain_grad_for_all_tensor", False, "Keep .grad on non-leaf tensors")

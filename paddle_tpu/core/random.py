@@ -1,0 +1,94 @@
+"""RNG state management.
+
+The reference keeps per-device mutable Philox generators
+(ref: paddle/phi/core/generator.h:32). The TPU-native design is JAX's
+functional PRNG: a root key advanced by a counter for eager ops, and
+``fold_in`` subkeys for parallel determinism (the analog of the reference's
+RNGStatesTracker, ref: python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class Generator:
+    """Stateful counter over a functional JAX key."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+            self._counter = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """A fresh subkey; each call advances the stream."""
+        with self._lock:
+            self._counter += 1
+            return jax.random.fold_in(self._key, self._counter)
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+        self._key = jax.random.key(self._seed)
+
+
+_default_generator = Generator(0)
+
+
+def seed(value: int) -> Generator:
+    """Global seed for eager random ops. ref: python/paddle/framework/random.py"""
+    _default_generator.manual_seed(value)
+    return _default_generator
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG streams for parallel determinism.
+
+    TP layers need 'global' vs 'local' (per model-parallel rank) dropout
+    streams; we derive them by fold_in on a per-name seed
+    (ref: fleet/layers/mpu/random.py RNGStatesTracker).
+    """
+
+    def __init__(self):
+        self._seeds = {}
+
+    def add(self, name: str, seed: int):
+        if name in self._seeds:
+            raise ValueError(f"RNG state {name} already exists")
+        self._seeds[name] = Generator(seed)
+
+    def rng_state(self, name: str) -> Generator:
+        if name not in self._seeds:
+            raise ValueError(f"Unknown RNG state {name}")
+        return self._seeds[name]
+
+    def next_key(self, name: str):
+        return self.rng_state(name).next_key()

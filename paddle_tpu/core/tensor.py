@@ -1,0 +1,273 @@
+"""Tensor: the user-facing eager array.
+
+TPU-native analog of the reference's ``paddle::Tensor``
+(ref: paddle/phi/api/include/tensor.h:82) + AutogradMeta
+(ref: paddle/fluid/eager/autograd_meta.h:61). The device buffer is a
+``jax.Array`` (PJRT-owned); autograd metadata is a (GradNode, out_index)
+edge recorded by ``core.autograd.apply_op``.
+
+Under jit tracing the same class wraps JAX tracers, so layer code written
+against this API runs unchanged in both eager and compiled modes.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from .autograd import apply_op, backward as _backward, is_grad_enabled
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
+                 "_retain_grads", "_hooks", "_hook_counter", "name",
+                 "trainable", "__weakref__", "_dist_attr")
+
+    def __init__(self, data, stop_gradient: bool = True, node=None,
+                 out_index: int = 0, name: Optional[str] = None):
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = node
+        self._out_index = out_index
+        self._retain_grads = False
+        self._hooks = {}
+        self._hook_counter = 0
+        self.name = name or ""
+        self.trainable = False
+        self._dist_attr = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        from .device import _get_place
+        return _get_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self._data.ndim
+
+    # -- host interop -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return np.asarray(self._data).item(*args)
+        return np.asarray(self._data).item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __len__(self):
+        if self._data.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}"
+                f"{grad_str},\n       {np.asarray(self._data)!r})")
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _backward([self], None if grad_tensor is None else [grad_tensor],
+                  retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data))
+        else:
+            self.grad = None
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook):
+        """ref: tensor_patch_methods.py register_hook; returns removable handle."""
+        hook_id = self._hook_counter
+        self._hook_counter += 1
+        self._hooks[hook_id] = hook
+
+        class _Handle:
+            def remove(_self):
+                self._hooks.pop(hook_id, None)
+
+        return _Handle()
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return apply_op(lambda x: x + 0, self, op_name="clone")
+
+    # -- mutation (leaf-only, used by optimizers / state loading) -----------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, self._data.dtype).reshape(
+            self._data.shape)
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # -- conversion ---------------------------------------------------------
+    def astype(self, dtype):
+        d = dtype_mod.convert_dtype(dtype)
+        return apply_op(lambda x: x.astype(d), self, op_name="cast")
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in dtype_mod._NAME_TO_DTYPE:
+                t = t.astype(a)
+            elif isinstance(a, np.dtype):
+                t = t.astype(a)
+        return t
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        if isinstance(idx, Tensor):
+            idx = idx._data
+        elif isinstance(idx, tuple):
+            idx = tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+        return apply_op(lambda x: x[idx], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        if isinstance(idx, Tensor):
+            idx = idx._data
+        elif isinstance(idx, tuple):
+            idx = tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = self._data.at[idx].set(value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # value_and methods like reshape/matmul/etc are attached by paddle_tpu.ops
+    # at import time (monkey-patch pattern mirroring the reference's
+    # python/paddle/tensor/tensor_method_patch).
+
+
+class Parameter(Tensor):
+    """Trainable leaf tensor. ref: python/paddle/base/framework.py Parameter"""
+
+    def __init__(self, data, stop_gradient: bool = False, name=None):
+        super().__init__(data, stop_gradient=stop_gradient, name=name)
+        self.trainable = not stop_gradient
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
+    """paddle.to_tensor. ref: python/paddle/tensor/creation.py to_tensor"""
+    d = dtype_mod.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._data
+        if d is not None and arr.dtype != d:
+            arr = arr.astype(d)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    if isinstance(data, (jax.Array,)) or hasattr(data, "aval"):
+        arr = data
+        if d is not None and arr.dtype != d:
+            arr = arr.astype(d)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    np_arr = np.asarray(data)
+    if d is None:
+        if np_arr.dtype == np.float64:
+            np_arr = np_arr.astype(dtype_mod.get_default_dtype())
+        elif np_arr.dtype == np.int64 and isinstance(data, (int, list)):
+            pass  # keep int64 like paddle
+    else:
+        np_arr = np_arr.astype(d)
+    return Tensor(jnp.asarray(np_arr), stop_gradient=stop_gradient)
+
+
+def unwrap(x):
+    """Tensor -> jax value (identity on non-Tensors)."""
+    return x._data if isinstance(x, Tensor) else x
+
+
+def wrap(x, stop_gradient=True):
+    return x if isinstance(x, Tensor) else Tensor(x, stop_gradient=stop_gradient)

@@ -1,0 +1,1 @@
+from .io import save, load  # noqa: F401

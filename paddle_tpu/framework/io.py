@@ -1,0 +1,71 @@
+"""paddle.save / paddle.load: pickle-based single-process checkpointing.
+
+ref: python/paddle/framework/io.py. Tensors are serialized as numpy arrays
+with dtype preserved (bfloat16 via ml_dtypes view trick); nested dicts/lists
+(state_dicts, optimizer states) round-trip transparently.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class _TensorPayload:
+    __slots__ = ("bytes", "shape", "dtype_str")
+
+    def __init__(self, arr: np.ndarray):
+        self.shape = arr.shape
+        self.dtype_str = str(arr.dtype)
+        if arr.dtype.kind == "V" or "bfloat16" in self.dtype_str:
+            self.bytes = arr.view(np.uint16).tobytes()
+            self.dtype_str = "bfloat16"
+        else:
+            self.bytes = arr.tobytes()
+
+    def restore(self) -> np.ndarray:
+        if self.dtype_str == "bfloat16":
+            import ml_dtypes
+            return np.frombuffer(self.bytes, np.uint16).view(
+                ml_dtypes.bfloat16).reshape(self.shape)
+        return np.frombuffer(
+            self.bytes, np.dtype(self.dtype_str)).reshape(self.shape)
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        arr = obj.restore()
+        return arr if return_numpy else Tensor(jnp.asarray(arr))
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

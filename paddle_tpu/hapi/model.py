@@ -1,0 +1,7 @@
+class Model:  # fleshed out in hapi milestone
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+
+
+def summary(net, input_size=None, dtypes=None):
+    raise NotImplementedError

@@ -1,0 +1,1 @@
+from ..ops.linalg import *  # noqa: F401,F403

@@ -1,0 +1,153 @@
+"""Functional op surface + Tensor method patching.
+
+Mirrors the reference's pattern of patching the Tensor type with the op
+surface (ref: python/paddle/base/dygraph/tensor_patch_methods.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+
+from . import creation, linalg, manipulation, math as math_ops
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Tensor method patching
+# ---------------------------------------------------------------------------
+_METHOD_SOURCES = [math_ops, manipulation, linalg]
+
+_METHODS = [
+    # math
+    "abs", "sqrt", "rsqrt", "exp", "log", "log2", "log10", "log1p", "sin",
+    "cos", "tan", "tanh", "sigmoid", "floor", "ceil", "round", "trunc",
+    "sign", "square", "reciprocal", "erf", "neg",
+    "add", "subtract", "multiply", "divide", "mod", "remainder", "pow",
+    "maximum", "minimum", "floor_divide", "scale", "clip", "lerp",
+    "sum", "mean", "prod", "max", "min", "std", "var", "median",
+    "logsumexp", "cumsum", "cumprod", "argmax", "argmin", "argsort", "sort",
+    "topk", "kthvalue", "unique", "nonzero", "bincount",
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "isnan", "isinf", "isfinite", "allclose", "isclose", "equal_all",
+    "all", "any", "nanmean", "nansum", "count_nonzero", "index_sample",
+    # manipulation
+    "reshape", "reshape_", "transpose", "concat", "split", "chunk", "unbind",
+    "squeeze", "unsqueeze", "flatten", "expand", "broadcast_to", "expand_as",
+    "tile", "repeat_interleave", "flip", "roll", "gather", "gather_nd",
+    "take_along_axis", "put_along_axis", "scatter", "scatter_nd_add",
+    "index_select", "index_add", "index_put", "masked_select", "masked_fill",
+    "where", "pad", "numel", "moveaxis", "diff", "tensordot", "unfold",
+    "strided_slice", "swapaxes",
+    # linalg
+    "matmul", "mm", "bmm", "dot", "inner", "outer", "cross", "t", "norm",
+    "dist", "cholesky", "inverse", "solve", "qr", "svd", "eigh", "det",
+    "matrix_power", "trace", "diagonal", "kron", "mv",
+]
+
+
+def _patch_methods():
+    for name in _METHODS:
+        fn = None
+        for src in _METHOD_SOURCES:
+            if hasattr(src, name):
+                fn = getattr(src, name)
+                break
+        if fn is None:
+            continue
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+
+def _binary_op(fn, reverse=False):
+    def method(self, other):
+        if reverse:
+            return fn(other, self)
+        return fn(self, other)
+    return method
+
+
+def _patch_operators():
+    m = math_ops
+    Tensor.__add__ = _binary_op(m.add)
+    Tensor.__radd__ = _binary_op(m.add, reverse=True)
+    Tensor.__sub__ = _binary_op(m.subtract)
+    Tensor.__rsub__ = _binary_op(m.subtract, reverse=True)
+    Tensor.__mul__ = _binary_op(m.multiply)
+    Tensor.__rmul__ = _binary_op(m.multiply, reverse=True)
+    Tensor.__truediv__ = _binary_op(m.divide)
+    Tensor.__rtruediv__ = _binary_op(m.divide, reverse=True)
+    Tensor.__floordiv__ = _binary_op(m.floor_divide)
+    Tensor.__rfloordiv__ = _binary_op(m.floor_divide, reverse=True)
+    Tensor.__mod__ = _binary_op(m.mod)
+    Tensor.__rmod__ = _binary_op(m.mod, reverse=True)
+    Tensor.__pow__ = _binary_op(m.pow)
+    Tensor.__rpow__ = _binary_op(m.pow, reverse=True)
+    Tensor.__matmul__ = _binary_op(linalg.matmul)
+    Tensor.__rmatmul__ = _binary_op(linalg.matmul, reverse=True)
+    Tensor.__neg__ = lambda self: m.neg(self)
+    Tensor.__abs__ = lambda self: m.abs(self)
+    Tensor.__invert__ = lambda self: m.logical_not(self)
+    Tensor.__eq__ = _binary_op(m.equal)
+    Tensor.__ne__ = _binary_op(m.not_equal)
+    Tensor.__lt__ = _binary_op(m.less_than)
+    Tensor.__le__ = _binary_op(m.less_equal)
+    Tensor.__gt__ = _binary_op(m.greater_than)
+    Tensor.__ge__ = _binary_op(m.greater_equal)
+    Tensor.__and__ = _binary_op(m.logical_and)
+    Tensor.__or__ = _binary_op(m.logical_or)
+    Tensor.__xor__ = _binary_op(m.logical_xor)
+
+    # in-place arithmetic used by optimizers / user code on leaves
+    def _iadd(self, other):
+        self._data = self._data + (other._data if isinstance(other, Tensor)
+                                   else other)
+        return self
+
+    def _isub(self, other):
+        self._data = self._data - (other._data if isinstance(other, Tensor)
+                                   else other)
+        return self
+
+    def _imul(self, other):
+        self._data = self._data * (other._data if isinstance(other, Tensor)
+                                   else other)
+        return self
+
+    def _idiv(self, other):
+        self._data = self._data / (other._data if isinstance(other, Tensor)
+                                   else other)
+        return self
+
+    Tensor.add_ = _iadd
+    Tensor.subtract_ = _isub
+    Tensor.multiply_ = _imul
+    Tensor.divide_ = _idiv
+    def _iscale(self, scale=1.0, bias=0.0, bias_after_scale=True):
+        if bias_after_scale:
+            self._data = self._data * scale + bias
+        else:
+            self._data = (self._data + bias) * scale
+        return self
+
+    Tensor.scale_ = _iscale
+
+
+_patch_methods()
+_patch_operators()

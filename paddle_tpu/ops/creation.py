@@ -1,0 +1,195 @@
+"""Tensor creation ops. ref: python/paddle/tensor/creation.py"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default or get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor) else x,
+                                 dtype=d))
+
+
+def ones_like(x, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return Tensor(jnp.ones_like(x._data if isinstance(x, Tensor) else x,
+                                dtype=d))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return Tensor(jnp.full_like(x._data if isinstance(x, Tensor) else x,
+                                fill_value, dtype=d))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        d = (np.dtype("int64") if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if xd.ndim == 1 and padding_value != 0:
+        n = xd.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, xd.dtype)
+        idx = jnp.arange(xd.shape[0])
+        if offset >= 0:
+            base = base.at[idx, idx + offset].set(xd)
+        else:
+            base = base.at[idx - offset, idx].set(xd)
+        return Tensor(base)
+    return Tensor(jnp.diag(xd, k=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    from ..core.autograd import apply_op
+    return apply_op(lambda a: jnp.tril(a, diagonal), x, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    from ..core.autograd import apply_op
+    return apply_op(lambda a: jnp.triu(a, diagonal), x, op_name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output.set_value(data)
+        return output
+    return Tensor(data)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+# -- random creation ---------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = (jax.random.key(seed) if seed else random_mod.next_key())
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(random_mod.next_key(), _shape(shape),
+                                    _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(random_mod.next_key(), shp) * s + m)
+    return Tensor(jax.random.normal(random_mod.next_key(), _shape(shape),
+                                    get_default_dtype()) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) or np.dtype("int64")
+    return Tensor(jax.random.randint(random_mod.next_key(), _shape(shape),
+                                     low, high, dtype=d))
+
+
+def randperm(n, dtype=None, name=None):
+    d = convert_dtype(dtype) or np.dtype("int64")
+    return Tensor(jax.random.permutation(random_mod.next_key(),
+                                         jnp.arange(n, dtype=d)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(xd, 1e-30))
+    if replacement:
+        out = jax.random.categorical(
+            random_mod.next_key(), logits, axis=-1,
+            shape=(num_samples,) + xd.shape[:-1]).T \
+            if xd.ndim > 1 else jax.random.categorical(
+                random_mod.next_key(), logits, shape=(num_samples,))
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(random_mod.next_key(), xd.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    u = jax.random.uniform(random_mod.next_key(), xd.shape)
+    return Tensor((u < xd).astype(xd.dtype))

@@ -1,0 +1,250 @@
+"""Linear-algebra ops. ref: python/paddle/tensor/linalg.py, einsum.py.
+
+matmul is the MXU hot path: inputs stay in their dtype (bf16 preferred) and
+XLA chooses fp32 accumulation on TPU by default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op(f, x, y, op_name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y, op_name="dot")
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, x, y, op_name="inner")
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), x, y, op_name="outer")
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op(f, x, y, op_name="cross")
+
+
+def t(input, name=None):
+    return apply_op(lambda a: a.T, input, op_name="t")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(a * a))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis),
+                                   keepdims=keepdim)
+        if p == "inf" or p == float("inf"):
+            ordv = jnp.inf
+        elif p == "-inf" or p == -float("inf"):
+            ordv = -jnp.inf
+        else:
+            ordv = p
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=ordv)
+        return jnp.linalg.norm(a, ord=ordv, axis=_ax(axis), keepdims=keepdim)
+    return apply_op(f, x, op_name="norm")
+
+
+def _ax(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def dist(x, y, p=2, name=None):
+    return apply_op(
+        lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), x, y,
+        op_name="dist")
+
+
+def einsum(equation, *operands):
+    return apply_op(lambda *ops: jnp.einsum(equation, *ops), *operands,
+                    op_name="einsum")
+
+
+def transpose(x, perm, name=None):
+    return apply_op(lambda a: jnp.transpose(a, perm), x, op_name="transpose")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply_op(f, x, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply_op(f, x, y, op_name="cholesky_solve")
+
+
+def inverse(x, name=None):
+    return apply_op(jnp.linalg.inv, x, op_name="inverse")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                              hermitian=hermitian), x,
+                    op_name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op(f, x, y, op_name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    yd = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(xd, yd, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
+
+
+def qr(x, mode="reduced", name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    q, r = jnp.linalg.qr(xd, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def svd(x, full_matrices=False, name=None):
+    """Returns (U, S, VH) — VH is the conjugate transpose of V, matching the
+    reference contract (ref: python/paddle/tensor/linalg.py svd Returns)."""
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    u, s, vh = jnp.linalg.svd(xd, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(vh)
+
+
+def eig(x, name=None):
+    xd = np.asarray(x._data if isinstance(x, Tensor) else x)
+    w, v = np.linalg.eig(xd)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    w, v = jnp.linalg.eigh(xd, UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    xd = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(xd)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x,
+                    op_name="eigvalsh")
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    sign, logdet = jnp.linalg.slogdet(xd)
+    return Tensor(jnp.stack([sign, logdet]))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol).astype(jnp.int64), x,
+        op_name="matrix_rank")
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, n), x,
+                    op_name="matrix_power")
+
+
+def multi_dot(x, name=None):
+    return apply_op(lambda *ops: jnp.linalg.multi_dot(ops), *x,
+                    op_name="multi_dot")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.trace(a, offset, axis1, axis2), x,
+                    op_name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.diagonal(a, offset, axis1, axis2), x,
+                    op_name="diagonal")
+
+
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, x, y, op_name="kron")
+
+
+def mv(x, vec, name=None):
+    return apply_op(lambda a, v: a @ v, x, vec, op_name="mv")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), x,
+                    op_name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._data if isinstance(fweights, Tensor) else fweights
+    aw = aweights._data if isinstance(aweights, Tensor) else aweights
+    return apply_op(
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=fw, aweights=aw), x, op_name="cov")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() \
+            if a.ndim > 2 else eye
+        for i in range(n):
+            v = jnp.concatenate([
+                jnp.zeros(a.shape[:-2] + (i,), a.dtype),
+                jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                a[..., i + 1:, i]], axis=-1)
+            h = (jnp.eye(m, dtype=a.dtype) -
+                 t[..., i, None, None] * v[..., :, None] * v[..., None, :])
+            q = q @ h
+        return q[..., :, :n]
+    return apply_op(f, x, tau, op_name="householder_product")

@@ -1,0 +1,434 @@
+"""Shape / layout manipulation ops. ref: python/paddle/tensor/manipulation.py"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def reshape(x, shape, name=None):
+    s = _shape_arg(shape)
+    return apply_op(lambda a: jnp.reshape(a, s), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x._data, _shape_arg(shape))
+    return x
+
+
+def transpose(x, perm, name=None):
+    return apply_op(lambda a: jnp.transpose(a, perm), x, op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda a: jnp.moveaxis(a, source, destination), x,
+                    op_name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, axis0, axis1), x,
+                    op_name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = list(x)
+    return apply_op(lambda *arrs: jnp.concatenate(arrs, axis=axis), *tensors,
+                    op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_op(lambda *arrs: jnp.stack(arrs, axis=axis), *tensors,
+                    op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, int):
+        n = x.shape[axis] if isinstance(x, Tensor) else x.shape[axis]
+        out = apply_op(
+            lambda a: tuple(jnp.split(a, num_or_sections, axis=axis)), x,
+            op_name="split")
+    else:
+        secs = [int(s) for s in num_or_sections]
+        # allow one -1 section
+        total = x.shape[axis]
+        if -1 in secs:
+            known = int(np.sum([s for s in secs if s != -1]))
+            secs[secs.index(-1)] = total - known
+        points = list(np.cumsum(secs)[:-1])
+        out = apply_op(lambda a: tuple(jnp.split(a, points, axis=axis)), x,
+                       op_name="split")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    out = apply_op(
+        lambda a: tuple(jnp.squeeze(s, axis)
+                        for s in jnp.split(a, n, axis=axis)),
+        x, op_name="unbind")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(i for i in ax if a.shape[i] == 1)
+        return jnp.squeeze(a, ax) if ax else a
+    return apply_op(f, x, op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op(lambda a: jnp.expand_dims(a, ax), x, op_name="unsqueeze")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis if start_axis >= 0 else nd + start_axis
+        e = stop_axis if stop_axis >= 0 else nd + stop_axis
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply_op(f, x, op_name="flatten")
+
+
+def expand(x, shape, name=None):
+    s = _shape_arg(shape)
+
+    def f(a):
+        # paddle semantics: -1 keeps the original dim; only legal for dims
+        # that exist in the input (trailing alignment)
+        tgt = list(s)
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                if i < off:
+                    raise ValueError(
+                        f"expand: -1 at position {i} refers to a new leading "
+                        f"dim; sizes of added dims must be given explicitly")
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return apply_op(f, x, op_name="expand")
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [expand(t, shape) for t in inputs]
+
+
+def tile(x, repeat_times, name=None):
+    r = _shape_arg(repeat_times)
+    return apply_op(lambda a: jnp.tile(a, r), x, op_name="tile")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    rd = repeats._data if isinstance(repeats, Tensor) else repeats
+    return apply_op(lambda a: jnp.repeat(a, rd, axis=axis), x,
+                    op_name="repeat_interleave")
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op(lambda a: jnp.flip(a, ax), x, op_name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda a: jnp.roll(a, shifts, axis=axis), x,
+                    op_name="roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k, axes), x, op_name="rot90")
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx,
+                        axis=axis)
+    return apply_op(f, x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply_op(f, x, index, op_name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def f(a, idx):
+        if broadcast:
+            tgt = list(a.shape)
+            tgt[axis] = idx.shape[axis]
+            idx = jnp.broadcast_to(idx, tuple(tgt))
+        return jnp.take_along_axis(a, idx, axis=axis)
+    return apply_op(f, arr, indices, op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def f(a, idx, v):
+        v = jnp.broadcast_to(v, idx.shape) if jnp.ndim(v) == 0 or \
+            v.shape != idx.shape else v
+        dims = [jnp.arange(s).reshape(
+            [-1 if i == d else 1 for i in range(idx.ndim)])
+            for d, s in enumerate(idx.shape)]
+        full_idx = tuple(idx if d == axis else
+                         jnp.broadcast_to(dims[d], idx.shape)
+                         for d in range(idx.ndim))
+        at = a.at[full_idx]
+        if reduce == "assign":
+            return at.set(v)
+        if reduce in ("add", "sum"):
+            return at.add(v)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(v)
+        if reduce == "amax":
+            return at.max(v)
+        if reduce == "amin":
+            return at.min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply_op(f, arr, indices, values, op_name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        if overwrite:
+            return a.at[idx].set(upd)
+        return a.at[idx].add(upd)
+    return apply_op(f, x, index, updates, op_name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply_op(f, x, index, updates, op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _shape_arg(shape)
+
+    def f(idx, upd):
+        z = jnp.zeros(s, upd.dtype)
+        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply_op(f, index, updates, op_name="scatter_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    def f(a, idx):
+        return jnp.take(a, idx, axis=axis)
+    return apply_op(f, x, index, op_name="index_select")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, idx, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op(f, x, index, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idxs = tuple(i._data if isinstance(i, Tensor) else i for i in indices)
+
+    def f(a, v):
+        at = a.at[idxs]
+        return at.add(v) if accumulate else at.set(v)
+    return apply_op(f, x, value, op_name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    xd = np.asarray(x._data if isinstance(x, Tensor) else x)
+    md = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(xd[np.broadcast_to(md, xd.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+
+    def f(a, m):
+        return jnp.where(m, jnp.asarray(v, a.dtype), a)
+    return apply_op(f, x, mask, op_name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .math import nonzero
+        return nonzero(condition, as_tuple=True)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), condition, x, y,
+                    op_name="where")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pd = _shape_arg(pad) if not isinstance(pad, (list, tuple)) else [
+        int(p.item()) if isinstance(p, Tensor) else int(p) for p in pad]
+
+    def f(a):
+        nd = a.ndim
+        if len(pd) == 2 * nd:
+            width = [(pd[2 * i], pd[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW convention: pad is [left,right,top,bottom...] on
+            # trailing spatial dims, reversed pair order
+            n_spatial = len(pd) // 2
+            width = [(0, 0)] * (nd - n_spatial)
+            spatial = [(pd[2 * i], pd[2 * i + 1]) for i in range(n_spatial)]
+            if data_format in ("NHWC", "NLC", "NDHWC"):
+                width = [(0, 0)] + spatial[::-1] + [(0, 0)]
+            else:
+                width = [(0, 0), (0, 0)] + spatial[::-1]
+        if mode == "constant":
+            return jnp.pad(a, width, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        return jnp.pad(a, width, mode=jmode)
+    return apply_op(f, x, op_name="pad")
+
+
+import builtins as _builtins  # noqa: E402
+
+
+def slice(input, axes, starts, ends, name=None):
+    def _v(lst):
+        return [int(v.item()) if isinstance(v, Tensor) else int(v)
+                for v in lst]
+    axes, starts, ends = list(axes), _v(starts), _v(ends)
+
+    def f(a):
+        idx = [_builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = _builtins.slice(s, e)
+        return a[tuple(idx)]
+    return apply_op(f, input, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [_builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = _builtins.slice(s, e, st)
+        return a[tuple(idx)]
+    return apply_op(f, x, op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _shape_arg(shape)
+    off = _shape_arg(offsets) if offsets is not None else (0,) * len(s)
+
+    def f(a):
+        idx = tuple(_builtins.slice(o, o + d) for o, d in zip(off, s))
+        return a[idx]
+    return apply_op(f, x, op_name="crop")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    flat = jnp.ravel(xd)
+    idx = offset + sum(
+        np.indices(shape)[i] * stride[i] for i in range(len(shape)))
+    return Tensor(flat[jnp.asarray(idx)])
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(convert_dtype(shape_or_dtype))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(idx):
+        per = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * per, (shard_id + 1) * per
+        ok = (idx >= lo) & (idx < hi)
+        return jnp.where(ok, idx - lo, ignore_value)
+    return apply_op(f, input, op_name="shard_index")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return apply_op(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre,
+                                       append=app), x, op_name="diff")
+
+
+def atleast_1d(*inputs):
+    out = [apply_op(jnp.atleast_1d, t, op_name="atleast_1d") for t in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs):
+    out = [apply_op(jnp.atleast_2d, t, op_name="atleast_2d") for t in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs):
+    out = [apply_op(jnp.atleast_3d, t, op_name="atleast_3d") for t in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y,
+                    op_name="tensordot")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col. ref: python/paddle/nn/functional/common.py unfold"""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st, padding="VALID",
+            rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply_op(f, x, op_name="unfold")

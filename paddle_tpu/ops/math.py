@@ -1,0 +1,451 @@
+"""Math ops (elementwise, reduction, comparison, logical).
+
+ref: python/paddle/tensor/math.py, logic.py, search.py. Each op is a thin
+differentiable wrapper over the jnp equivalent via ``apply_op`` — gradients
+come from jax.vjp, so there is no per-op grad kernel to maintain (the analog
+of the reference's ~2,663 PHI kernel registrations collapses to XLA).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    """Coerce python scalars / numpy to Tensor-or-raw for apply_op."""
+    if isinstance(x, Tensor):
+        return x
+    return x  # raw scalars pass straight through to jnp
+
+
+def _unary(jfn, name):
+    def op(x, name=None):
+        return apply_op(jfn, _t(x), op_name=name)
+    op.__name__ = name
+    return op
+
+
+def _binary(jfn, name):
+    def op(x, y, name=None):
+        return apply_op(jfn, _t(x), _t(y), op_name=name)
+    op.__name__ = name
+    return op
+
+
+# -- elementwise unary -------------------------------------------------------
+abs = _unary(jnp.abs, "abs")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda x: x - jnp.trunc(x), "frac")
+sign = _unary(jnp.sign, "sign")
+neg = _unary(jnp.negative, "neg")
+reciprocal = _unary(lambda x: 1.0 / x, "reciprocal")
+square = _unary(jnp.square, "square")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+angle = _unary(jnp.angle, "angle")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+
+# -- elementwise binary ------------------------------------------------------
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+mod = _binary(jnp.mod, "mod")
+remainder = mod
+floor_mod = mod
+pow = _binary(jnp.power, "pow")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+hypot = _binary(jnp.hypot, "hypot")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+
+
+def floor_divide(x, y, name=None):
+    return apply_op(jnp.floor_divide, _t(x), _t(y), op_name="floor_divide")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    if bias_after_scale:
+        out = apply_op(lambda a: a * scale + bias, _t(x), op_name="scale")
+    else:
+        out = apply_op(lambda a: (a + bias) * scale, _t(x), op_name="scale")
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return apply_op(lambda a: jnp.clip(a, mn, mx), _t(x), op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op(lambda a, b, w: a + w * (b - a), _t(x), _t(y), _t(weight),
+                    op_name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), _t(x),
+                    op_name="stanh")
+
+
+def multiply_(x, y):
+    x._data = x._data * (y._data if isinstance(y, Tensor) else y)
+    return x
+
+
+# -- reductions --------------------------------------------------------------
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = tuple(int(a) for a in np.asarray(axis._data))
+    if isinstance(axis, list):
+        axis = tuple(axis)
+    return axis
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(
+        lambda a: jnp.sum(a, axis=_norm_axis(axis), dtype=d, keepdims=keepdim),
+        _t(x), op_name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.mean(a, axis=_norm_axis(axis), keepdims=keepdim),
+        _t(x), op_name="mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(
+        lambda a: jnp.prod(a, axis=_norm_axis(axis), dtype=d, keepdims=keepdim),
+        _t(x), op_name="prod")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.max(a, axis=_norm_axis(axis), keepdims=keepdim),
+        _t(x), op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.min(a, axis=_norm_axis(axis), keepdims=keepdim),
+        _t(x), op_name="min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply_op(
+        lambda a: jnp.std(a, axis=_norm_axis(axis), ddof=ddof,
+                          keepdims=keepdim), _t(x), op_name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply_op(
+        lambda a: jnp.var(a, axis=_norm_axis(axis), ddof=ddof,
+                          keepdims=keepdim), _t(x), op_name="var")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.median(a, axis=_norm_axis(axis), keepdims=keepdim),
+        _t(x), op_name="median")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jax.scipy.special.logsumexp(
+            a, axis=_norm_axis(axis), keepdims=keepdim),
+        _t(x), op_name="logsumexp")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=axis, dtype=d)
+    return apply_op(f, _t(x), op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(lambda a: jnp.cumprod(a, axis=dim, dtype=d), _t(x),
+                    op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if axis is None:
+        xd, ax = xd.reshape(-1), 0
+    else:
+        ax = axis
+    pos = jnp.arange(xd.shape[ax]).reshape(
+        [-1 if i == ax else 1 for i in range(xd.ndim)])
+    pos = jnp.broadcast_to(pos, xd.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv >= av  # paddle keeps the later index on ties
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    vals, idx = jax.lax.associative_scan((lambda a, b: combine(a, b)),
+                                         (xd, pos), axis=ax)
+    return Tensor(vals), Tensor(idx.astype(convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    neg_vals, idx = cummax(-(x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))),
+                           axis=axis, dtype=dtype)
+    return Tensor(-neg_vals._data), idx
+
+
+# -- comparison / logical ----------------------------------------------------
+equal = _binary(jnp.equal, "equal")
+not_equal = _binary(jnp.not_equal, "not_equal")
+greater_than = _binary(jnp.greater, "greater_than")
+greater_equal = _binary(jnp.greater_equal, "greater_equal")
+less_than = _binary(jnp.less, "less_than")
+less_equal = _binary(jnp.less_equal, "less_equal")
+logical_and = _binary(jnp.logical_and, "logical_and")
+logical_or = _binary(jnp.logical_or, "logical_or")
+logical_xor = _binary(jnp.logical_xor, "logical_xor")
+logical_not = _unary(jnp.logical_not, "logical_not")
+bitwise_and = _binary(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _binary(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _binary(jnp.bitwise_xor, "bitwise_xor")
+bitwise_not = _unary(jnp.bitwise_not, "bitwise_not")
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), _t(x), _t(y),
+                    op_name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan),
+        _t(x), _t(y), op_name="allclose")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                 equal_nan=equal_nan),
+        _t(x), _t(y), op_name="isclose")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.all(a, axis=_norm_axis(axis), keepdims=keepdim),
+        _t(x), op_name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.any(a, axis=_norm_axis(axis), keepdims=keepdim),
+        _t(x), op_name="any")
+
+
+# -- search / sort -----------------------------------------------------------
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    def f(a):
+        r = jnp.argmax(a, axis=axis, keepdims=keepdim and axis is not None)
+        return r.astype(d)
+    return apply_op(f, _t(x), op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    def f(a):
+        r = jnp.argmin(a, axis=axis, keepdims=keepdim and axis is not None)
+        return r.astype(d)
+    return apply_op(f, _t(x), op_name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        r = jnp.argsort(a, axis=axis, stable=True)
+        if descending:
+            r = jnp.flip(r, axis=axis)
+        return r.astype(jnp.int64)
+    return apply_op(f, _t(x), op_name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        r = jnp.sort(a, axis=axis, stable=True)
+        if descending:
+            r = jnp.flip(r, axis=axis)
+        return r
+    return apply_op(f, _t(x), op_name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return apply_op(f, _t(x), op_name="topk")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis, stable=True)
+        v = jnp.take(s, k - 1, axis=axis)
+        ix = jnp.take(i, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            ix = jnp.expand_dims(ix, axis)
+        return v, ix
+    return apply_op(f, _t(x), op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ax = axis if axis >= 0 else xd.ndim + axis
+    moved = jnp.moveaxis(xd, ax, -1)
+    batch_shape, n = moved.shape[:-1], moved.shape[-1]
+    flat = moved.reshape(-1, n)
+    s = jnp.sort(flat, axis=-1)
+
+    def counts(row_sorted):
+        lo = jnp.searchsorted(row_sorted, row_sorted, side="left")
+        hi = jnp.searchsorted(row_sorted, row_sorted, side="right")
+        return hi - lo
+
+    cnt = jax.vmap(counts)(s)
+    best = jnp.argmax(cnt, axis=-1, keepdims=True)
+    vals = jnp.take_along_axis(s, best, axis=-1)
+    # index of (last) occurrence in the original order, paddle-style
+    occ = flat == vals
+    idx = (n - 1) - jnp.argmax(occ[:, ::-1], axis=-1, keepdims=True)
+    vals = jnp.moveaxis(vals.reshape(batch_shape + (1,)), -1, ax)
+    idx = jnp.moveaxis(idx.reshape(batch_shape + (1,)), -1, ax)
+    if not keepdim:
+        vals, idx = jnp.squeeze(vals, ax), jnp.squeeze(idx, ax)
+    return Tensor(vals), Tensor(idx.astype(jnp.int64))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    xd = np.asarray(x._data if isinstance(x, Tensor) else x)
+    res = np.unique(xd, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    def f(s, v):
+        r = jnp.searchsorted(s, v, side="right" if right else "left")
+        return r.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply_op(f, _t(sorted_sequence), _t(values),
+                    op_name="searchsorted")
+
+
+def index_sample(x, index):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx, axis=1)
+    return apply_op(f, _t(x), _t(index), op_name="index_sample")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    wd = weights._data if isinstance(weights, Tensor) else weights
+    n = int(jnp.maximum(jnp.max(xd) + 1, minlength)) if xd.size else minlength
+    return Tensor(jnp.bincount(xd, wd, length=n))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.nanmean(a, axis=_norm_axis(axis), keepdims=keepdim),
+        _t(x), op_name="nanmean")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(
+        lambda a: jnp.nansum(a, axis=_norm_axis(axis), dtype=d,
+                             keepdims=keepdim), _t(x), op_name="nansum")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.count_nonzero(a, axis=_norm_axis(axis),
+                                    keepdims=keepdim).astype(jnp.int64),
+        _t(x), op_name="count_nonzero")
+
+
+def nonzero(x, as_tuple=False):
+    xd = np.asarray(x._data if isinstance(x, Tensor) else x)
+    idx = np.nonzero(xd)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None])) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))

@@ -1,0 +1,231 @@
+"""Eager autograd: backward, grad accumulation, hooks, PyLayer,
+higher-order. Numeric gradients are checked against finite differences,
+mirroring the reference's OpTest.check_grad (ref: test/legacy_test/
+op_test.py:3129)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    flat_x = x.reshape(-1)
+    flat_g = g.reshape(-1)
+    for i in range(flat_x.size):
+        orig = flat_x[i]
+        flat_x[i] = orig + eps
+        hi = f(x.copy().reshape(x.shape))
+        flat_x[i] = orig - eps
+        lo = f(x.copy().reshape(x.shape))
+        flat_x[i] = orig
+        flat_g[i] = (hi - lo) / (2 * eps)
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_diamond_graph(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        a = x * 3
+        b = x * 4
+        y = a * b  # y = 12 x^2, dy/dx = 24x = 48
+        y.backward()
+        np.testing.assert_allclose(x.grad.item(), 48.0)
+
+    def test_shared_input_multi_consumer(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x.exp()
+        z = (y + y * y).sum()  # dz/dy = 1 + 2y; dz/dx = (1+2e^x)e^x
+        z.backward()
+        e = np.exp([1.0, 2.0])
+        np.testing.assert_allclose(x.grad.numpy(), (1 + 2 * e) * e, rtol=1e-5)
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0])  # stop_gradient=True
+        z = (x * y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach_cuts_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        z = (y * 3).sum()
+        z.backward()
+        assert x.grad is None
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_non_scalar_backward_with_grad(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * x
+        y.backward(paddle.to_tensor([1.0, 0.5]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_non_scalar_backward_raises(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            (x * x).backward()
+
+    def test_matmul_grad_numeric(self, rng):
+        a_np = rng.standard_normal((3, 4)).astype(np.float32)
+        b_np = rng.standard_normal((4, 2)).astype(np.float32)
+        a = paddle.to_tensor(a_np, stop_gradient=False)
+        b = paddle.to_tensor(b_np, stop_gradient=False)
+        loss = paddle.matmul(a, b).sum()
+        loss.backward()
+        ng = numeric_grad(lambda x: (x @ b_np).sum(), a_np.copy())
+        np.testing.assert_allclose(a.grad.numpy(), ng, rtol=1e-2, atol=1e-2)
+        ng_b = numeric_grad(lambda y: (a_np @ y).sum(), b_np.copy())
+        np.testing.assert_allclose(b.grad.numpy(), ng_b, rtol=1e-2, atol=1e-2)
+
+    def test_broadcast_grad(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+        b = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+        ((x + b) ** 2).sum().backward()
+        np.testing.assert_allclose(b.grad.numpy(),
+                                   2 * (np.array([[2, 3], [4, 5]])).sum(0))
+
+    def test_multi_output_grad(self):
+        x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+        vals, idx = paddle.topk(x, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+    def test_getitem_grad(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+        (x[1] * 5).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0, 5, 0])
+
+    def test_cast_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        x.astype("bfloat16").astype("float32").sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+    def test_backward_twice_same_graph(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.item(), 8.0)
+
+
+class TestFunctionalGrad:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = x ** 2
+        (g,) = paddle.grad(y, x)
+        assert g.item() == pytest.approx(6.0)
+        assert x.grad is None  # functional API does not write .grad
+
+    def test_grad_multiple_inputs(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = paddle.to_tensor(3.0, stop_gradient=False)
+        z = x * y + x
+        gx, gy = paddle.grad(z, [x, y])
+        assert gx.item() == pytest.approx(4.0)
+        assert gy.item() == pytest.approx(2.0)
+
+    def test_allow_unused(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = paddle.to_tensor(3.0, stop_gradient=False)
+        z = x * 2
+        with pytest.raises(RuntimeError):
+            paddle.grad(z, [x, y])
+        gx, gy = paddle.grad(z, [x, y], allow_unused=True)
+        assert gy is None
+
+    def test_hooks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        h = x.register_hook(lambda g: seen.append(g.numpy()))
+        (x * 2).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [2.0])
+        h.remove()
+        x.clear_grad()
+        (x * 2).sum().backward()
+        assert len(seen) == 1
+
+    def test_hook_modifies_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        x.register_hook(lambda g: g * 10)
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Cube(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 3 * x * x
+
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = Cube.apply(x)
+        assert y.item() == pytest.approx(8.0)
+        y.backward()
+        assert x.grad.item() == pytest.approx(12.0)
+
+    def test_pylayer_multi_io(self):
+        class AddMul(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a + b, a * b
+
+            @staticmethod
+            def backward(ctx, da, dm):
+                a, b = ctx.saved_tensor()
+                return da + dm * b, da + dm * a
+
+        a = paddle.to_tensor(2.0, stop_gradient=False)
+        b = paddle.to_tensor(5.0, stop_gradient=False)
+        s, m = AddMul.apply(a, b)
+        (s + m).backward()
+        assert a.grad.item() == pytest.approx(6.0)
+        assert b.grad.item() == pytest.approx(3.0)
+
+
+class TestHigherOrder:
+    def test_jacobian(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        jac = paddle.autograd.jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]))
+
+    def test_hessian(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        hes = paddle.autograd.hessian(lambda t: (t ** 3).sum(), x)
+        np.testing.assert_allclose(hes.numpy(), np.diag([6.0, 12.0]))
+
+    def test_vjp_jvp(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        out, g = paddle.autograd.vjp(lambda t: (t * t).sum(), x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+        out, tang = paddle.autograd.jvp(lambda t: (t * t).sum(), x)
+        assert tang.item() == pytest.approx(6.0)

@@ -1,0 +1,320 @@
+"""Tensor basics: creation, dtype, methods, indexing, interop.
+
+Modeled on the reference's OpTest style of NumPy-reference comparison
+(ref: test/legacy_test/op_test.py check_output)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == np.float32
+        np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_to_tensor_dtype(self):
+        t = paddle.to_tensor([1, 2, 3], dtype="float32")
+        assert t.dtype == np.float32
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+
+    def test_arange_linspace(self):
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.arange(1, 7, 2).numpy(), [1, 3, 5])
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+
+    def test_eye_like(self):
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        x = paddle.ones([2, 2])
+        assert paddle.zeros_like(x).numpy().sum() == 0
+        assert paddle.full_like(x, 3).numpy().sum() == 12
+
+    def test_random_shapes(self):
+        paddle.seed(42)
+        a = paddle.randn([4, 5])
+        assert a.shape == [4, 5]
+        b = paddle.uniform([3], min=2.0, max=3.0)
+        assert (b.numpy() >= 2).all() and (b.numpy() < 3).all()
+        c = paddle.randint(0, 10, [20])
+        assert ((c.numpy() >= 0) & (c.numpy() < 10)).all()
+
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([8])
+        paddle.seed(7)
+        b = paddle.randn([8])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_tril_triu(self):
+        x = paddle.ones([3, 3])
+        assert paddle.tril(x).numpy().sum() == 6
+        assert paddle.triu(x, 1).numpy().sum() == 3
+
+
+class TestTensorMethods:
+    def test_properties(self):
+        t = paddle.ones([2, 3, 4])
+        assert t.ndim == 3
+        assert t.size == 24
+        assert t.numel() == 24
+        assert len(t) == 2
+
+    def test_item(self):
+        assert paddle.to_tensor(3.5).item() == pytest.approx(3.5)
+        assert float(paddle.to_tensor([2.0]).sum()) == 2.0
+
+    def test_astype(self):
+        t = paddle.to_tensor([1.7, 2.3])
+        assert t.astype("int32").dtype == np.int32
+
+    def test_operators(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose((a + b).numpy(), [4, 6])
+        np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+        np.testing.assert_allclose((a * b).numpy(), [3, 8])
+        np.testing.assert_allclose((b / a).numpy(), [3, 2])
+        np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((2.0 * a).numpy(), [2, 4])
+        np.testing.assert_allclose((1.0 - a).numpy(), [0, -1])
+        np.testing.assert_allclose((-a).numpy(), [-1, -2])
+
+    def test_comparison(self):
+        a = paddle.to_tensor([1.0, 5.0])
+        b = paddle.to_tensor([2.0, 2.0])
+        np.testing.assert_array_equal((a < b).numpy(), [True, False])
+        np.testing.assert_array_equal((a >= b).numpy(), [False, True])
+
+    def test_matmul_operator(self):
+        a = paddle.ones([2, 3])
+        b = paddle.ones([3, 4])
+        assert (a @ b).shape == [2, 4]
+
+    def test_indexing(self):
+        t = paddle.to_tensor(np.arange(12.0).reshape(3, 4))
+        assert t[0, 1].item() == 1.0
+        np.testing.assert_allclose(t[1].numpy(), [4, 5, 6, 7])
+        np.testing.assert_allclose(t[:, 2].numpy(), [2, 6, 10])
+        np.testing.assert_allclose(t[0:2, 0:2].numpy(), [[0, 1], [4, 5]])
+
+    def test_setitem(self):
+        t = paddle.zeros([3, 3])
+        t[1, 1] = 5.0
+        assert t.numpy()[1, 1] == 5.0
+
+    def test_method_patching(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.sum().item() == 10
+        assert t.mean().item() == 2.5
+        assert t.reshape([4]).shape == [4]
+        assert t.transpose([1, 0]).shape == [2, 2]
+        assert t.exp().shape == [2, 2]
+
+    def test_inplace(self):
+        t = paddle.ones([2])
+        t.add_(paddle.ones([2]))
+        np.testing.assert_allclose(t.numpy(), [2, 2])
+        t.set_value(np.array([5.0, 6.0]))
+        np.testing.assert_allclose(t.numpy(), [5, 6])
+
+    def test_detach_clone(self):
+        t = paddle.to_tensor([1.0], stop_gradient=False)
+        d = t.detach()
+        assert d.stop_gradient
+        c = t.clone()
+        assert not c.stop_gradient
+
+
+class TestMathOps:
+    def test_unary_matches_numpy(self, rng):
+        x = rng.uniform(0.1, 2.0, (3, 4)).astype(np.float32)
+        t = paddle.to_tensor(x)
+        for pfn, nfn in [
+            (paddle.sqrt, np.sqrt), (paddle.exp, np.exp), (paddle.log, np.log),
+            (paddle.sin, np.sin), (paddle.cos, np.cos), (paddle.tanh, np.tanh),
+            (paddle.floor, np.floor), (paddle.abs, np.abs),
+            (paddle.square, np.square),
+        ]:
+            # XLA CPU fast-math transcendentals differ from libm at ~1e-4 rel
+            np.testing.assert_allclose(pfn(t).numpy(), nfn(x), rtol=1e-3,
+                                       atol=1e-5)
+
+    def test_reductions(self, rng):
+        x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t.sum().item(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.sum(t, axis=1).numpy(), x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.mean(t, axis=[0, 2]).numpy(), x.mean((0, 2)), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.max(t, axis=2, keepdim=True).numpy(),
+            x.max(2, keepdims=True))
+        np.testing.assert_allclose(paddle.std(t).item(), x.std(ddof=1),
+                                   rtol=1e-4)
+
+    def test_argmax_topk_sort(self, rng):
+        x = rng.standard_normal((5, 6)).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(
+            paddle.argmax(t, axis=1).numpy(), x.argmax(1))
+        vals, idx = paddle.topk(t, 3, axis=1)
+        np.testing.assert_allclose(vals.numpy(), -np.sort(-x, 1)[:, :3],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.sort(t, axis=0).numpy(), np.sort(x, 0))
+
+    def test_cumsum_clip(self, rng):
+        x = rng.standard_normal(10).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.cumsum(t).numpy(), np.cumsum(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.clip(t, -0.5, 0.5).numpy(), np.clip(x, -0.5, 0.5))
+
+    def test_where_nonzero(self):
+        x = paddle.to_tensor([1.0, -1.0, 2.0])
+        y = paddle.zeros([3])
+        np.testing.assert_allclose(
+            paddle.where(x > 0, x, y).numpy(), [1, 0, 2])
+        nz = paddle.nonzero(paddle.to_tensor([0, 3, 0, 5]))
+        np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+    def test_logic(self):
+        a = paddle.to_tensor([True, False])
+        b = paddle.to_tensor([True, True])
+        np.testing.assert_array_equal(
+            paddle.logical_and(a, b).numpy(), [True, False])
+        assert paddle.all(b).item()
+        assert not paddle.all(a).item()
+
+
+class TestManipulation:
+    def test_reshape_transpose(self, rng):
+        x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(
+            paddle.reshape(t, [6, 4]).numpy(), x.reshape(6, 4))
+        np.testing.assert_allclose(
+            paddle.reshape(t, [-1, 2]).numpy(), x.reshape(-1, 2))
+        np.testing.assert_allclose(
+            paddle.transpose(t, [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+
+    def test_concat_stack_split(self, rng):
+        x = rng.standard_normal((2, 3)).astype(np.float32)
+        y = rng.standard_normal((2, 3)).astype(np.float32)
+        tx, ty = paddle.to_tensor(x), paddle.to_tensor(y)
+        np.testing.assert_allclose(
+            paddle.concat([tx, ty], axis=0).numpy(),
+            np.concatenate([x, y], 0))
+        np.testing.assert_allclose(
+            paddle.stack([tx, ty], axis=1).numpy(), np.stack([x, y], 1))
+        parts = paddle.split(paddle.to_tensor(np.arange(10.0)), 5)
+        assert len(parts) == 5 and parts[0].shape == [2]
+        parts = paddle.split(paddle.to_tensor(np.arange(10.0)), [3, 7])
+        assert parts[0].shape == [3] and parts[1].shape == [7]
+        parts = paddle.split(paddle.to_tensor(np.arange(10.0)), [3, -1])
+        assert parts[1].shape == [7]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        t = paddle.ones([1, 3, 1, 4])
+        assert paddle.squeeze(t).shape == [3, 4]
+        assert paddle.squeeze(t, axis=0).shape == [3, 1, 4]
+        assert paddle.unsqueeze(paddle.ones([3]), 0).shape == [1, 3]
+        assert paddle.flatten(paddle.ones([2, 3, 4]),
+                              start_axis=1).shape == [2, 12]
+
+    def test_expand_tile(self):
+        t = paddle.ones([1, 3])
+        assert paddle.expand(t, [4, 3]).shape == [4, 3]
+        assert paddle.expand(t, [4, -1]).shape == [4, 3]
+        assert paddle.tile(t, [2, 2]).shape == [2, 6]
+
+    def test_gather_scatter(self, rng):
+        x = rng.standard_normal((5, 3)).astype(np.float32)
+        t = paddle.to_tensor(x)
+        idx = paddle.to_tensor([0, 2], dtype="int32")
+        np.testing.assert_allclose(
+            paddle.gather(t, idx, axis=0).numpy(), x[[0, 2]])
+        upd = paddle.ones([2, 3])
+        out = paddle.scatter(t, idx, upd)
+        np.testing.assert_allclose(out.numpy()[0], np.ones(3))
+
+    def test_take_along_put_along(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        idx = np.argsort(x, axis=1)
+        out = paddle.take_along_axis(
+            paddle.to_tensor(x), paddle.to_tensor(idx), axis=1)
+        np.testing.assert_allclose(out.numpy(), np.take_along_axis(x, idx, 1))
+
+    def test_pad(self):
+        t = paddle.ones([1, 1, 2, 2])
+        out = paddle.pad(t, [1, 1, 1, 1])
+        assert out.shape == [1, 1, 4, 4]
+        assert out.numpy().sum() == 4
+
+    def test_flip_roll(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(
+            paddle.flip(t, 0).numpy(), [[3, 4], [1, 2]])
+        np.testing.assert_allclose(
+            paddle.roll(t, 1, axis=1).numpy(), [[2, 1], [4, 3]])
+
+
+class TestLinalg:
+    def test_matmul(self, rng):
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((4, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T),
+                          transpose_y=True).numpy(), a @ b, rtol=1e-5)
+
+    def test_einsum(self, rng):
+        a = rng.standard_normal((2, 3)).astype(np.float32)
+        b = rng.standard_normal((3, 4)).astype(np.float32)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+    def test_norm_inverse_solve(self, rng):
+        a = rng.standard_normal((3, 3)).astype(np.float32)
+        a = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        ta = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.norm(ta).item(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.inverse(ta).numpy(),
+                                   np.linalg.inv(a), rtol=1e-3, atol=1e-5)
+        b = rng.standard_normal((3,)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.solve(ta, paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), rtol=1e-3, atol=1e-5)
+
+    def test_svd_qr_eigh(self, rng):
+        a = rng.standard_normal((4, 3)).astype(np.float32)
+        u, s, vh = paddle.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vh.numpy(), a, rtol=1e-3, atol=1e-4)
+        q, r = paddle.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_saved_load_roundtrip(self, tmp_path):
+        state = {"w": paddle.randn([3, 3]).astype("bfloat16"),
+                 "step": 7, "nested": {"b": paddle.ones([2])}}
+        p = str(tmp_path / "ckpt.pdparams")
+        paddle.save(state, p)
+        loaded = paddle.load(p)
+        assert loaded["step"] == 7
+        np.testing.assert_array_equal(
+            loaded["w"].astype("float32").numpy(),
+            state["w"].astype("float32").numpy())
