@@ -1,1 +1,3 @@
-# placeholder, filled in by build plan
+"""paddle.amp equivalent. ref: python/paddle/amp/__init__.py"""
+from .auto_cast import auto_cast, autocast, decorate, amp_guard, white_list  # noqa: F401
+from .grad_scaler import GradScaler  # noqa: F401

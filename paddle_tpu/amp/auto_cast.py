@@ -1,0 +1,126 @@
+"""AMP autocast.
+
+ref: python/paddle/amp/auto_cast.py + the per-op AMP hook the reference
+generates into every ad_func (eager_gen.py AMP block; manual example
+fluid/eager/api/manual/eager_manual/forwards/multiply_fwd_func.cc:49-70).
+
+TPU-native: bfloat16 is the native fast dtype (MXU), needs no loss scaling.
+The autocast context installs a dtype-cast hook into apply_op's dispatch:
+ops on the white list run their float32 inputs as bf16/fp16.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+# O1 white list: matmul-ish ops where low precision is safe and fast
+# (ref: python/paddle/amp/amp_lists.py white_list)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "mm", "bmm",
+    "einsum", "flash_attention", "sdpa",
+}
+# ops forced to fp32 (ref: black_list — softmax/norm/exp-ish numerics)
+BLACK_LIST = {
+    "softmax", "log_softmax", "cross_entropy", "layer_norm", "batch_norm",
+    "group_norm", "rms_norm", "exp", "log", "mean", "sum", "logsumexp",
+    "cumsum",
+}
+
+
+def white_list():
+    return WHITE_LIST
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = None
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+class auto_cast:
+    """Context manager. level O1 = per-op white list; O2 = everything except
+    the black list runs in low precision."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = dtype
+        self.custom_white = set(custom_white_list or ())
+        self.custom_black = set(custom_black_list or ())
+
+    def __enter__(self):
+        self._prev = (_state.enabled, _state.dtype, _state.level,
+                      _state.custom_white, _state.custom_black)
+        _state.enabled = self.enable
+        _state.dtype = convert_dtype(self.dtype)
+        _state.level = self.level
+        _state.custom_white = self.custom_white
+        _state.custom_black = self.custom_black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = self._prev
+        return False
+
+
+autocast = auto_cast
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name: str, datas):
+    """Called from apply_op: returns datas cast per AMP policy."""
+    if not _state.enabled:
+        return datas
+    name = op_name or ""
+    white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    black = (BLACK_LIST | _state.custom_black) - _state.custom_white
+    low = _state.dtype
+
+    def cast_to(arr, d):
+        if hasattr(arr, "dtype") and arr.dtype == jnp.float32:
+            return arr.astype(d)
+        return arr
+
+    if _state.level == "O2":
+        if name in black:
+            return [cast_to(a, jnp.float32) for a in datas]
+        return [cast_to(a, low) for a in datas]
+    if name in white:
+        return [cast_to(a, low) for a in datas]
+    if name in black:
+        return [a.astype(jnp.float32)
+                if hasattr(a, "dtype") and a.dtype == low else a
+                for a in datas]
+    return datas
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """ref: python/paddle/amp/auto_cast.py amp_decorate. O2 casts model
+    parameters to the low dtype (master weights live in the optimizer's
+    fp32 moments on TPU)."""
+    if level == "O2":
+        items = models if isinstance(models, (list, tuple)) else [models]
+        for m in items:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers

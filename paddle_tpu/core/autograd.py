@@ -124,6 +124,12 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     name = op_name or getattr(fn, "__name__", "op")
     datas = [a._data if isinstance(a, Tensor) else a for a in args]
 
+    # AMP hook (the analog of the generated ad_func AMP block,
+    # ref: multiply_fwd_func.cc:49-70)
+    from ..amp.auto_cast import _state as _amp_state, maybe_cast_inputs
+    if _amp_state.enabled:
+        datas = maybe_cast_inputs(name, datas)
+
     diff_idx = [
         i for i, a in enumerate(args)
         if isinstance(a, Tensor) and not a.stop_gradient

@@ -44,6 +44,39 @@ class Generator:
         self._key = jax.random.key(self._seed)
 
 
+class TracedKeyStream:
+    """A key stream whose root key is a traced value — used when a Layer's
+    forward runs under jit so dropout masks differ per step instead of being
+    constant-folded. Pushed by paddle_tpu.jit's train/eval step wrappers."""
+
+    def __init__(self, key):
+        self._key = key
+        self._counter = 0
+
+    def next_key(self):
+        self._counter += 1
+        return jax.random.fold_in(self._key, self._counter)
+
+
+_stream_stack = []
+
+
+class key_stream:
+    """Context manager installing a TracedKeyStream as the active source for
+    eager random ops during tracing."""
+
+    def __init__(self, key):
+        self._stream = TracedKeyStream(key)
+
+    def __enter__(self):
+        _stream_stack.append(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        _stream_stack.pop()
+        return False
+
+
 _default_generator = Generator(0)
 
 
@@ -58,6 +91,8 @@ def default_generator() -> Generator:
 
 
 def next_key():
+    if _stream_stack:
+        return _stream_stack[-1].next_key()
     return _default_generator.next_key()
 
 

@@ -1,0 +1,118 @@
+"""DataLoader: host input pipeline with background prefetch.
+
+ref: python/paddle/io/dataloader/dataloader_iter.py (single/multi-process
+iterators) + worker.py shared-memory loop. TPU-native shape: the device is
+fed from the host, so the pipeline is (a) index batches from a sampler,
+(b) a thread pool mapping dataset.__getitem__ + collate, (c) a bounded
+prefetch queue overlapping host work with device steps (the analog of the
+reference's pin-memory + worker processes; threads suffice because the work
+is numpy/IO which releases the GIL).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched numpy arrays (structure-preserving).
+    ref: python/paddle/io/dataloader/collate.py"""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch])
+                for k in sample}
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(t)) for t in transposed)
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        from .dataset import IterableDataset
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no fixed length")
+        return len(self.batch_sampler)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def __iter__(self):
+        if self._iterable:
+            yield from self._iter_iterable()
+            return
+        if self.num_workers == 0:
+            for idx_batch in self.batch_sampler:
+                samples = [self.dataset[i] for i in idx_batch]
+                yield self.collate_fn(samples)
+            return
+        yield from self._iter_prefetch()
+
+    def _iter_prefetch(self):
+        """Thread-pool fetch + bounded queue prefetch."""
+        q: queue.Queue = queue.Queue(
+            maxsize=self.num_workers * self.prefetch_factor)
+        sentinel = object()
+
+        def producer():
+            try:
+                with ThreadPoolExecutor(self.num_workers) as pool:
+                    def fetch(idx_batch):
+                        samples = [self.dataset[i] for i in idx_batch]
+                        return self.collate_fn(samples)
+                    for out in pool.map(fetch, iter(self.batch_sampler)):
+                        q.put(out)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        t.join()

@@ -1,1 +1,11 @@
-# placeholder, filled in by build plan
+"""paddle.jit equivalent: one compilation path (trace -> StableHLO -> XLA).
+
+ref: python/paddle/jit/{api.py,dy2static,sot}. The reference needs an AST
+transpiler + bytecode tracer (SOT) because its eager semantics are op-by-op
+C++ dispatch; here every op is already a pure JAX call on Tensor-held arrays,
+so "to_static" is just functionalization + jax.jit — the design SURVEY.md §7
+step 3 calls for (replacing eager engine + PirInterpreter + CINN with one
+trace path).
+"""
+from .api import to_static, functionalize, TrainStep, save, load, not_to_static  # noqa: F401
+from .api import ignore_module  # noqa: F401

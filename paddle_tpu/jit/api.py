@@ -1,0 +1,311 @@
+"""Functionalization + compiled train/eval steps.
+
+Core mechanism: a Layer's Parameters/buffers are leaf Tensors; swapping
+their ``._data`` for JAX tracers and calling ``forward`` traces the same
+Python code into an XLA program. Gradients come from ``jax.value_and_grad``
+over the functionalized program, and the optimizer's pure per-param
+``_update`` runs inside the same compiled step (one fused XLA executable for
+fwd+bwd+opt, the shape the TPU wants).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core.autograd import no_grad
+from ..core.tensor import Parameter, Tensor
+
+
+class InputSpec:
+    """ref: python/paddle/static/input.py InputSpec"""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+
+def _tree_unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_tree_unwrap(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _tree_unwrap(v) for k, v in x.items()}
+    return x
+
+
+def _tree_wrap(x):
+    if isinstance(x, (jax.Array,)) or hasattr(x, "aval"):
+        return Tensor(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_tree_wrap(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _tree_wrap(v) for k, v in x.items()}
+    return x
+
+
+class _Swap:
+    """Temporarily install pytree values into a layer's param/buffer
+    Tensors; capture buffer mutations (e.g. BN running stats) on exit."""
+
+    def __init__(self, layer):
+        self.params = dict(layer.named_parameters())
+        self.buffers = dict(layer.named_buffers())
+
+    def run(self, param_vals: Dict[str, Any], buffer_vals: Dict[str, Any],
+            fn, *args, **kwargs):
+        old_p = {k: t._data for k, t in self.params.items()}
+        old_b = {k: t._data for k, t in self.buffers.items()}
+        try:
+            for k, t in self.params.items():
+                t._data = param_vals[k]
+            for k, t in self.buffers.items():
+                if k in buffer_vals:
+                    t._data = buffer_vals[k]
+            out = fn(*args, **kwargs)
+            new_buffers = {k: t._data for k, t in self.buffers.items()}
+            return out, new_buffers
+        finally:
+            for k, t in self.params.items():
+                t._data = old_p[k]
+            for k, t in self.buffers.items():
+                t._data = old_b[k]
+
+
+def functionalize(layer, fn: Optional[Callable] = None):
+    """Returns (apply, params, buffers):
+    apply(params, buffers, *args, **kwargs) -> (out_pytree, new_buffers)
+    pure in its inputs; params/buffers are {name: jnp array} pytrees."""
+    swap = _Swap(layer)
+    call = fn if fn is not None else layer.__call__
+    params0 = {k: t._data for k, t in swap.params.items()}
+    buffers0 = {k: t._data for k, t in swap.buffers.items()}
+
+    def apply(params, buffers, *args, **kwargs):
+        with no_grad():
+            args_t = tuple(Tensor(a) if _is_arr(a) else a for a in args)
+            kwargs_t = {k: (Tensor(v) if _is_arr(v) else v)
+                        for k, v in kwargs.items()}
+            out, new_buffers = swap.run(params, buffers, call, *args_t,
+                                        **kwargs_t)
+            return _tree_unwrap(out), new_buffers
+
+    return apply, params0, buffers0
+
+
+def _is_arr(v):
+    return isinstance(v, (jax.Array, np.ndarray)) or hasattr(v, "aval")
+
+
+class StaticFunction:
+    """Result of to_static on a layer/function: jit-compiled forward with a
+    shape/dtype-keyed compile cache (jax.jit's own cache)."""
+
+    def __init__(self, layer_or_fn, input_spec=None, **kwargs):
+        from ..nn.layer import Layer
+        self._is_layer = isinstance(layer_or_fn, Layer)
+        if self._is_layer:
+            self._layer = layer_or_fn
+            self._fn = layer_or_fn.__call__
+        else:
+            self._layer = getattr(layer_or_fn, "__self__", None)
+            self._fn = layer_or_fn
+        self.input_spec = input_spec
+        self._jitted = None
+
+    def _build(self):
+        if self._layer is not None:
+            apply, _, _ = functionalize(self._layer, self._fn)
+
+            @functools.partial(jax.jit)
+            def jitted(params, buffers, key, *args, **kwargs):
+                with random_mod.key_stream(key):
+                    out, new_buffers = apply(params, buffers, *args,
+                                             **kwargs)
+                return out, new_buffers
+            self._jitted = jitted
+            self._swap = _Swap(self._layer)
+        else:
+            fn = self._fn
+
+            @functools.partial(jax.jit)
+            def jitted(key, *args, **kwargs):
+                with random_mod.key_stream(key), no_grad():
+                    args_t = tuple(Tensor(a) if _is_arr(a) else a
+                                   for a in args)
+                    out = fn(*args_t, **kwargs)
+                return _tree_unwrap(out)
+            self._jitted = jitted
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        raw_args = tuple(_tree_unwrap(a) for a in args)
+        raw_kwargs = {k: _tree_unwrap(v) for k, v in kwargs.items()}
+        key = random_mod.next_key()
+        if self._layer is not None:
+            params = {k: t._data for k, t in self._swap.params.items()}
+            buffers = {k: t._data for k, t in self._swap.buffers.items()}
+            out, new_buffers = self._jitted(params, buffers, key, *raw_args,
+                                            **raw_kwargs)
+            for k, t in self._swap.buffers.items():
+                t._data = new_buffers[k]
+            return _tree_wrap(out)
+        out = self._jitted(key, *raw_args, **raw_kwargs)
+        return _tree_wrap(out)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """ref: python/paddle/jit/api.py to_static. Decorator or direct call."""
+    def decorate(fn):
+        return StaticFunction(fn, input_spec, **kwargs)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+class TrainStep:
+    """Whole-training-step compiler: loss fwd + backward + optimizer update
+    as ONE XLA executable (donated params/opt-state, so updates are
+    in-place in HBM).
+
+    Usage:
+        step = TrainStep(model, loss_fn, optimizer)
+        loss = step(x, y)          # tensors or numpy
+
+    loss_fn(outputs, *labels) -> scalar Tensor.
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._swap = _Swap(model)
+        self._params = self._swap.params
+        self._opt_state = None
+        self._jitted = None
+        self._donate = donate
+
+    def _init_opt_state(self):
+        state = {}
+        for k, p in self._params.items():
+            state[k] = self.optimizer._init_state(p)
+        return state
+
+    def _pure_clip(self, grads: Dict[str, Any]):
+        clip = self.optimizer._grad_clip
+        if clip is None:
+            return grads
+        from ..utils.clip_grad import (ClipGradByGlobalNorm, ClipGradByNorm,
+                                       ClipGradByValue)
+        if isinstance(clip, ClipGradByValue):
+            return {k: jnp.clip(g, clip.min, clip.max)
+                    for k, g in grads.items()}
+        if isinstance(clip, ClipGradByNorm):
+            out = {}
+            for k, g in grads.items():
+                n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                s = jnp.minimum(clip.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                out[k] = (g * s).astype(g.dtype)
+            return out
+        if isinstance(clip, ClipGradByGlobalNorm):
+            gn = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in grads.values()))
+            s = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+            return {k: (g * s).astype(g.dtype) for k, g in grads.items()}
+        return grads
+
+    def _build(self):
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        swap = self._swap
+        trainable = {k for k, p in self._params.items()
+                     if not p.stop_gradient}
+
+        def step_fn(params, buffers, opt_state, lr, key, *batch):
+            train_p = {k: v for k, v in params.items() if k in trainable}
+            frozen_p = {k: v for k, v in params.items()
+                        if k not in trainable}
+
+            def loss_of(tp):
+                full = {**tp, **frozen_p}
+                with no_grad(), random_mod.key_stream(key):
+                    inputs = tuple(Tensor(b) for b in batch[:-1]) \
+                        if len(batch) > 1 else (Tensor(batch[0]),)
+                    labels = (Tensor(batch[-1]),) if len(batch) > 1 else ()
+                    (out, new_buffers) = swap.run(
+                        full, buffers, model.__call__, *inputs)
+                    loss_t = loss_fn(out, *labels) if labels else \
+                        loss_fn(out)
+                return loss_t._data.astype(jnp.float32), new_buffers
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_p)
+            grads = self._pure_clip(grads)
+            new_params = dict(params)
+            new_opt_state = dict(opt_state)
+            for k in trainable:
+                if hasattr(opt, "_current_pid"):
+                    opt._current_pid = id(self._params[k])
+                new_p, new_s = opt._update(params[k], grads[k],
+                                           opt_state[k], lr)
+                new_params[k] = new_p
+                new_opt_state[k] = new_s
+            return loss, new_params, new_buffers, new_opt_state
+
+        donate = (0, 2) if self._donate else ()
+        self._jitted = jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        if self._jitted is None:
+            self._build()
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        raw = tuple(_tree_unwrap(b) if isinstance(b, Tensor)
+                    else jnp.asarray(np.asarray(b)) for b in batch)
+        params = {k: t._data for k, t in self._params.items()}
+        buffers = {k: t._data for k, t in self._swap.buffers.items()}
+        lr = jnp.float32(self.optimizer.get_lr())
+        key = random_mod.next_key()
+        loss, new_params, new_buffers, new_opt = self._jitted(
+            params, buffers, self._opt_state, lr, key, *raw)
+        for k, t in self._params.items():
+            t._data = new_params[k]
+        for k, t in self._swap.buffers.items():
+            t._data = new_buffers[k]
+        self._opt_state = new_opt
+        self.optimizer._global_step += 1
+        if isinstance(self.optimizer._learning_rate, object) and hasattr(
+                self.optimizer._learning_rate, "step") and not isinstance(
+                    self.optimizer._learning_rate, (int, float)):
+            pass  # schedulers are stepped by the user, matching paddle
+        return Tensor(loss)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — persists params + a loadable program description.
+    ref: python/paddle/jit/api.py save. v1: state_dict + class info."""
+    from ..framework.io import save as _save
+    state = {"state_dict": layer.state_dict(),
+             "layer_class": type(layer).__name__}
+    _save(state, path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..framework.io import load as _load
+    return _load(path + ".pdparams")

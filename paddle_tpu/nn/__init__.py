@@ -1,1 +1,43 @@
-# placeholder, filled in by build plan
+"""paddle.nn equivalent. ref: python/paddle/nn/__init__.py"""
+from .layer import Layer, ParamAttr  # noqa: F401
+from .container import Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
+from .layers_common import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    Bilinear, PixelShuffle, PixelUnshuffle, ChannelShuffle,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D, CosineSimilarity, Unfold, Fold,
+)
+from .layers_conv_norm import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose,
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, SpectralNorm, LocalResponseNorm,
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from .layers_activation import (  # noqa: F401
+    ReLU, ReLU6, LeakyReLU, PReLU, GELU, Sigmoid, Tanh, Softmax,
+    LogSoftmax, ELU, SELU, CELU, Silu, Swish, Mish, Hardswish, Hardsigmoid,
+    Hardtanh, Hardshrink, Softshrink, Tanhshrink, ThresholdedReLU,
+    Softplus, Softsign, LogSigmoid, Maxout, GLU, RReLU,
+)
+from .layers_loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
+    BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
+    TripletMarginLoss, HingeEmbeddingLoss, CTCLoss,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerEncoder,
+    TransformerEncoderLayer, TransformerDecoder, TransformerDecoderLayer,
+)
+from .rnn import (  # noqa: F401
+    SimpleRNN, LSTM, GRU, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+
+from ..utils.clip_grad import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)

@@ -1,0 +1,13 @@
+"""paddle.nn.functional equivalent. ref: python/paddle/nn/functional/"""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention,
+)
+
+# pad lives with tensor manipulation but is exported via F as well
+from ...ops.manipulation import pad, unfold  # noqa: F401

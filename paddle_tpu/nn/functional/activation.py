@@ -1,0 +1,191 @@
+"""Activation functionals. ref: python/paddle/nn/functional/activation.py"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+
+
+def relu(x, name=None):
+    return apply_op(jax.nn.relu, x, op_name="relu")
+
+
+def relu6(x, name=None):
+    return apply_op(jax.nn.relu6, x, op_name="relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda a: jax.nn.leaky_relu(a, negative_slope), x,
+                    op_name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a >= 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a >= 0, a, w.reshape(shape) * a)
+    return apply_op(f, x, weight, op_name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.elu(a, alpha), x, op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x,
+        op_name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.celu(a, alpha), x, op_name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda a: jax.nn.gelu(a, approximate=approximate), x,
+                    op_name="gelu")
+
+
+def silu(x, name=None):
+    return apply_op(jax.nn.silu, x, op_name="silu")
+
+
+swish = silu
+
+
+def mish(x, name=None):
+    return apply_op(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x,
+                    op_name="mish")
+
+
+def hardswish(x, name=None):
+    return apply_op(
+        lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x,
+        op_name="hardswish")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x,
+                    op_name="hardsigmoid")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda a: jnp.clip(a, min, max), x, op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype),
+        x, op_name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold,
+                                      0.0)).astype(a.dtype),
+        x, op_name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda a: a - jnp.tanh(a), x, op_name="tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(
+        lambda a: jnp.where(a > threshold, a, value).astype(a.dtype), x,
+        op_name="thresholded_relu")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jax.nn.softplus(beta * a) / beta), x,
+        op_name="softplus")
+
+
+def softsign(x, name=None):
+    return apply_op(jax.nn.soft_sign, x, op_name="softsign")
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, x, op_name="sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return apply_op(jax.nn.log_sigmoid, x, op_name="log_sigmoid")
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, x, op_name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op(f, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op(f, x, op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as random_mod
+    key = random_mod.next_key()
+
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(
+                y_hard, idx, jnp.ones_like(idx, y.dtype), axis=axis,
+                inplace=False)
+            # straight-through: hard value forward, soft gradient backward
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+    return apply_op(f, x, op_name="gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        shape = list(a.shape)
+        c = shape[axis]
+        shape[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(shape), axis=axis + 1)
+    return apply_op(f, x, op_name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op(lambda a: jax.nn.glu(a, axis=axis), x, op_name="glu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...core import random as random_mod
+    if not training:
+        mid = (lower + upper) / 2.0
+        return leaky_relu(x, mid)
+    key = random_mod.next_key()
+
+    def f(a):
+        slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+        return jnp.where(a >= 0, a, slope * a)
+    return apply_op(f, x, op_name="rrelu")

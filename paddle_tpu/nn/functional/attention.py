@@ -1,0 +1,78 @@
+"""Attention functionals.
+
+ref: python/paddle/nn/functional/flash_attention.py (flash_attention,
+scaled_dot_product_attention). On TPU the fused path is the Pallas flash
+kernel (paddle_tpu.ops.pallas.flash_attention); the reference implementation
+here is plain jnp, used on CPU and as the numeric oracle in tests.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+
+def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False,
+                    scale=None):
+    # q,k,v: [B, L, H, D] (paddle flash-attention layout)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2)  # [B, H, L, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt).astype(jnp.float32) * s
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to [B, L, H, D]
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Layout [batch, seq, heads, head_dim], matching the reference API."""
+    use_flash = _should_use_flash(query)
+    md = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+
+    if use_flash and md is None:
+        from ...ops.pallas.flash_attention import flash_attention_fwd
+        return apply_op(
+            lambda q, k, v: flash_attention_fwd(q, k, v, causal=is_causal),
+            query, key, value, op_name="flash_attention")
+
+    def f(q, k, v):
+        return _sdpa_reference(q, k, v, mask=md, causal=is_causal)
+    return apply_op(f, query, key, value, op_name="sdpa")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """ref: nn/functional/flash_attention.py flash_attention — same
+    signature; returns (out, softmax_lse-like None) tuple for parity."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def _should_use_flash(q) -> bool:
+    import jax as _jax
+    try:
+        dev = (q._data.devices() if isinstance(q, Tensor) else set()) or set()
+        plats = {d.platform for d in dev}
+        if not plats:
+            plats = {_jax.default_backend()}
+        return any(p in ("tpu", "axon") for p in plats)
+    except Exception:
+        return False

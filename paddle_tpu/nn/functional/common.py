@@ -1,0 +1,243 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+ref: python/paddle/nn/functional/common.py, input.py"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as random_mod
+from ...core.autograd import apply_op, is_grad_enabled
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's [in, out] weight layout
+    (ref: python/paddle/nn/functional/common.py linear)."""
+    if bias is None:
+        return apply_op(lambda a, w: a @ w, x, weight, op_name="linear")
+    return apply_op(lambda a, w, b: a @ w + b, x, weight, bias,
+                    op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if p == 1.0:
+        return apply_op(lambda a: jnp.zeros_like(a), x, op_name="dropout")
+    key = random_mod.next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply_op(f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = random_mod.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+    return apply_op(f, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out).astype(w.dtype)
+        return out
+    return apply_op(f, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    def f(idx):
+        return jax.nn.one_hot(idx, num_classes, dtype=jnp.float32)
+    return apply_op(f, x, op_name="one_hot")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply_op(f, x1, x2, op_name="cosine_similarity")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return apply_op(f, x, op_name="normalize")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    pd = prior_dist._data if isinstance(prior_dist, Tensor) else prior_dist
+
+    def f(lbl):
+        k = lbl.shape[-1]
+        if pd is None:
+            return (1 - epsilon) * lbl + epsilon / k
+        return (1 - epsilon) * lbl + epsilon * pd
+    return apply_op(f, label, op_name="label_smooth")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """ref: python/paddle/nn/functional/common.py interpolate. Uses
+    jax.image.resize; supports nearest/bilinear/bicubic/trilinear/area."""
+    if isinstance(size, Tensor):
+        size = [int(s) for s in np.asarray(size._data)]
+    elif size is not None and not isinstance(size, (list, tuple)):
+        size = [int(size)]
+
+    def f(a):
+        channel_last = data_format in ("NHWC", "NDHWC", "NLC")
+        nd = a.ndim - 2
+        if channel_last:
+            spatial = a.shape[1:-1]
+        else:
+            spatial = a.shape[2:]
+        if size is not None:
+            out_spatial = tuple(int(s) for s in size)
+        else:
+            sf = scale_factor
+            if isinstance(sf, Tensor):
+                sf = [float(v) for v in np.asarray(sf._data)]
+            if not isinstance(sf, (list, tuple)):
+                sf = [sf] * nd
+            out_spatial = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
+        if channel_last:
+            out_shape = (a.shape[0],) + out_spatial + (a.shape[-1],)
+        else:
+            out_shape = a.shape[:2] + out_spatial
+        method = {"nearest": "nearest", "bilinear": "bilinear",
+                  "bicubic": "bicubic", "trilinear": "trilinear",
+                  "linear": "linear", "area": "linear"}[mode]
+        return jax.image.resize(a, out_shape, method=method).astype(a.dtype)
+    return apply_op(f, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oc = c // (r * r)
+            a = a.reshape(n, oc, r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, oc, h * r, w * r)
+        n, h, w, c = a.shape
+        oc = c // (r * r)
+        a = a.reshape(n, h, w, r, r, oc)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, oc)
+    return apply_op(f, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 2, 4, 5, 1, 3)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return apply_op(f, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return a.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply_op(f, x, op_name="channel_shuffle")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *maybe_bias):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+    if bias is not None:
+        return apply_op(f, x1, x2, weight, bias, op_name="bilinear")
+    return apply_op(f, x1, x2, weight, op_name="bilinear")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im, inverse of unfold."""
+    os = output_sizes if isinstance(output_sizes, (list, tuple)) \
+        else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os[0] + pd[0] + pd[2], os[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        a = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hi = i * dl[0]
+                wj = j * dl[1]
+                out = out.at[:, :, hi:hi + oh * st[0]:st[0],
+                             wj:wj + ow * st[1]:st[1]].add(a[:, :, i, j])
+        return out[:, :, pd[0]:ph - pd[2], pd[1]:pw - pd[3]]
+    return apply_op(f, x, op_name="fold")

@@ -1,0 +1,174 @@
+"""Convolution functionals over lax.conv_general_dilated (MXU path).
+
+ref: python/paddle/nn/functional/conv.py. Weight layout follows the
+reference: [out_c, in_c/groups, *kernel]; data_format NCHW (default) or NHWC.
+XLA maps these directly onto the MXU via implicit im2col.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    """paddle padding: int, list of n ints, list of 2n ints, list of n pairs,
+    or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _dims(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else \
+            ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else \
+            ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else \
+        ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd,
+          data_format, op_name):
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    strides = _tuple(stride, nd)
+    dil = _tuple(dilation, nd)
+    pad = _padding(padding, nd)
+    dn_in, dn_w, dn_out = _dims(nd, channel_last)
+
+    def f(a, w, *maybe_b):
+        # weight arrives paddle-layout [O, I/g, *k]; lax wants per dn_w
+        if channel_last:
+            # OIHW -> HWIO etc.
+            perm = tuple(range(2, 2 + nd)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=(dn_in, dn_w, dn_out),
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply_op(f, x, weight, bias, op_name=op_name)
+    return apply_op(f, x, weight, op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df,
+                 "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, nd, data_format, op_name):
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    strides = _tuple(stride, nd)
+    dil = _tuple(dilation, nd)
+    pad = _padding(padding, nd)
+    opad = _tuple(output_padding, nd) if output_padding is not None \
+        else (0,) * nd
+    dn_in, dn_w, dn_out = _dims(nd, channel_last)
+
+    def f(a, w, *maybe_b):
+        # paddle transpose-conv weight: [in_c, out_c/groups, *k]
+        # grad-of-conv formulation: lhs_dilation = stride
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # transposed conv padding: effective pad = k - 1 - p (per side)
+            k = w.shape[2:2 + nd]
+            padding_cfg = [
+                (dil[i] * (k[i] - 1) - pad[i][0],
+                 dil[i] * (k[i] - 1) - pad[i][1] + opad[i])
+                for i in range(nd)]
+        if groups > 1:
+            ic, ocg = w.shape[0], w.shape[1]
+            wg = w.reshape((groups, ic // groups) + w.shape[1:])
+            # flip spatial, swap in/out per group
+            wg = jnp.flip(wg, axis=tuple(range(3, 3 + nd)))
+            wg = jnp.swapaxes(wg, 1, 2)  # [g, ocg, icg, *k]
+            w2 = wg.reshape((groups * ocg, ic // groups) + w.shape[2:])
+        else:
+            w2 = jnp.swapaxes(w, 0, 1)
+            w2 = jnp.flip(w2, axis=tuple(range(2, 2 + nd)))
+        if channel_last:
+            perm = tuple(range(2, 2 + nd)) + (1, 0)
+            w2 = jnp.transpose(w2, perm)
+        out = jax.lax.conv_general_dilated(
+            a, w2, window_strides=(1,) * nd, padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=(dn_in, dn_w, dn_out),
+            feature_group_count=groups)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply_op(f, x, weight, bias, op_name=op_name)
+    return apply_op(f, x, weight, op_name=op_name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, df, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format,
+                           "conv3d_transpose")

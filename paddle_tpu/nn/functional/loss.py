@@ -1,0 +1,274 @@
+"""Loss functionals. ref: python/paddle/nn/functional/loss.py"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+
+def _reduce(v, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(v) / weight_sum
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+        op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+        op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        v = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle multiplies by delta
+        return _reduce(v * delta, reduction)
+    return apply_op(f, input, label, op_name="smooth_l1_loss")
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """ref: nn/functional/loss.py cross_entropy (softmax+NLL fused).
+
+    On TPU this lowers to one fused XLA computation; the reference's
+    c_softmax_with_cross_entropy TP variant lives in distributed.mp_layers.
+    """
+    wd = weight._data if isinstance(weight, Tensor) else weight
+
+    def f(logits, lbl):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label or (lbl.ndim == logits.ndim and
+                          lbl.shape[axis] == logits.shape[axis] and
+                          jnp.issubdtype(lbl.dtype, jnp.floating)):
+            tgt = lbl.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            per = -jnp.sum(tgt * logp, axis=axis)
+            if wd is not None:
+                cls_w = jnp.sum(tgt * wd, axis=axis)
+                per = per * cls_w
+            return _reduce(per, reduction)
+        # hard labels
+        lbl_idx = lbl.astype(jnp.int32)
+        squeeze = (lbl_idx.ndim == logits.ndim and
+                   lbl_idx.shape[axis] == 1)
+        if squeeze:
+            lbl_idx = jnp.squeeze(lbl_idx, axis)
+        k = logits.shape[axis]
+        if label_smoothing > 0.0:
+            oh = jax.nn.one_hot(lbl_idx, k, axis=axis, dtype=jnp.float32)
+            tgt = (1 - label_smoothing) * oh + label_smoothing / k
+            per = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            moved = jnp.moveaxis(logp, axis, -1)
+            per = -jnp.take_along_axis(
+                moved, lbl_idx[..., None], axis=-1)[..., 0]
+        valid = lbl_idx != ignore_index
+        per = jnp.where(valid, per, 0.0)
+        if wd is not None:
+            w_per = jnp.take(wd, jnp.clip(lbl_idx, 0, k - 1)) * valid
+            per = per * w_per
+            return _reduce(per, reduction,
+                           weight_sum=jnp.sum(w_per)
+                           if reduction == "mean" else None)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(per, reduction)
+    return apply_op(f, input, label, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    from .activation import softmax as softmax_fn
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    # paddle keeps a size-1 class dim on the returned loss
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    wd = weight._data if isinstance(weight, Tensor) else weight
+
+    def f(logp, lbl):
+        lbl_idx = lbl.astype(jnp.int32)
+        moved = jnp.moveaxis(logp, 1, -1)
+        per = -jnp.take_along_axis(moved, lbl_idx[..., None],
+                                   axis=-1)[..., 0]
+        valid = lbl_idx != ignore_index
+        per = jnp.where(valid, per, 0.0)
+        if wd is not None:
+            w_per = jnp.take(wd, jnp.clip(lbl_idx, 0, logp.shape[1] - 1))
+            w_per = w_per * valid
+            per = per * w_per
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.sum(w_per)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(per, reduction)
+    return apply_op(f, input, label, op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def f(p, y, *w):
+        eps = 1e-12
+        v = -(y * jnp.log(jnp.maximum(p, eps)) +
+              (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w:
+            v = v * w[0]
+        return _reduce(v, reduction)
+    return apply_op(f, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    pw = pos_weight._data if isinstance(pos_weight, Tensor) else pos_weight
+    args = [logit, label] + ([weight] if weight is not None else [])
+
+    def f(z, y, *w):
+        # numerically-stable BCE-with-logits
+        neg_abs = -jnp.abs(z)
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(neg_abs))
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            base = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        if w:
+            base = base * w[0]
+        return _reduce(base, reduction)
+    return apply_op(f, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, tgt):
+        if log_target:
+            v = jnp.exp(tgt) * (tgt - logp)
+        else:
+            v = tgt * (jnp.log(jnp.maximum(tgt, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(v) / logp.shape[0]
+        return _reduce(v, reduction)
+    return apply_op(f, input, label, op_name="kl_div")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def f(a, y):
+        v = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(v, reduction)
+    return apply_op(f, input, label, op_name="hinge_embedding_loss")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        v = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(v, reduction)
+    return apply_op(f, input, other, label, op_name="margin_ranking_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def f(a, b, y):
+        cos = (jnp.sum(a * b, -1) /
+               jnp.maximum(jnp.linalg.norm(a, axis=-1) *
+                           jnp.linalg.norm(b, axis=-1), 1e-12))
+        v = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(v, reduction)
+    return apply_op(f, input1, input2, label,
+                    op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply_op(f, input, positive, negative,
+                    op_name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan)."""
+    def f(lp, lbl, in_len, lbl_len):
+        # lp: [T, B, C] logits -> log prob
+        logp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = logp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        same = jnp.concatenate(
+            [jnp.ones((B, 2), bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, logp_t):
+            a0 = alpha
+            a1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(same, neg_inf, a2)
+            m = jnp.maximum(jnp.maximum(a0, a1), a2)
+            new = m + jnp.log(
+                jnp.exp(a0 - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m))
+            emit = jnp.take_along_axis(logp_t, ext, axis=1)
+            new = new + emit
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        final = alphas[t_idx, jnp.arange(B)]  # [B, L]
+        end1 = 2 * lbl_len.astype(jnp.int32)
+        end2 = 2 * lbl_len.astype(jnp.int32) - 1
+        f1 = jnp.take_along_axis(final, end1[:, None], axis=1)[:, 0]
+        f2 = jnp.take_along_axis(final, jnp.maximum(end2, 0)[:, None],
+                                 axis=1)[:, 0]
+        m = jnp.maximum(f1, f2)
+        ll = m + jnp.log(jnp.exp(f1 - m) + jnp.exp(f2 - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len, 1))
+        return _reduce(loss, reduction)
+    return apply_op(f, log_probs, labels, input_lengths, label_lengths,
+                    op_name="ctc_loss")

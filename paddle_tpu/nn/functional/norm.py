@@ -1,0 +1,167 @@
+"""Normalization functionals. ref: python/paddle/nn/functional/norm.py.
+
+These are prime XLA fusion targets; layer_norm/rms_norm additionally have
+Pallas fused implementations in paddle_tpu.ops.pallas used on TPU for the
+hot transformer path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (used by Llama-family). Above-parity with the reference's
+    fused_rms_norm (ref: paddle/phi/kernels/fusion/gpu/fused_layernorm*)."""
+    def f(a, *w):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+    args = [weight] if weight is not None else []
+    return apply_op(f, x, *args, op_name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Eager batch_norm; updates running stats in-place on the passed
+    Tensors when training (ref: nn/functional/norm.py batch_norm)."""
+    channel_axis = 1 if data_format.startswith("NC") else -1
+
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        axes = tuple(i for i in range(xd.ndim)
+                     if i != (channel_axis % xd.ndim))
+        batch_mean = jnp.mean(xd.astype(jnp.float32), axis=axes)
+        batch_var = jnp.var(xd.astype(jnp.float32), axis=axes)
+        # in-place running-stat update (leaf buffers)
+        if isinstance(running_mean, Tensor):
+            running_mean._data = (momentum * running_mean._data +
+                                  (1 - momentum) * batch_mean.astype(
+                                      running_mean._data.dtype))
+        if isinstance(running_var, Tensor):
+            n = xd.size // xd.shape[channel_axis % xd.ndim]
+            unbiased = batch_var * (n / max(n - 1, 1))
+            running_var._data = (momentum * running_var._data +
+                                 (1 - momentum) * unbiased.astype(
+                                     running_var._data.dtype))
+        mean_used, var_used = Tensor(batch_mean), Tensor(batch_var)
+    else:
+        mean_used, var_used = running_mean, running_var
+
+    def f(a, m, v, *wb):
+        shape = [1] * a.ndim
+        shape[channel_axis] = a.shape[channel_axis]
+        out = ((a.astype(jnp.float32) - m.reshape(shape)) *
+               jax.lax.rsqrt(v.reshape(shape) + epsilon))
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, mean_used, var_used, *args, op_name="batch_norm")
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-05,
+               data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
+    def f(a, *wb):
+        if channel_last:
+            a_m = jnp.moveaxis(a, -1, 1)
+        else:
+            a_m = a
+        n, c = a_m.shape[:2]
+        spatial = a_m.shape[2:]
+        g = a_m.reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(g.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (g.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.reshape(n, c, *spatial)
+        shape = [1, c] + [1] * len(spatial)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        out = out.astype(a.dtype)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, *args, op_name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, *args, op_name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a.astype(jnp.float32))
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        pad = [(0, 0)] * (moved.ndim - 1) + [(size // 2, (size - 1) // 2)]
+        padded = jnp.pad(moved, pad)
+        c = moved.shape[-1]
+        acc = jnp.stack([padded[..., i:i + c] for i in range(size)],
+                        axis=0).sum(0)
+        acc = jnp.moveaxis(acc, -1, ch_axis)
+        return (a / jnp.power(k + alpha * acc, beta)).astype(a.dtype)
+    return apply_op(f, x, op_name="local_response_norm")

@@ -1,0 +1,194 @@
+"""Pooling functionals via lax.reduce_window.
+ref: python/paddle/nn/functional/pooling.py"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else list(v) * n))[:n]
+    return (int(v),) * n
+
+
+def _pad_cfg(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _pool(x, kernel, stride, padding, nd, data_format, reducer, init,
+          op_name, ceil_mode=False, exclusive=True):
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    k = _tuple(kernel, nd)
+    s = _tuple(stride if stride is not None else kernel, nd)
+    pad = _pad_cfg(padding, nd)
+
+    def f(a):
+        if channel_last:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            spatial_off = 1
+        else:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            spatial_off = 2
+        if isinstance(pad, str):
+            pad_cfg = pad
+        else:
+            full = [(0, 0)] * a.ndim
+            for i in range(nd):
+                full[spatial_off + i] = pad[i]
+            if ceil_mode:
+                # extend right pad so the last partial window is included
+                for i in range(nd):
+                    size = a.shape[spatial_off + i]
+                    lo, hi = full[spatial_off + i]
+                    total = size + lo + hi - k[i]
+                    rem = total % s[i]
+                    if rem != 0:
+                        full[spatial_off + i] = (lo, hi + (s[i] - rem))
+            pad_cfg = full
+        if reducer == "max":
+            out = jax.lax.reduce_window(
+                a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                else jnp.iinfo(a.dtype).min,
+                jax.lax.max, window, strides,
+                pad_cfg if not isinstance(pad_cfg, str) else pad_cfg)
+        else:  # mean
+            summed = jax.lax.reduce_window(
+                a.astype(jnp.float32), 0.0, jax.lax.add, window, strides,
+                pad_cfg)
+            if exclusive and not isinstance(pad_cfg, str):
+                counts = jax.lax.reduce_window(
+                    jnp.ones_like(a, jnp.float32), 0.0, jax.lax.add,
+                    window, strides, pad_cfg)
+                out = (summed / counts).astype(a.dtype)
+            else:
+                out = (summed / float(np.prod(k))).astype(a.dtype)
+        return out
+    return apply_op(f, x, op_name=op_name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, df, "max", None,
+                 "max_pool1d", ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max",
+                 None, "max_pool2d", ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max",
+                 None, "max_pool3d", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, df, "mean", None,
+                 "avg_pool1d", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "mean",
+                 None, "avg_pool2d", ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "mean",
+                 None, "avg_pool3d", ceil_mode, exclusive)
+
+
+def _adaptive_pool(x, output_size, nd, data_format, mode, op_name):
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    os = _tuple(output_size, nd)
+
+    def f(a):
+        spatial_off = 1 if channel_last else 2
+        in_sizes = [a.shape[spatial_off + i] for i in range(nd)]
+        out = a
+        # adaptive pooling = per-dim variable windows; implement via mean/max
+        # over index buckets (equal splits when divisible, else gather)
+        for d in range(nd):
+            axis = spatial_off + d
+            n_in, n_out = in_sizes[d], os[d]
+            if n_out is None:
+                continue
+            if n_in % n_out == 0:
+                k = n_in // n_out
+                new_shape = (out.shape[:axis] + (n_out, k) +
+                             out.shape[axis + 1:])
+                r = out.reshape(new_shape)
+                out = (jnp.max(r, axis=axis + 1) if mode == "max"
+                       else jnp.mean(r.astype(jnp.float32),
+                                     axis=axis + 1).astype(a.dtype))
+            else:
+                starts = [int(np.floor(i * n_in / n_out))
+                          for i in range(n_out)]
+                ends = [int(np.ceil((i + 1) * n_in / n_out))
+                        for i in range(n_out)]
+                pieces = []
+                for st, en in zip(starts, ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[axis] = slice(st, en)
+                    seg = out[tuple(sl)]
+                    pieces.append(
+                        jnp.max(seg, axis=axis, keepdims=True)
+                        if mode == "max" else
+                        jnp.mean(seg.astype(jnp.float32), axis=axis,
+                                 keepdims=True).astype(a.dtype))
+                out = jnp.concatenate(pieces, axis=axis)
+        return out
+    return apply_op(f, x, op_name=op_name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg",
+                          "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg",
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg",
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "max",
+                          "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max",
+                          "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max",
+                          "adaptive_max_pool3d")

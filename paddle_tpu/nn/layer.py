@@ -1,0 +1,320 @@
+"""Layer: the module base class.
+
+ref: python/paddle/nn/layer/layers.py:354 (Layer) — parameters/buffers/
+sublayers registries, hooks, state_dict, train/eval. The TPU-native twist:
+parameters are leaf Tensors whose ._data can be swapped for tracers, so the
+same Layer object serves eager execution and jit functionalization
+(see paddle_tpu.jit).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Parameter, Tensor
+from . import initializer as I
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=None):
+        # use object.__setattr__ to dodge our own __setattr__
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        self._non_persistable_buffer_names = set()
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._forward_pre_hooks: Dict[int, Callable] = {}
+        self._forward_post_hooks: Dict[int, Callable] = {}
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- registration --------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._parameters[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Tensor) and not name.startswith("_"):
+            # plain Tensor attr → non-persistable buffer (ref: layers.py
+            # __setattr__ registers Tensor values as buffers)
+            self._buffers[name] = value
+            self._non_persistable_buffer_names.add(name)
+            self.__dict__.pop(name, None)
+        else:
+            # plain attribute; drop any stale registry entry with same name
+            if name in getattr(self, "_parameters", {}):
+                if value is None:
+                    self._parameters[name] = None
+                    return
+                del self._parameters[name]
+            if name in getattr(self, "_sub_layers", {}):
+                del self._sub_layers[name]
+            if name in getattr(self, "_buffers", {}):
+                del self._buffers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for registry in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for registry in (self._parameters, self._buffers, self._sub_layers):
+            if name in registry:
+                del registry[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """ref: layers.py create_parameter; attr may be a ParamAttr, an
+        Initializer, False (no parameter), or None (default init)."""
+        if attr is False:
+            return None
+        d = convert_dtype(dtype) or self._dtype
+        init = default_initializer
+        trainable = True
+        if attr is not None:
+            if isinstance(attr, I.Initializer):
+                init = attr
+            elif isinstance(attr, ParamAttr):
+                if attr.initializer is not None:
+                    init = attr.initializer
+                trainable = attr.trainable
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        data = init(tuple(shape), d)
+        p = Parameter(data, stop_gradient=not trainable)
+        return p
+
+    # -- iteration -----------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else
+                       f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for item in layer.named_parameters(sub_prefix):
+                    yield item
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for item in layer.named_buffers(sub_prefix):
+                    yield item
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            for item in layer.named_sublayers(sub_prefix):
+                yield item
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items()
+                    if l is not None)
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- mode ----------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for lname, layer in self.named_sublayers(
+                prefix=structured_name_prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is not None:
+                    dest[f"{lname}.{pname}" if lname else pname] = p
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                dest[f"{lname}.{bname}" if lname else bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Returns (missing_keys, unexpected_keys) like the reference."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                data = v._data if isinstance(v, Tensor) else jnp.asarray(
+                    np.asarray(v))
+                target = own[k]
+                if tuple(data.shape) != tuple(target._data.shape):
+                    raise ValueError(
+                        f"shape mismatch for {k}: checkpoint "
+                        f"{tuple(data.shape)} vs model "
+                        f"{tuple(target._data.shape)}")
+                target._data = data.astype(target._data.dtype)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype / device ------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = convert_dtype(dtype)
+            for p in self.parameters():
+                p._data = p._data.astype(d)
+            for b in self.buffers():
+                if jnp.issubdtype(b._data.dtype, jnp.floating):
+                    b._data = b._data.astype(d)
+            for layer in self.sublayers(include_self=True):
+                layer._dtype = d
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            rep = repr(layer).split("\n")
+            rep = [rep[0]] + ["  " + r for r in rep[1:]]
+            lines.append(f"  ({name}): " + "\n".join(rep))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+
+class ParamAttr:
+    """ref: python/paddle/base/param_attr.py ParamAttr"""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

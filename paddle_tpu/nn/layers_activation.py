@@ -1,0 +1,122 @@
+"""Activation layers. ref: python/paddle/nn/layer/activation.py"""
+from __future__ import annotations
+
+from ..core.tensor import Parameter
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+
+def _make(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, name=None, **kwargs):
+            super().__init__()
+            self._args = args
+            self._kwargs = {**fixed, **kwargs}
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, *self._args, **self._kwargs)
+    _Act.__name__ = fn_name
+    return _Act
+
+
+class ReLU(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False, name=None):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, self.approximate)
+
+
+class Sigmoid(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.sigmoid(x)
+
+
+class Tanh(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.tanh(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, self.axis)
+
+
+ELU = _make("elu")
+SELU = _make("selu")
+CELU = _make("celu")
+Silu = _make("silu")
+Swish = _make("swish")
+Mish = _make("mish")
+Hardswish = _make("hardswish")
+Hardsigmoid = _make("hardsigmoid")
+Hardtanh = _make("hardtanh")
+Hardshrink = _make("hardshrink")
+Softshrink = _make("softshrink")
+Tanhshrink = _make("tanhshrink")
+ThresholdedReLU = _make("thresholded_relu")
+Softplus = _make("softplus")
+Softsign = _make("softsign")
+LogSigmoid = _make("log_sigmoid")
+Maxout = _make("maxout")
+GLU = _make("glu")
+RReLU = _make("rrelu")

@@ -1,0 +1,360 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ cells).
+
+ref: python/paddle/nn/layer/rnn.py. TPU-native: the time loop is a
+``lax.scan`` inside one apply_op, so it traces to a single XLA while-op
+(compiler-friendly control flow, no Python-per-step dispatch) and is
+differentiable through the scan.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from . import initializer as I
+from .layer import Layer
+
+
+def _uniform_init(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        init = _uniform_init(hidden_size)
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = Tensor(jnp.zeros(
+                (inputs.shape[0], self.hidden_size), inputs._data.dtype))
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, op_name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        init = _uniform_init(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = jnp.zeros((inputs.shape[0], self.hidden_size),
+                          inputs._data.dtype)
+            states = (Tensor(z), Tensor(z))
+        h0, c0 = states
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i, fgt, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(fgt),
+                         jax.nn.sigmoid(o))
+            g = jnp.tanh(g)
+            c_new = fgt * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h, c = apply_op(f, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                        self.bias_ih, self.bias_hh, op_name="lstm_cell")
+        return h, (h, c)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        init = _uniform_init(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = Tensor(jnp.zeros(
+                (inputs.shape[0], self.hidden_size), inputs._data.dtype))
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h
+
+
+class _RNNBase(Layer):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        init = _uniform_init(hidden_size)
+        g = self.GATES
+        for l in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if l == 0 else hidden_size * ndir
+                suffix = f"_l{l}" + ("_reverse" if d == 1 else "")
+                self.add_parameter(
+                    f"weight_ih{suffix}", self.create_parameter(
+                        [g * hidden_size, in_sz], default_initializer=init))
+                self.add_parameter(
+                    f"weight_hh{suffix}", self.create_parameter(
+                        [g * hidden_size, hidden_size],
+                        default_initializer=init))
+                self.add_parameter(
+                    f"bias_ih{suffix}", self.create_parameter(
+                        [g * hidden_size], is_bias=True,
+                        default_initializer=init))
+                self.add_parameter(
+                    f"bias_hh{suffix}", self.create_parameter(
+                        [g * hidden_size], is_bias=True,
+                        default_initializer=init))
+
+    def _cell_fn(self):
+        raise NotImplementedError
+
+    def _init_state(self, batch, dtype):
+        raise NotImplementedError
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        cell = self._cell_fn()
+        tm = self.time_major
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        params = []
+        for l in range(nl):
+            for d in range(nd):
+                sfx = f"_l{l}" + ("_reverse" if d == 1 else "")
+                params += [self._parameters[f"weight_ih{sfx}"],
+                           self._parameters[f"weight_hh{sfx}"],
+                           self._parameters[f"bias_ih{sfx}"],
+                           self._parameters[f"bias_hh{sfx}"]]
+
+        has_cell_state = self.MODE == "LSTM"
+        init_given = initial_states is not None
+        init_tensors = []
+        if init_given:
+            if has_cell_state:
+                init_tensors = [initial_states[0], initial_states[1]]
+            else:
+                init_tensors = [initial_states]
+
+        def f(x, *flat):
+            if init_given:
+                if has_cell_state:
+                    h0_all, c0_all, *ps = flat
+                else:
+                    h0_all, *ps = flat
+                    c0_all = None
+            else:
+                ps = list(flat)
+                h0_all = c0_all = None
+            if not tm:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            batch = x.shape[1]
+            if h0_all is None:
+                h0_all = jnp.zeros((nl * nd, batch, hs), x.dtype)
+                if has_cell_state:
+                    c0_all = jnp.zeros((nl * nd, batch, hs), x.dtype)
+            out = x
+            last_h, last_c = [], []
+            for l in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    idx = (l * nd + d) * 4
+                    wi, wh, bi, bh = ps[idx:idx + 4]
+                    seq = out if d == 0 else jnp.flip(out, axis=0)
+                    h0 = h0_all[l * nd + d]
+                    carry0 = ((h0, c0_all[l * nd + d]) if has_cell_state
+                              else h0)
+
+                    def step(carry, x_t):
+                        new = cell(x_t, carry, wi, wh, bi, bh)
+                        h_out = new[0] if has_cell_state else new
+                        return new, h_out
+
+                    carry, hs_seq = jax.lax.scan(step, carry0, seq)
+                    if d == 1:
+                        hs_seq = jnp.flip(hs_seq, axis=0)
+                    dir_outs.append(hs_seq)
+                    if has_cell_state:
+                        last_h.append(carry[0])
+                        last_c.append(carry[1])
+                    else:
+                        last_h.append(carry)
+                out = (jnp.concatenate(dir_outs, axis=-1) if nd == 2
+                       else dir_outs[0])
+            outputs = out if tm else jnp.swapaxes(out, 0, 1)
+            h_stack = jnp.stack(last_h, axis=0)
+            if has_cell_state:
+                return outputs, h_stack, jnp.stack(last_c, axis=0)
+            return outputs, h_stack
+
+        res = apply_op(f, inputs, *init_tensors, *params,
+                       op_name=self.MODE.lower())
+        if has_cell_state:
+            outputs, h, c = res
+            return outputs, (h, c)
+        outputs, h = res
+        return outputs, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def _cell_fn(self):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def cell(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        return cell
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+    def __init__(self, *args, **kwargs):
+        kwargs.pop("activation", None)
+        super().__init__(*args, **kwargs)
+
+    def _cell_fn(self):
+        def cell(x, carry, wi, wh, bi, bh):
+            h, c = carry
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                       jax.nn.sigmoid(o))
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            return (o * jnp.tanh(c_new), c_new)
+        return cell
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+    GATES = 3
+
+    def __init__(self, *args, **kwargs):
+        kwargs.pop("activation", None)
+        super().__init__(*args, **kwargs)
+
+    def _cell_fn(self):
+        def cell(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+        return cell
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time. ref: nn/layer/rnn.py RNN"""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        tm = self.time_major
+        steps_axis = 0 if tm else 1
+        n = inputs.shape[steps_axis]
+        outs = []
+        states = initial_states
+        idxs = range(n - 1, -1, -1) if self.is_reverse else range(n)
+        for t in idxs:
+            x_t = inputs[t] if tm else inputs[:, t]
+            o, states = self.cell(x_t, states)
+            outs.append(o)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ..ops.manipulation import stack
+        return stack(outs, axis=steps_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat
+        sf = initial_states[0] if initial_states else None
+        sb = initial_states[1] if initial_states else None
+        out_f, st_f = self.rnn_fw(inputs, sf)
+        out_b, st_b = self.rnn_bw(inputs, sb)
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)

@@ -1,1 +1,6 @@
-# placeholder, filled in by build plan
+"""paddle.optimizer equivalent. ref: python/paddle/optimizer/__init__.py"""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adagrad, Adam, AdamW, Adamax, RMSProp, Lamb,
+    Adadelta,
+)
+from . import lr  # noqa: F401

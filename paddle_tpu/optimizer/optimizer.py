@@ -1,0 +1,391 @@
+"""Optimizer base + SGD/Momentum/Adam/AdamW/etc.
+
+ref: python/paddle/optimizer/optimizer.py. TPU-native design: each optimizer
+defines a *pure* per-parameter update ``_update(p, g, state, lr) ->
+(new_p, new_state)`` over jnp arrays. Eager ``step()`` loops parameters and
+mutates leaf tensors; the jit path (paddle_tpu.jit.TrainStep) calls the same
+pure update inside the traced program, so eager and compiled training share
+one numeric definition (the analog of the reference's fused
+multi-tensor/adamw kernels is XLA fusing this update across params).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters must be provided (pass model.parameters())")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float):
+            self._weight_decay = weight_decay
+        elif weight_decay is None:
+            self._weight_decay = 0.0
+        else:  # L2Decay-like object with a coeff
+            self._weight_decay = getattr(weight_decay, "_coeff",
+                                         getattr(weight_decay, "coeff", 0.0))
+        # per-param slot states keyed by id(param)
+        self._states: Dict[int, Dict[str, Any]] = {}
+        self._global_step = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the learning rate is a scheduler")
+        self._learning_rate = value
+
+    # -- states --------------------------------------------------------------
+    def _init_state(self, p: Parameter) -> Dict[str, Any]:
+        return {}
+
+    def _state_for(self, p: Parameter) -> Dict[str, Any]:
+        s = self._states.get(id(p))
+        if s is None:
+            s = self._init_state(p)
+            self._states[id(p)] = s
+        return s
+
+    # -- the pure update (override per optimizer) ---------------------------
+    def _update(self, p, g, state, lr):
+        raise NotImplementedError
+
+    def _use_wd(self, p) -> float:
+        return self._weight_decay
+
+    # -- step ----------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        self._global_step += 1
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            gd = g._data if isinstance(g, Tensor) else g
+            state = self._state_for(p)
+            new_p, new_state = self._update(p._data, gd, state, lr)
+            p._data = new_p
+            self._states[id(p)] = new_state
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        for i, p in enumerate(self._parameter_list):
+            s = self._states.get(id(p))
+            if s:
+                for k, v in s.items():
+                    out[f"param_{i}_{k}"] = (Tensor(v)
+                                             if not isinstance(v, Tensor)
+                                             else v)
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._global_step = state_dict.get("global_step", 0)
+        if isinstance(self._learning_rate, LRScheduler) and \
+                "LR_Scheduler" in state_dict:
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            s = {}
+            prefix = f"param_{i}_"
+            for k, v in state_dict.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    val = v._data if isinstance(v, Tensor) else jnp.asarray(
+                        np.asarray(v))
+                    s[k[len(prefix):]] = val
+            if s:
+                self._states[id(p)] = s
+
+
+class SGD(Optimizer):
+    """ref: python/paddle/optimizer/sgd.py"""
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(jnp.float32)
+        wd = self._weight_decay
+        if wd:
+            g = g + wd * p.astype(jnp.float32)
+        return (p - lr * g.astype(p.dtype)).astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    """ref: python/paddle/optimizer/momentum.py"""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._data, jnp.float32)}
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._data, self._init_acc,
+                                        jnp.float32)}
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        m = state["moment"] + g * g
+        new_p = p.astype(jnp.float32) - lr * g / (jnp.sqrt(m) +
+                                                  self._epsilon)
+        return new_p.astype(p.dtype), {"moment": m}
+
+
+class Adam(Optimizer):
+    """ref: python/paddle/optimizer/adam.py (L2 regularization folded into
+    the gradient, unlike AdamW's decoupled decay)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 use_multi_tensor=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._decoupled_wd = False
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._data, jnp.float32),
+            "moment2": jnp.zeros_like(p._data, jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        wd = self._use_wd(p)
+        if wd and not self._decoupled_wd:
+            g = g + wd * p32
+        m1 = b1 * state["moment1"] + (1 - b1) * g
+        m2 = b2 * state["moment2"] + (1 - b2) * g * g
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        upd = m1_hat / (jnp.sqrt(m2_hat) + eps)
+        if wd and self._decoupled_wd:
+            upd = upd + wd * p32
+        new_p = (p32 - lr * upd).astype(p.dtype)
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay. ref: python/paddle/optimizer/adamw.py"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._decoupled_wd = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._param_names = {id(p): getattr(p, "name", "") or f"param_{i}"
+                             for i, p in enumerate(self._parameter_list)}
+        self._current_pid = None
+
+    def _use_wd(self, p):
+        if self._apply_decay_param_fun is not None:
+            name = self._param_names.get(self._current_pid, "")
+            if not self._apply_decay_param_fun(name):
+                return 0.0
+        return self._weight_decay
+
+    @no_grad()
+    def step(self):
+        self._global_step += 1
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            self._current_pid = id(p)
+            gd = g._data if isinstance(g, Tensor) else g
+            state = self._state_for(p)
+            new_p, new_state = self._update(p._data, gd, state, lr)
+            p._data = new_p
+            self._states[id(p)] = new_state
+        self._current_pid = None
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p._data, jnp.float32),
+                "inf_norm": jnp.zeros_like(p._data, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        b1p = state["beta1_pow"] * b1
+        new_p = (p.astype(jnp.float32) -
+                 lr / (1 - b1p) * m / (u + eps)).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        s = {"mean_square": jnp.zeros_like(p._data, jnp.float32),
+             "momentum": jnp.zeros_like(p._data, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p._data, jnp.float32)
+        return s
+
+    def _update(self, p, g, state, lr):
+        rho, eps = self._rho, self._epsilon
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_state["momentum"] = mom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), new_state
+
+
+class Lamb(Optimizer):
+    """ref: python/paddle/optimizer/lamb.py"""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p._data, jnp.float32),
+                "moment2": jnp.zeros_like(p._data, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m1 = b1 * state["moment1"] + (1 - b1) * g
+        m2 = b2 * state["moment2"] + (1 - b2) * g * g
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        r = (m1 / (1 - b1p)) / (jnp.sqrt(m2 / (1 - b2p)) + eps)
+        r = r + self._weight_decay * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = (p32 - lr * trust * r).astype(p.dtype)
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p._data, jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p._data, jnp.float32)}
+
+    def _update(self, p, g, state, lr):
+        rho, eps = self._rho, self._epsilon
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        upd = g * jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}

@@ -1,0 +1,1 @@
+from . import clip_grad  # noqa: F401

@@ -1,0 +1,96 @@
+"""Gradient clipping strategies.
+
+ref: python/paddle/nn/clip.py (ClipGradByGlobalNorm etc.). Operate on
+(param, grad) lists; the distributed variant that allreduces the norm
+across mesh axes lives in distributed.fleet (hybrid_parallel_optimizer).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gd = g._data if isinstance(g, Tensor) else g
+            out.append((p, Tensor(jnp.clip(gd, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gd = g._data if isinstance(g, Tensor) else g
+            norm = jnp.sqrt(jnp.sum(jnp.square(gd.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, Tensor((gd * scale).astype(gd.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        sq = []
+        for _, g in params_grads:
+            if g is None:
+                continue
+            gd = g._data if isinstance(g, Tensor) else g
+            sq.append(jnp.sum(jnp.square(gd.astype(jnp.float32))))
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gd = g._data if isinstance(g, Tensor) else g
+            out.append((p, Tensor((gd * scale).astype(gd.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style helper also exposed by paddle.nn.utils."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        norms = [jnp.max(jnp.abs(p.grad._data)) for p in params]
+        total = jnp.max(jnp.stack(norms))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._data.astype(jnp.float32)) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    scale = max_norm / jnp.maximum(total, 1e-6)
+    scale = jnp.minimum(scale, 1.0)
+    for p in params:
+        p.grad._data = (p.grad._data * scale).astype(p.grad._data.dtype)
+    return Tensor(total)
