@@ -26,6 +26,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled_opts = set()
 
     def scale(self, var):
         if not self._enable:
@@ -33,8 +34,9 @@ class GradScaler:
         return var * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or id(optimizer) in self._unscaled_opts:
             return
+        self._unscaled_opts.add(id(optimizer))
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -53,11 +55,12 @@ class GradScaler:
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
+        self._unscaled_opts.discard(id(optimizer))
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
         if not (self._enable and self._dynamic):

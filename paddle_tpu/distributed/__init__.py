@@ -1,0 +1,34 @@
+"""paddle.distributed equivalent, TPU-native.
+
+ref: python/paddle/distributed/__init__.py. Three API tiers, as in the
+reference: (1) eager collectives + groups (communication/), (2) semi-auto
+parallel DTensor (auto_parallel/api.py), (3) fleet hybrid-parallel
+orchestration (fleet/). All three ride jax.sharding + XLA collectives.
+"""
+from .placement import Placement, Replicate, Shard, Partial  # noqa: F401
+from .process_mesh import (  # noqa: F401
+    ProcessMesh, get_default_mesh, set_default_mesh, init_process_mesh,
+)
+from .api import (  # noqa: F401
+    DistAttr, shard_tensor, dtensor_from_fn, reshard, shard_layer,
+    unshard_dtensor, placements_to_spec,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    all_gather_object, broadcast, reduce, scatter, alltoall,
+    alltoall_single, send, recv, isend, irecv, barrier, reduce_scatter,
+    stream,
+)
+from .parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized,
+    ParallelEnv, DataParallel,
+)
+
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+
+# paddle.distributed.split (TP sugar) lives in fleet.mp_ops
+from .fleet.mp_ops import split  # noqa: F401
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,11 @@
+"""Distributed checkpoint: sharded save/load with reshard-on-load.
+
+ref: python/paddle/distributed/checkpoint/save_state_dict.py:145 and
+metadata.py:20-41 (Metadata{LocalTensorMetadata(global_offset,
+local_shape)}), load_state_dict.py. Design contract preserved: each rank
+writes only its local shards plus a global metadata index; load reshards
+when the target mesh/placements differ (SURVEY.md §5 Checkpoint/resume).
+"""
+from .save_load import (  # noqa: F401
+    save_state_dict, load_state_dict, LocalTensorMetadata, Metadata,
+)

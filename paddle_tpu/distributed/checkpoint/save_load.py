@@ -1,0 +1,153 @@
+"""Sharded state_dict save/load.
+
+ref: python/paddle/distributed/checkpoint/save_state_dict.py:145
+(save_state_dict: local shard write + metadata, cross-rank dedup of
+replicated tensors at :117 dedup_tensor) and load_state_dict.py (reshard
+on read). TPU-native: a jax.Array's addressable_shards give exactly the
+(global_offset, local_shape) pairs the reference records; load assembles
+the global value from shard files then device_puts with the target
+sharding — changed mesh/placement works by construction.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict", "Metadata",
+           "LocalTensorMetadata"]
+
+
+@dataclass
+class LocalTensorMetadata:
+    """ref: checkpoint/metadata.py:20."""
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+    file_name: str
+
+
+@dataclass
+class Metadata:
+    """ref: checkpoint/metadata.py:41."""
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = \
+        field(default_factory=dict)
+    flat_mapping: Dict[str, str] = field(default_factory=dict)
+
+
+def _process_id() -> int:
+    return jax.process_index()
+
+
+def save_state_dict(state_dict: Dict, path: str,
+                    process_group=None, coordinator_rank: int = 0):
+    """ref: save_state_dict.py:145. Layout on disk:
+    path/{key}__{shard_idx}.npy per local shard + path/metadata.json
+    (written by the coordinator; single-controller writes everything)."""
+    os.makedirs(path, exist_ok=True)
+    meta = Metadata()
+    rank = _process_id()
+    for key, value in _flatten(state_dict).items():
+        arr = value._data if isinstance(value, Tensor) else np.asarray(value)
+        entries = []
+        if isinstance(arr, jax.Array) and len(arr.sharding.device_set) > 1:
+            seen_offsets = set()
+            for i, shard in enumerate(arr.addressable_shards):
+                offset = tuple(s.start or 0 for s in shard.index) \
+                    if shard.index else ()
+                if offset in seen_offsets:
+                    continue  # dedup replicated shards (ref: :117)
+                seen_offsets.add(offset)
+                fname = f"{_safe(key)}__r{rank}s{i}.npy"
+                np.save(os.path.join(path, fname), np.asarray(shard.data))
+                entries.append(LocalTensorMetadata(
+                    offset, tuple(shard.data.shape), str(arr.dtype), fname))
+        else:
+            if rank == coordinator_rank:
+                fname = f"{_safe(key)}__full.npy"
+                np.save(os.path.join(path, fname), np.asarray(arr))
+                entries.append(LocalTensorMetadata(
+                    tuple(0 for _ in np.shape(arr)),
+                    tuple(np.shape(arr)), str(np.asarray(arr).dtype), fname))
+        if entries:
+            meta.state_dict_metadata[key] = entries
+    # merge metadata across processes via the filesystem (each process owns
+    # distinct keys' shard files; coordinator merges)
+    part = os.path.join(path, f"metadata_rank{rank}.pkl")
+    with open(part, "wb") as f:
+        pickle.dump(meta, f)
+    if rank == coordinator_rank:
+        merged = Metadata()
+        for fn in sorted(os.listdir(path)):
+            if fn.startswith("metadata_rank") and fn.endswith(".pkl"):
+                with open(os.path.join(path, fn), "rb") as f:
+                    m = pickle.load(f)
+                for k, v in m.state_dict_metadata.items():
+                    merged.state_dict_metadata.setdefault(k, []).extend(v)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump({k: [vars(e) for e in v]
+                       for k, v in merged.state_dict_metadata.items()}, f)
+
+
+def load_state_dict(state_dict: Dict, path: str,
+                    process_group=None, coordinator_rank: int = 0):
+    """ref: load_state_dict.py — fills `state_dict` values in place,
+    resharding to each destination tensor's current sharding."""
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    flat = _flatten(state_dict)
+    for key, dest in flat.items():
+        if key not in meta:
+            continue
+        entries = meta[key]
+        global_arr = _assemble(path, entries)
+        if isinstance(dest, Tensor):
+            target = dest._data
+            if isinstance(target, jax.Array) and hasattr(target, "sharding"):
+                arr = jax.device_put(
+                    global_arr.astype(target.dtype), target.sharding)
+            else:
+                arr = jax.numpy.asarray(global_arr)
+            dest._data = arr
+        else:
+            raise TypeError(f"load_state_dict target for {key} must be Tensor")
+
+
+def _assemble(path: str, entries: List[dict]) -> np.ndarray:
+    if len(entries) == 1 and all(o == 0 for o in entries[0]["global_offset"]):
+        return np.load(os.path.join(path, entries[0]["file_name"]))
+    # compute global shape as max(offset + local_shape) per dim
+    ndim = len(entries[0]["local_shape"])
+    gshape = [0] * ndim
+    for e in entries:
+        for d in range(ndim):
+            gshape[d] = max(gshape[d],
+                            e["global_offset"][d] + e["local_shape"][d])
+    out = np.zeros(gshape, dtype=entries[0]["dtype"])
+    for e in entries:
+        sl = tuple(slice(o, o + s) for o, s in
+                   zip(e["global_offset"], e["local_shape"]))
+        out[sl] = np.load(os.path.join(path, e["file_name"]))
+    return out
+
+
+def _flatten(d: Dict, prefix: str = "") -> Dict[str, object]:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _safe(key: str) -> str:
+    return key.replace("/", "_").replace(".", "_")

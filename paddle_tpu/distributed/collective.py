@@ -1,0 +1,355 @@
+"""Eager collective communication API + Group bookkeeping.
+
+ref: python/paddle/distributed/communication/ (all_reduce.py etc.) and
+paddle/fluid/distributed/collective/process_group_nccl.cc. TPU-native design
+(SURVEY.md §5 "Distributed communication backend"): instead of NCCL comms on
+a side stream, each collective is a tiny cached XLA executable over the
+group's device mesh — the collective rides ICI inside the compiled program.
+
+Two operating regimes:
+- single-controller (default, incl. tests with 8 virtual CPU devices): one
+  Python process drives all chips; "ranks" are devices. Eager collectives on
+  replicated host values are identity-like (world through jit is the real
+  path); collectives on device-sharded DistTensors run compiled psum etc.
+- multi-process (jax.distributed.initialize via launch CLI): rank ==
+  process_index, and the same compiled-collective cache spans hosts (DCN).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
+    "all_gather_object", "broadcast", "reduce", "scatter", "alltoall",
+    "alltoall_single", "send", "recv", "isend", "irecv", "barrier",
+    "reduce_scatter", "stream",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Task:
+    """Async collective handle (ref: process_group.h Task). XLA dispatch is
+    already async; wait() blocks on the result buffer."""
+
+    def __init__(self, arrays):
+        self._arrays = arrays
+
+    def wait(self):
+        for a in self._arrays:
+            if hasattr(a, "block_until_ready"):
+                a.block_until_ready()
+
+    def is_completed(self):
+        return True
+
+
+class Group:
+    """ref: python/paddle/distributed/communication/group.py Group."""
+
+    def __init__(self, gid: int, ranks: List[int]):
+        self.id = gid
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        """This process's rank within the group (-1 if not a member)."""
+        grank = _global_rank()
+        return self.ranks.index(grank) if grank in self.ranks else -1
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_group_map = {}
+_group_counter = 0
+
+
+def _global_rank() -> int:
+    return jax.process_index()
+
+
+def _world_size() -> int:
+    return jax.process_count()
+
+
+def _ensure_default_group() -> Group:
+    if 0 not in _group_map:
+        _group_map[0] = Group(0, list(range(max(_world_size(), 1))))
+    return _group_map[0]
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _ensure_default_group()
+    return _group_map[gid]
+
+
+def _get_group(group: Optional[Group]) -> Group:
+    return group if group is not None else _ensure_default_group()
+
+
+def new_group(ranks: Optional[List[int]] = None, backend=None, timeout=None) -> Group:
+    """ref: communication/group.py new_group."""
+    global _group_counter
+    _group_counter += 1
+    if ranks is None:
+        ranks = list(range(max(_world_size(), 1)))
+    g = Group(_group_counter, sorted(ranks))
+    _group_map[g.id] = g
+    return g
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+# -- multi-process compiled collectives --------------------------------------
+# One device per process is assumed for the cross-process eager path (the
+# launch CLI sets this up); a global 1-D mesh over process-local device 0 of
+# every process carries the collective.
+
+@functools.lru_cache(maxsize=None)
+def _proc_mesh(nranks: int):
+    from jax.sharding import Mesh
+    devs = np.asarray(jax.devices()[:nranks], dtype=object)
+    return Mesh(devs, axis_names=("r",))
+
+
+def _cross_process(op_name, arr, group: Group, **kw):
+    """Run a one-collective compiled program over the group's ranks."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = _proc_mesh(group.nranks)
+    x = jax.make_array_from_single_device_arrays(
+        (group.nranks,) + arr.shape,
+        NamedSharding(mesh, P("r")),
+        [jax.device_put(arr[None], jax.devices()[0])])
+
+    if op_name == "all_reduce":
+        red = kw.get("op", ReduceOp.SUM)
+        def f(v):
+            v = v[0]
+            if red in (ReduceOp.SUM, ReduceOp.AVG):
+                out = jax.lax.psum(v, "r")
+                if red == ReduceOp.AVG:
+                    out = out / group.nranks
+            elif red == ReduceOp.MAX:
+                out = jax.lax.pmax(v, "r")
+            elif red == ReduceOp.MIN:
+                out = jax.lax.pmin(v, "r")
+            else:
+                raise NotImplementedError(red)
+            return out[None]
+    elif op_name == "all_gather":
+        def f(v):
+            return jax.lax.all_gather(v[0], "r")
+    else:
+        raise NotImplementedError(op_name)
+
+    spec = P("r")
+    fn = jax.jit(shard_map(f, mesh=mesh, in_specs=(spec,),
+                           out_specs=spec if op_name == "all_reduce" else P("r")))
+    return fn(x)
+
+
+# -- public API ---------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True) -> Task:
+    """ref: communication/all_reduce.py:29. In-place on `tensor`."""
+    g = _get_group(group)
+    if g.nranks <= 1 or _world_size() <= 1:
+        # single-controller: value already holds the full contribution
+        if op == ReduceOp.AVG and g.nranks > 1:
+            tensor._data = _unwrap(tensor) / g.nranks
+        return Task([_unwrap(tensor)])
+    out = _cross_process("all_reduce", _unwrap(tensor), g, op=op)
+    local = out[jax.process_index() % out.shape[0]] if out.ndim > _unwrap(tensor).ndim else out
+    tensor._data = jnp.asarray(local)
+    return Task([tensor._data])
+
+
+def all_gather(tensor_list: List, tensor, group: Optional[Group] = None,
+               sync_op: bool = True) -> Task:
+    """ref: communication/all_gather.py."""
+    g = _get_group(group)
+    arr = _unwrap(tensor)
+    if g.nranks <= 1 or _world_size() <= 1:
+        for _ in range(g.nranks):
+            tensor_list.append(Tensor(jnp.asarray(arr)))
+        return Task([arr])
+    out = _cross_process("all_gather", arr, g)
+    host = np.asarray(out)
+    for i in range(g.nranks):
+        tensor_list.append(Tensor(jnp.asarray(host[i])))
+    return Task([arr])
+
+
+def all_gather_object(object_list: List, obj, group: Optional[Group] = None):
+    g = _get_group(group)
+    if g.nranks <= 1 or _world_size() <= 1:
+        object_list.extend(obj for _ in range(g.nranks))
+        return
+    import pickle
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    size = np.array([payload.size], dtype=np.int32)
+    sizes = np.asarray(_cross_process("all_gather", jnp.asarray(size),
+                                      g)).reshape(g.nranks)
+    maxlen = int(sizes.max())
+    padded = np.zeros(maxlen, dtype=np.uint8)
+    padded[:payload.size] = payload
+    gathered = np.asarray(
+        _cross_process("all_gather", jnp.asarray(padded), g))
+    for i in range(g.nranks):
+        object_list.append(pickle.loads(gathered[i][:sizes[i]].tobytes()))
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True) -> Task:
+    """ref: communication/broadcast.py. Single-controller: identity."""
+    g = _get_group(group)
+    if g.nranks <= 1 or _world_size() <= 1:
+        return Task([_unwrap(tensor)])
+    # broadcast == all_reduce of (value if rank==src else zeros)
+    arr = _unwrap(tensor)
+    if g.rank != g.get_group_rank(src):
+        arr = jnp.zeros_like(arr)
+    t = Tensor(arr)
+    task = all_reduce(t, ReduceOp.SUM, g)
+    tensor._data = t._data
+    return task
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    task = all_reduce(tensor, op, group)
+    return task
+
+
+def scatter(tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    g = _get_group(group)
+    if g.nranks <= 1 or _world_size() <= 1:
+        if tensor_list:
+            tensor._data = _unwrap(tensor_list[0])
+        return Task([_unwrap(tensor)])
+    raise NotImplementedError(
+        "cross-process scatter requires the launch runtime")
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    g = _get_group(group)
+    if g.nranks <= 1 or _world_size() <= 1:
+        idx = max(g.rank, 0)
+        t = Tensor(_unwrap(tensor_list[idx]))
+        all_reduce(t, op, g)
+        tensor._data = t._data
+        return Task([tensor._data])
+    stacked = jnp.stack([_unwrap(t) for t in tensor_list])
+    summed = _cross_process("all_reduce", stacked, g, op=op)
+    tensor._data = jnp.asarray(summed)[g.rank]
+    return Task([tensor._data])
+
+
+def alltoall(out_tensor_list: List, in_tensor_list: List,
+             group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    g = _get_group(group)
+    if g.nranks <= 1 or _world_size() <= 1:
+        out_tensor_list.extend(Tensor(_unwrap(t)) for t in in_tensor_list)
+        return Task([])
+    stacked = jnp.stack([_unwrap(t) for t in in_tensor_list])
+    gathered = np.asarray(_cross_process("all_gather", stacked, g))
+    r = g.rank
+    for i in range(g.nranks):
+        out_tensor_list.append(Tensor(jnp.asarray(gathered[i][r])))
+    return Task([])
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group: Optional[Group] = None,
+                    sync_op: bool = True) -> Task:
+    g = _get_group(group)
+    if g.nranks <= 1 or _world_size() <= 1:
+        out_tensor._data = _unwrap(in_tensor)
+        return Task([out_tensor._data])
+    raise NotImplementedError(
+        "cross-process alltoall_single requires the launch runtime")
+
+
+def send(tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True) -> Task:
+    if _world_size() <= 1:
+        _p2p_buf.append(jnp.asarray(_unwrap(tensor)))
+        return Task([])
+    raise NotImplementedError("cross-process send requires the launch runtime")
+
+
+def recv(tensor, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True) -> Task:
+    if _world_size() <= 1:
+        if _p2p_buf:
+            tensor._data = _p2p_buf.pop(0)
+        return Task([])
+    raise NotImplementedError("cross-process recv requires the launch runtime")
+
+
+_p2p_buf: List = []
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def barrier(group: Optional[Group] = None):
+    g = _get_group(group)
+    if g.nranks <= 1 or _world_size() <= 1:
+        return
+    t = Tensor(jnp.zeros((1,), jnp.float32))
+    all_reduce(t, ReduceOp.SUM, g).wait()
+
+
+class stream:
+    """paddle.distributed.stream.* namespace parity (sync/calc-stream
+    variants collapse on TPU: XLA owns scheduling)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    alltoall = staticmethod(alltoall)
+    reduce_scatter = staticmethod(reduce_scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

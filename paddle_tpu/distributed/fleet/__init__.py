@@ -1,0 +1,29 @@
+"""paddle.distributed.fleet equivalent.
+
+ref: python/paddle/distributed/fleet/__init__.py — hybrid-parallel
+orchestration: topology, TP/PP/sharding wrappers, meta-optimizers.
+"""
+from .fleet import (  # noqa: F401
+    DistributedStrategy, init, fleet, distributed_model,
+    distributed_optimizer, get_hybrid_communicate_group,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from . import mp_ops  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
+from .sharding_optimizer import DygraphShardingOptimizer  # noqa: F401
+
+# meta_parallel namespace parity (ref: fleet/meta_parallel/__init__.py)
+from . import mp_layers as meta_parallel  # noqa: F401
+
+worker_num = None  # populated via fleet singleton accessors
+is_first_worker = fleet.is_first_worker
+barrier_worker = fleet.barrier_worker
+worker_index = fleet.worker_index
+is_initialized = fleet.is_initialized

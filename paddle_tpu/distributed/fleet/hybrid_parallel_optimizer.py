@@ -1,0 +1,89 @@
+"""HybridParallelOptimizer: optimizer wrapper for hybrid parallelism.
+
+ref: python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:42 (HybridParallelClipGrad — global-norm clip
+allreduced across mp/pp/sharding groups) and :266 (HybridParallelOptimizer).
+Delegates to DygraphShardingOptimizer when sharding degree > 1.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..collective import ReduceOp, all_reduce
+from ..parallel import get_world_size
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad"]
+
+
+class HybridParallelClipGrad:
+    """ref: hybrid_parallel_optimizer.py:42 — the local sq-norm of each
+    param group is summed across the hybrid groups before clipping so the
+    clip factor is identical on all ranks."""
+
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        sq = None
+        for p, g in params_grads:
+            if g is None:
+                continue
+            s = jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+            sq = s if sq is None else sq + s
+        if sq is None:
+            return params_grads
+        t = Tensor(sq)
+        if get_world_size() > 1:
+            all_reduce(t, ReduceOp.SUM, self._hcg.get_model_parallel_group())
+        global_norm = jnp.sqrt(t._data)
+        max_norm = getattr(self._clip, "clip_norm", None) or \
+            getattr(self._clip, "max_global_norm", 1.0)
+        factor = jnp.minimum(max_norm / (global_norm + 1e-6), 1.0)
+        return [(p, None if g is None else Tensor(g._data * factor))
+                for p, g in params_grads]
+
+
+class HybridParallelOptimizer:
+    """ref: hybrid_parallel_optimizer.py:266."""
+
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self._sharding = hcg.get_sharding_parallel_world_size() > 1
+        if self._sharding:
+            from .sharding_optimizer import DygraphShardingOptimizer
+            stage = int((getattr(strategy, "sharding_configs", {}) or {})
+                        .get("stage", 1))
+            self._inner_opt = DygraphShardingOptimizer(
+                optimizer, hcg, stage=stage)
+        clip = getattr(optimizer, "_grad_clip", None)
+        if clip is not None:
+            optimizer._grad_clip = HybridParallelClipGrad(clip, hcg)
+
+    def step(self):
+        # dp(+sep) gradient sync before the update
+        # (ref: hybrid_parallel_util.py:249 fused_allreduce_gradients)
+        if get_world_size() > 1 and \
+                self._hcg.get_data_parallel_world_size() > 1:
+            n = self._hcg.get_data_parallel_world_size()
+            group = self._hcg.get_data_parallel_group()
+            for p in self._inner_opt._parameter_list:
+                if p.grad is not None:
+                    all_reduce(p.grad, ReduceOp.SUM, group)
+                    p.grad._data = p.grad._data / n
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)

@@ -1,0 +1,83 @@
+"""Tensor-parallel communication primitives.
+
+ref: python/paddle/distributed/fleet/layers/mpu/mp_ops.py:91-341
+(_c_identity / _c_split / _c_concat / _mp_allreduce) and :706
+(paddle.distributed.split). TPU-native: under jit these are pure sharding
+annotations (with_sharding_constraint) and XLA inserts the collective; the
+eager fallbacks below act on replicated values on a single controller.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.autograd import apply_op
+from ..collective import Group, ReduceOp, all_reduce, get_group
+
+__all__ = ["_c_identity", "_c_split", "_c_concat", "_mp_allreduce", "split"]
+
+
+def _nranks(group: Optional[Group]):
+    g = group if group is not None else get_group(0)
+    return max(g.nranks, 1), max(g.rank, 0)
+
+
+def _c_identity(tensor: Tensor, group: Optional[Group] = None) -> Tensor:
+    """Forward identity, backward allreduce over the mp group
+    (ref: mp_ops.py:91 c_identity). Under trace the backward psum comes from
+    the sharding of the consumer; eager single-controller returns as-is."""
+    return apply_op(lambda x: x, tensor, op_name="c_identity")
+
+
+def _mp_allreduce(tensor: Tensor, op=ReduceOp.SUM,
+                  group: Optional[Group] = None) -> Tensor:
+    """Forward allreduce, backward identity (ref: mp_ops.py:241)."""
+    out = apply_op(lambda x: x, tensor, op_name="mp_allreduce")
+    all_reduce(out, op, group)
+    return out
+
+
+def _c_split(tensor: Tensor, group: Optional[Group] = None) -> Tensor:
+    """Split last dim, keep local rank's slice (ref: mp_ops.py:141)."""
+    n, r = _nranks(group)
+    if n == 1:
+        return tensor
+    def f(x):
+        return jnp.split(x, n, axis=-1)[r]
+    return apply_op(f, tensor, op_name="c_split")
+
+
+def _c_concat(tensor: Tensor, group: Optional[Group] = None) -> Tensor:
+    """All-gather along last dim (ref: mp_ops.py:176). Single-controller:
+    identity (the value is already global)."""
+    return apply_op(lambda x: x, tensor, op_name="c_concat")
+
+
+def split(x, size, operation: str, axis: int = 0, num_partitions: int = 1,
+          gather_out: bool = True, weight_attr=None, bias_attr=None,
+          name=None):
+    """ref: mp_ops.py:706 paddle.distributed.split — sugar constructing a
+    row/column-parallel linear or vocab-parallel embedding."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, name=name)
+        else:
+            layer = ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out, name=name)
+        return layer(x)
+    elif operation == "embedding":
+        vocab, dim = size
+        layer = VocabParallelEmbedding(vocab, dim, weight_attr=weight_attr,
+                                       name=name)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation}")

@@ -1,0 +1,140 @@
+"""Pipeline-parallel runtime: 1F1B schedule over micro-batches.
+
+ref: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:255
+(PipelineParallel), :575-720 (forward_backward_pipeline: warmup
+recv_forward/_forward_step/send_forward, steady 1F1B, cooldown), :928
+(_forward_step), :994 (_backward_step); p2p meta handshake
+pp_utils/p2p_communication.py:52,576.
+
+TPU-native note (SURVEY.md §7 "hard parts"): a host-driven per-micro-batch
+loop serializes on dispatch latency. This runtime therefore (a) keeps the
+reference's 1F1B order so memory high-water matches, and (b) under a
+single controller the stage programs are jit-cached so the host loop only
+enqueues. The fully-compiled alternative (stage axis on the mesh +
+collective_permute) lives in paddle_tpu.parallel.pipeline_spmd and is what
+dryrun_multichip exercises.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ..collective import recv, send
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError(
+                "fleet.distributed_model with pp_degree>1 expects a "
+                "PipelineLayer (ref: fleet/model.py:134)")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pcfg = getattr(strategy, "pipeline_configs", {}) or {}
+        self.accumulate_steps = int(pcfg.get("accumulate_steps", 1))
+        self.micro_batch_size = int(pcfg.get("micro_batch_size", 1))
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.stage_id = hcg.get_stage_id()
+        self.is_first_stage = self.stage_id == 0
+        self.is_last_stage = self.stage_id == self.num_stages - 1
+        self.total_loss = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    # -- schedule -----------------------------------------------------------
+    def _split_micro(self, data):
+        """Split the global batch into accumulate_steps micro-batches."""
+        if data is None:
+            return [None] * self.accumulate_steps
+        if isinstance(data, (tuple, list)):
+            parts = [self._split_micro(d) for d in data]
+            return list(zip(*parts))
+        n = self.accumulate_steps
+        arrs = jnp.split(data._data if isinstance(data, Tensor) else
+                         jnp.asarray(data), n, axis=0)
+        return [Tensor(a) for a in arrs]
+
+    def _forward_step(self, micro_input, micro_label):
+        """ref: pipeline_parallel.py:928."""
+        out = micro_input
+        if not self.is_first_stage:
+            # out arrived from the previous stage via recv
+            pass
+        out = self._layers(out) if not isinstance(out, (tuple, list)) \
+            else self._layers(*out)
+        if self.is_last_stage and self._layers._loss_fn is not None:
+            loss = self._layers._loss_fn(out, micro_label)
+            if isinstance(loss, Tensor) and loss._data.ndim > 0:
+                loss = loss.mean() if hasattr(loss, "mean") else loss
+            return loss
+        return out
+
+    def _backward_step(self, out, out_grad=None):
+        """ref: pipeline_parallel.py:994 — paddle.autograd.backward on the
+        chunk with received output grads."""
+        out.backward(out_grad)
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """ref: :575 — on a single controller all stages are local, so 1F1B
+        degenerates to looped fwd+bwd per micro-batch with grad
+        accumulation (identical numerics and memory shape)."""
+        inputs, labels = data if isinstance(data, (tuple, list)) and \
+            len(data) == 2 else (data, None)
+        micro_inputs = self._split_micro(inputs)
+        micro_labels = self._split_micro(labels)
+        self.total_loss = None
+        for mi, ml in zip(micro_inputs, micro_labels):
+            loss = self._forward_step(mi, ml)
+            scaled = loss
+            if scaler is not None:
+                scaled = scaler.scale(loss)
+            div = apply_scale(scaled, 1.0 / self.accumulate_steps)
+            self._backward_step(div)
+            self.total_loss = (loss if self.total_loss is None else
+                               Tensor(self.total_loss._data + loss._data))
+        return Tensor(self.total_loss._data / self.accumulate_steps)
+
+    def train_batch(self, data, optimizer=None, lr_scheduler=None,
+                    scaler=None):
+        """ref: pipeline_parallel.py:820."""
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if optimizer is not None:
+            if scaler is not None:
+                scaler.step(optimizer)
+                scaler.update()
+            else:
+                optimizer.step()
+            optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss: bool = True):
+        self._layers.eval()
+        inputs, labels = data if isinstance(data, (tuple, list)) and \
+            len(data) == 2 else (data, None)
+        out = self._layers(inputs)
+        if compute_loss and self._layers._loss_fn is not None:
+            return self._layers._loss_fn(out, labels)
+        return out
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+def apply_scale(loss: Tensor, factor: float) -> Tensor:
+    from ...core.autograd import apply_op
+    return apply_op(lambda x: x * factor, loss, op_name="scale")

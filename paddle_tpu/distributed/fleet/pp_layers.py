@@ -1,0 +1,145 @@
+"""Pipeline-parallel layer description and segmentation.
+
+ref: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py:56 (LayerDesc), :92 (SharedLayerDesc), :257 (PipelineLayer —
+segmentation of a layer list into stages). The segmentation math is
+hardware-agnostic and ports as semantics; on TPU each stage's chunk is a
+separately jit-compiled program and activations cross stages over ICI
+send/recv (or, in single-controller SPMD mode, the whole pipeline lives in
+one program and the stage dim is a mesh axis).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ...nn.layer import Layer
+from ...nn.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """ref: pp_layers.py:56 — lazy layer constructor so only the owning
+    stage materializes parameters."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """ref: pp_layers.py:92 — layer shared between stages (e.g. tied
+    embedding/lm-head); grads for shared params are allreduced over the
+    owning stages' comm group."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+def _uniform_partition(num_items: int, num_parts: int) -> List[int]:
+    """ref: pp_layers.py segment_uniform — bounds[i] is first index of part i."""
+    base = num_items // num_parts
+    extra = num_items % num_parts
+    bounds = [0]
+    for i in range(num_parts):
+        bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+    return bounds
+
+
+class PipelineLayer(Layer):
+    """ref: pp_layers.py:257 — takes a flat list of LayerDesc/Layer/callable,
+    segments into num_stages parts, builds only this stage's segment."""
+
+    def __init__(self, layers, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval: int = 0, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+
+        if topology is not None:
+            self._num_stages = topology.get_dim("pipe") if hasattr(
+                topology, "get_dim") else num_stages
+            self._stage_id = 0
+        else:
+            self._num_stages = num_stages or 1
+            self._stage_id = 0
+
+        from .fleet import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and hcg.get_pipe_parallel_world_size() > 1:
+            self._num_stages = hcg.get_pipe_parallel_world_size()
+            self._stage_id = hcg.get_stage_id()
+
+        n = len(self._layers_desc)
+        self.segment_parts = _uniform_partition(n, self._num_stages)
+        self._start = self.segment_parts[self._stage_id]
+        self._end = self.segment_parts[self._stage_id + 1]
+
+        self.run_function: List = []
+        built = []
+        self.shared_layers = {}
+        for i in range(self._start, self._end):
+            desc = self._layers_desc[i]
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in self.shared_layers:
+                    self.shared_layers[desc.layer_name] = desc.build_layer()
+                layer = self.shared_layers[desc.layer_name]
+                if desc.forward_func is not None:
+                    fwd = desc.forward_func
+                    self.run_function.append(
+                        lambda x, _l=layer, _f=fwd: _f(_l, x))
+                else:
+                    self.run_function.append(layer)
+                built.append(layer)
+            elif isinstance(desc, LayerDesc):
+                layer = desc.build_layer()
+                self.run_function.append(layer)
+                built.append(layer)
+            elif isinstance(desc, Layer):
+                self.run_function.append(desc)
+                built.append(desc)
+            elif callable(desc):
+                self.run_function.append(desc)
+            else:
+                raise TypeError(f"bad pipeline item {desc!r}")
+        self._stage_layers = LayerList(built)
+
+    # -- accessors ----------------------------------------------------------
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_stage_id(self):
+        return self._stage_id
+
+    @property
+    def parameters_in_stage(self):
+        return self.parameters()
+
+    def forward(self, input):
+        x = input
+        for fn in self.run_function:
+            x = fn(x)
+        return x
+
+    def forward_segment(self, x, start: int, end: int):
+        for fn in self.run_function[start:end]:
+            x = fn(x)
+        return x

@@ -1,0 +1,43 @@
+"""TensorParallel model wrapper.
+
+ref: python/paddle/distributed/fleet/meta_parallel/tensor_parallel.py —
+broadcasts non-mp params within the mp group at wrap time and syncs
+gradients of sequence-parallel params. Single-controller TPU: parameters
+are logically global (replicated or mp-sharded jax.Arrays), so broadcast
+is structural, not a comm.
+"""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ..collective import broadcast
+from ..parallel import get_world_size
+
+__all__ = ["TensorParallel"]
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers: Layer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        if get_world_size() > 1:
+            src = hcg.get_model_parallel_group_src_rank()
+            group = hcg.get_model_parallel_group()
+            for p in layers.parameters():
+                if getattr(p, "_dist_attr", None) is None:
+                    broadcast(p, src=src, group=group)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._layers, name)

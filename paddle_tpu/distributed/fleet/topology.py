@@ -1,0 +1,198 @@
+"""Hybrid-parallel communication topology.
+
+ref: python/paddle/distributed/fleet/base/topology.py:70 (CommunicateTopology)
+and :189 (HybridCommunicateGroup): a product topology over the axes
+[dp, pp, sharding, sep, mp] with per-axis communicator groups. The math is
+hardware-agnostic and ports directly; on TPU the per-axis "comm groups"
+double as named mesh axes — get_mesh() returns the jax-backed ProcessMesh
+whose axis names carry pjit collectives over ICI.
+"""
+from __future__ import annotations
+
+import itertools
+from functools import reduce
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..collective import Group, new_group
+from ..process_mesh import ProcessMesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+_HYBRID_PARALLEL_ORDER = ["dp", "pp", "sharding", "sep", "mp"]
+
+
+class CommunicateTopology:
+    """ref: topology.py:70 — rank <-> coordinate bookkeeping on a dense
+    cartesian product of parallel axes."""
+
+    def __init__(self, hybrid_group_names: Optional[List[str]] = None,
+                 dims: Optional[List[int]] = None):
+        self._parallel_names = list(hybrid_group_names or _HYBRID_PARALLEL_ORDER)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self.coordinate = list(itertools.product(
+            *(range(d) for d in self._dims)))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+        self._rank2coord = {i: c for i, c in enumerate(self.coordinate)}
+        self._world = np.arange(self.world_size()).reshape(self._dims)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(reduce(lambda a, b: a * b, self._dims, 1))
+
+    def get_rank(self, **args) -> int:
+        coord = tuple(args[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on axis_name equals index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items()
+                      if c[axis] == index)
+
+    def get_dim_num(self, axis_name: str) -> int:
+        return self.get_dim(axis_name)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """ref: topology.py get_comm_list — for each combination of the other
+        axes, the rank list varying along axis_name (one comm ring each)."""
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        comm_list = []
+        for other_coord in itertools.product(*(range(d) for d in other_dims)):
+            ranks = []
+            for i in range(self._dims[axis]):
+                coord = list(other_coord)
+                coord.insert(axis, i)
+                ranks.append(self._coord2rank[tuple(coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = list(self.get_coord(global_rank))
+        for name, val in kwargs.items():
+            coord[self._parallel_names.index(name)] = val
+        return self._coord2rank[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """ref: topology.py:189 — builds per-axis groups (dp/mp/pp/sharding/sep)
+    plus fused groups (e.g. dp+sep for gradient sync) and exposes
+    rank/degree accessors used throughout fleet."""
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.nranks = topology.world_size()
+
+        self._dp_degree = topology.get_dim("dp")
+        self._mp_degree = topology.get_dim("mp")
+        self._pp_degree = topology.get_dim("pp")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = (topology.get_dim("sep")
+                            if "sep" in topology.get_hybrid_group_names() else 1)
+
+        self._groups: Dict[str, Group] = {}
+        self._group_ranks: Dict[str, List[int]] = {}
+        for axis in topology.get_hybrid_group_names():
+            self._groups[axis], self._group_ranks[axis] = \
+                self._build_group(axis)
+
+        # fused data-parallel group (dp+sep behave DP-like for grads;
+        # ref: topology.py _set_p2p_prev_next + hybrid_parallel_util.py:265)
+        self._dp_sep_group = self._groups["dp"]
+
+    def _build_group(self, axis_name: str):
+        comm_lists = self._topo.get_comm_list(axis_name)
+        my_ranks = next(rl for rl in comm_lists if self.global_rank in rl)
+        return new_group(my_ranks), my_ranks
+
+    # -- degree / rank accessors (ref: topology.py:220-292) -----------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def _axis_rank(self, axis):
+        coord = self._topo.get_coord(self.global_rank)
+        return coord[self._topo.get_hybrid_group_names().index(axis)]
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("dp")
+
+    def get_model_parallel_rank(self):
+        return self._axis_rank("mp")
+
+    def get_stage_id(self):
+        return self._axis_rank("pp")
+
+    get_pipe_parallel_rank = get_stage_id
+
+    def get_sharding_parallel_rank(self):
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._axis_rank("sep")
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups.get("sep", self._groups["dp"])
+
+    def get_data_parallel_group_src_rank(self):
+        return self._group_ranks["dp"][0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._group_ranks["mp"][0]
+
+    def get_p2p_groups(self):
+        return None
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # -- TPU-native bridge ---------------------------------------------------
+    def get_mesh(self) -> ProcessMesh:
+        """The whole hybrid topology as one named device mesh — the idiomatic
+        TPU form: every per-axis comm group above is a named axis here."""
+        names = self._topo.get_hybrid_group_names()
+        dims = [self._topo.get_dim(n) for n in names]
+        keep = [i for i, d in enumerate(dims) if d > 1] or [0]
+        shape = [dims[i] for i in keep]
+        kept_names = [names[i] for i in keep]
+        n = int(np.prod(shape))
+        return ProcessMesh(np.arange(n).reshape(shape), kept_names)

@@ -1,0 +1,133 @@
+"""Parallel environment bootstrap + DataParallel.
+
+ref: python/paddle/distributed/parallel.py:978 (init_parallel_env),
+:396-419 (DataParallel over EagerReducer bucketed allreduce,
+ref: paddle/fluid/distributed/collective/reducer.cc). TPU-native:
+bootstrap is jax.distributed.initialize (PJRT coordination service plays
+the TCPStore role, ref: phi/core/distributed/store/tcp_store.h:121);
+DataParallel's gradient sync is an allreduce over the dp group after
+backward — on a single controller the preferred path is instead batch
+sharding via shard_tensor/pjit, which needs no wrapper at all.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .collective import Group, ReduceOp, _ensure_default_group, all_reduce
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "ParallelEnv", "DataParallel",
+]
+
+_initialized = False
+
+
+def init_parallel_env() -> Group:
+    """ref: parallel.py:978. Reads PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+    PADDLE_MASTER (set by paddle_tpu.distributed.launch) and brings up the
+    JAX distributed runtime; single-process when unset."""
+    global _initialized
+    if _initialized:
+        return _ensure_default_group()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    master = os.environ.get("PADDLE_MASTER",
+                            os.environ.get("MASTER_ADDR", ""))
+    if nranks > 1 and jax.process_count() == 1:
+        port = os.environ.get("MASTER_PORT", "")
+        addr = master if ":" in master or not port else f"{master}:{port}"
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=nranks, process_id=rank)
+    _initialized = True
+    return _ensure_default_group()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.nranks
+    return max(jax.process_count(),
+               int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+
+
+class ParallelEnv:
+    """ref: parallel.py ParallelEnv (env introspection object)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", "0"))
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+class DataParallel(Layer):
+    """ref: parallel.py:396 DataParallel. Gradient allreduce over the dp
+    group after backward; bucketing (EagerReducer, reducer.cc) is left to
+    XLA's collective combiner when the step is jitted — eager path does a
+    straight per-param allreduce on apply_collective_grads."""
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group: Optional[Group] = None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+        init_parallel_env()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def apply_collective_grads(self):
+        """ref: hybrid_parallel_util.py fused_allreduce_gradients."""
+        n = get_world_size(self._group)
+        if n <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, ReduceOp.SUM, self._group)
+                p.grad._data = p.grad._data / n
+
+    def scale_loss(self, loss):
+        return loss
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._layers, name)

@@ -1,0 +1,77 @@
+"""Placement types for the semi-auto-parallel (DTensor) API.
+
+ref: paddle/phi/core/distributed/auto_parallel/placement_types.h:68,108,132
+(Shard / Replicate / Partial). On TPU these map onto jax.sharding
+PartitionSpec entries: Shard(d) puts a mesh axis on tensor dim d,
+Replicate leaves the axis unused, Partial marks a pending cross-axis
+reduction (tracked framework-side; XLA's NamedSharding has no native
+partial, so reshard materializes it with a psum).
+"""
+from __future__ import annotations
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Partial(Placement):
+    """Pending reduction over a mesh axis (ref: placement_types.h:132).
+
+    reduce_type: 'sum' | 'avg' | 'max' | 'min' (ReduceType subset).
+    """
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))

@@ -1,0 +1,118 @@
+"""ProcessMesh: named cartesian topology of devices.
+
+ref: paddle/phi/core/distributed/auto_parallel/process_mesh.h:34 and
+python/paddle/distributed/auto_parallel/process_mesh.py. TPU-native: a thin
+veneer over jax.sharding.Mesh — process ids are flattened device indices into
+jax.devices(); the named dims become jax mesh axis names that pjit/shard_map
+collectives ride over ICI.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_g_default_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 shape=None, process_ids=None):
+        if shape is not None and process_ids is not None:
+            arr = np.asarray(process_ids, dtype=np.int64).reshape(shape)
+        else:
+            arr = np.asarray(mesh, dtype=np.int64)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {arr.ndim}")
+        self._mesh = arr
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+        global _g_default_mesh
+        if _g_default_mesh is None:
+            _g_default_mesh = self
+
+    # -- reference-parity accessors -----------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(i) for i in self._mesh.flatten()]
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Reorder so dim_name is leading; optionally slice one coordinate."""
+        axis = self._dim_names.index(dim_name)
+        order = [axis] + [i for i in range(self.ndim) if i != axis]
+        new_names = [self._dim_names[i] for i in order]
+        new_mesh = self._mesh.transpose(order)
+        if index is not None:
+            return ProcessMesh(new_mesh[index], new_names[1:] or ["d0"])
+        return ProcessMesh(new_mesh, new_names)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._dim_names == other._dim_names
+                and np.array_equal(self._mesh, other._mesh))
+
+    def __hash__(self):
+        return hash((tuple(self._dim_names), self._mesh.tobytes()))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names},"
+                f" process_ids={self.process_ids})")
+
+    # -- TPU-native bridge ---------------------------------------------------
+    def to_jax_mesh(self) -> Mesh:
+        """Materialize as a jax.sharding.Mesh over the runtime's devices."""
+        if self._jax_mesh is None:
+            devices = np.asarray(jax.devices(), dtype=object)
+            if self._mesh.size > devices.size:
+                raise RuntimeError(
+                    f"ProcessMesh needs {self._mesh.size} devices but the "
+                    f"runtime exposes {devices.size}")
+            dev_grid = np.empty(self._mesh.shape, dtype=object)
+            for idx, pid in np.ndenumerate(self._mesh):
+                dev_grid[idx] = devices[int(pid)]
+            self._jax_mesh = Mesh(dev_grid, axis_names=tuple(self._dim_names))
+        return self._jax_mesh
+
+
+def get_default_mesh() -> Optional[ProcessMesh]:
+    return _g_default_mesh
+
+
+def set_default_mesh(mesh: ProcessMesh):
+    global _g_default_mesh
+    _g_default_mesh = mesh
+
+
+def init_process_mesh(shape: Sequence[int], dim_names: List[str]) -> ProcessMesh:
+    """Build a mesh over all visible devices in default order."""
+    n = int(np.prod(shape))
+    return ProcessMesh(np.arange(n).reshape(shape), dim_names)

@@ -98,13 +98,21 @@ class DataLoader:
         sentinel = object()
 
         def producer():
+            # submit lazily: at most queue-capacity + workers batches in
+            # flight, so a slow consumer can't accumulate the whole epoch
             try:
                 with ThreadPoolExecutor(self.num_workers) as pool:
                     def fetch(idx_batch):
                         samples = [self.dataset[i] for i in idx_batch]
                         return self.collate_fn(samples)
-                    for out in pool.map(fetch, iter(self.batch_sampler)):
-                        q.put(out)
+                    pending = []
+                    it = iter(self.batch_sampler)
+                    for idx_batch in it:
+                        pending.append(pool.submit(fetch, idx_batch))
+                        if len(pending) >= self.num_workers:
+                            q.put(pending.pop(0).result())
+                    for fut in pending:
+                        q.put(fut.result())
             finally:
                 q.put(sentinel)
 

@@ -13,13 +13,15 @@ class Sequential(Layer):
         if len(layers) == 1 and isinstance(layers[0], OrderedDict):
             for name, layer in layers[0].items():
                 self.add_sublayer(name, layer)
-        elif len(layers) and isinstance(layers[0], (list, tuple)) and \
-                len(layers[0]) and isinstance(layers[0][0], tuple):
-            for name, layer in layers:
-                self.add_sublayer(name, layer)
-        else:
-            for i, layer in enumerate(layers):
-                self.add_sublayer(str(i), layer)
+            return
+        if len(layers) == 1 and isinstance(layers[0], list):
+            layers = tuple(layers[0])
+        for i, item in enumerate(layers):
+            if isinstance(item, tuple) and len(item) == 2 and \
+                    isinstance(item[0], str):
+                self.add_sublayer(item[0], item[1])
+            else:
+                self.add_sublayer(str(i), item)
 
     def __getitem__(self, idx):
         if isinstance(idx, slice):

@@ -14,10 +14,11 @@ import jax.numpy as jnp
 
 from ...core.autograd import apply_op
 from ...core.tensor import Tensor
+from ...core import random as random_mod
 
 
-def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False,
-                    scale=None):
+def _sdpa_reference(q, k, v, mask=None, causal=False, scale=None,
+                    dropout_p=0.0, dropout_key=None):
     # q,k,v: [B, L, H, D] (paddle flash-attention layout)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -32,6 +33,10 @@ def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False,
     if mask is not None:
         logits = logits + mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)  # back to [B, L, H, D]
 
@@ -40,17 +45,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
     """Layout [batch, seq, heads, head_dim], matching the reference API."""
-    use_flash = _should_use_flash(query)
     md = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+    drop = dropout_p if training else 0.0
 
-    if use_flash and md is None:
+    if _should_use_flash(query) and md is None and drop == 0.0:
         from ...ops.pallas.flash_attention import flash_attention_fwd
         return apply_op(
             lambda q, k, v: flash_attention_fwd(q, k, v, causal=is_causal),
             query, key, value, op_name="flash_attention")
 
+    dropout_key = random_mod.next_key() if drop > 0.0 else None
+
     def f(q, k, v):
-        return _sdpa_reference(q, k, v, mask=md, causal=is_causal)
+        return _sdpa_reference(q, k, v, mask=md, causal=is_causal,
+                               dropout_p=drop, dropout_key=dropout_key)
     return apply_op(f, query, key, value, op_name="sdpa")
 
 
@@ -58,11 +66,9 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None,
                     rng_name="", training=True, name=None):
     """ref: nn/functional/flash_attention.py flash_attention — same
-    signature; returns (out, softmax_lse-like None) tuple for parity."""
+    signature; returns (out, softmax-or-None) tuple for parity."""
     out = scaled_dot_product_attention(query, key, value, None, dropout,
                                        causal, training)
-    if return_softmax:
-        return out, None
     return out, None
 
 

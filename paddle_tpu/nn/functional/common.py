@@ -24,7 +24,12 @@ def linear(x, weight, bias=None, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     if not training or p == 0.0:
-        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        if training or mode == "upscale_in_train" or p == 0.0:
+            return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        # downscale_in_infer: train applies the raw mask, so eval scales
+        # by the keep probability (ref: common.py dropout mode semantics)
+        return apply_op(lambda a: (a * (1.0 - p)).astype(a.dtype), x,
+                        op_name="dropout")
     if p == 1.0:
         return apply_op(lambda a: jnp.zeros_like(a), x, op_name="dropout")
     key = random_mod.next_key()

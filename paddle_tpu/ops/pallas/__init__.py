@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the hand-written hot ops.
+
+The analog of the reference's fused kernel zoo (ref: paddle/phi/kernels/
+fusion/, 90k LoC CUDA/CUTLASS): flash attention, fused RoPE, fused
+layernorm. Each module exposes a jittable function with a custom_vjp and a
+pure-XLA fallback for non-TPU backends (used by the CPU test mesh).
+"""
+from . import flash_attention  # noqa: F401

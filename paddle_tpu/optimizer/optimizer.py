@@ -83,6 +83,7 @@ class Optimizer:
         for p, g in params_grads:
             gd = g._data if isinstance(g, Tensor) else g
             state = self._state_for(p)
+            self._cur_param = p  # lets _update consult Parameter metadata
             new_p, new_state = self._update(p._data, gd, state, lr)
             p._data = new_p
             self._states[id(p)] = new_state
@@ -358,7 +359,11 @@ class Lamb(Optimizer):
         b1p = state["beta1_pow"] * b1
         b2p = state["beta2_pow"] * b2
         r = (m1 / (1 - b1p)) / (jnp.sqrt(m2 / (1 - b2p)) + eps)
-        r = r + self._weight_decay * p32
+        wd = self._weight_decay
+        if self._exclude_fn is not None and \
+                self._exclude_fn(getattr(self, "_cur_param", None)):
+            wd = 0.0
+        r = r + wd * p32
         w_norm = jnp.linalg.norm(p32)
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)

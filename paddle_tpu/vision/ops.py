@@ -1,0 +1,92 @@
+"""Vision ops: roi_align, nms, box utils.
+
+ref: python/paddle/vision/ops.py (roi_align, nms, deform_conv2d...).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.autograd import apply_op
+
+__all__ = ["nms", "box_coder", "roi_align"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """ref: vision/ops.py nms. Host-side implementation (data-dependent
+    output size is inherently host logic on TPU)."""
+    b = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    s = (np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+         if scores is not None else np.arange(len(b), 0, -1, dtype=np.float32))
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), dtype=bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i_ in order:
+        if suppressed[i_]:
+            continue
+        keep.append(int(i_))
+        xx1 = np.maximum(b[i_, 0], b[:, 0])
+        yy1 = np.maximum(b[i_, 1], b[:, 1])
+        xx2 = np.minimum(b[i_, 2], b[:, 2])
+        yy2 = np.minimum(b[i_, 3], b[:, 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        iou = inter / (areas[i_] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i_] = True
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(np.asarray(keep, dtype=np.int64)))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    raise NotImplementedError("box_coder lands with the detection suite")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """ref: vision/ops.py roi_align — average-pool ROI crops; static-shape
+    friendly bilinear sampling."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bxs):
+        n_rois = bxs.shape[0]
+        c = feat.shape[1]
+        h, w = feat.shape[2], feat.shape[3]
+        off = 0.5 if aligned else 0.0
+        x1 = bxs[:, 0] * spatial_scale - off
+        y1 = bxs[:, 1] * spatial_scale - off
+        x2 = bxs[:, 2] * spatial_scale - off
+        y2 = bxs[:, 3] * spatial_scale - off
+        bin_h = (y2 - y1) / oh
+        bin_w = (x2 - x1) / ow
+        ys = y1[:, None] + (jnp.arange(oh) + 0.5) * bin_h[:, None]
+        xs = x1[:, None] + (jnp.arange(ow) + 0.5) * bin_w[:, None]
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(ys, 0, h - 1) - y0
+        wx = jnp.clip(xs, 0, w - 1) - x0
+        img = feat[0]  # single image per batch of rois (batch handled by boxes_num upstream)
+        def gather(yi, xi):
+            return img[:, yi][:, :, xi]  # [c, n, oh, n, ow] -> careful
+        # vectorized bilinear: [n_rois, c, oh, ow]
+        f00 = img[:, y0[:, :, None], x0[:, None, :]]
+        f01 = img[:, y0[:, :, None], x1i[:, None, :]]
+        f10 = img[:, y1i[:, :, None], x0[:, None, :]]
+        f11 = img[:, y1i[:, :, None], x1i[:, None, :]]
+        wy_ = wy[:, :, None][None]
+        wx_ = wx[:, None, :][None]
+        out = (f00 * (1 - wy_) * (1 - wx_) + f01 * (1 - wy_) * wx_
+               + f10 * wy_ * (1 - wx_) + f11 * wy_ * wx_)
+        return jnp.transpose(out, (1, 0, 2, 3))
+
+    return apply_op(f, x, boxes, op_name="roi_align")
