@@ -16,8 +16,14 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# The image ships a plugin that force-prepends the "axon" TPU platform to
+# jax_platforms regardless of JAX_PLATFORMS; override after import so
+# jax.devices() resolves to the 8 virtual CPU devices.
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture
