@@ -24,7 +24,7 @@ from .process_mesh import ProcessMesh
 
 __all__ = [
     "DistAttr", "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
-    "unshard_dtensor", "placements_to_spec",
+    "unshard_dtensor", "placements_to_spec", "shard_parameter",
 ]
 
 
@@ -165,6 +165,35 @@ def shard_layer(layer, process_mesh: ProcessMesh,
         layer.register_forward_post_hook(
             lambda _layer, inputs, outputs: output_fn(outputs, process_mesh))
     return layer
+
+
+def shard_parameter(param, mesh: ProcessMesh, tp_axis: Optional[str] = None,
+                    fsdp_axis: Optional[str] = None,
+                    tp_dim: Optional[int] = None,
+                    fsdp_dim: Optional[int] = None) -> None:
+    """In-place tp/fsdp placement for one parameter — the shared placement
+    algebra behind the model zoo's shard_* rule tables (ref: the per-weight
+    shard_tensor calls in semi_auto_parallel_llama_model.py).
+
+    tp_dim shards on tp_axis (column=out dim, row=in dim for [in, out]
+    weights); fsdp_dim shards the remaining dim on fsdp_axis unless it
+    would collide with the tp split. Dims beyond the param's rank are
+    ignored.
+    """
+    axis_names = list(mesh.dim_names)
+    placements: List[Placement] = [Replicate() for _ in axis_names]
+    ndim = param._data.ndim
+    if tp_axis in axis_names and tp_dim is not None and tp_dim < ndim:
+        placements[axis_names.index(tp_axis)] = Shard(tp_dim)
+    else:
+        tp_dim = None
+    if (fsdp_axis in axis_names and fsdp_dim is not None
+            and fsdp_dim < ndim and fsdp_dim != tp_dim):
+        placements[axis_names.index(fsdp_axis)] = Shard(fsdp_dim)
+    sharded = shard_tensor(param, mesh, placements,
+                           stop_gradient=param.stop_gradient)
+    param._data = sharded._data
+    param._dist_attr = sharded._dist_attr
 
 
 def unshard_dtensor(t: Tensor) -> Tensor:

@@ -1,0 +1,129 @@
+"""Sharded whole-training-step compiler.
+
+The TPU-native replacement for the reference's hybrid-parallel training
+machinery (ref: fleet/meta_parallel/* + auto_parallel/static/engine.py:100):
+parameters carry NamedShardings (attached by shard_llama / shard_tensor),
+and ONE jax.jit of loss-fwd + backward + optimizer-update compiles the
+whole dp x fsdp x tp program — XLA GSPMD inserts the ICI collectives the
+reference issues manually through ProcessGroupNCCL (all-gather for ZeRO-3
+param shards, reduce-scatter of grads, allreduce over dp). Optimizer state
+inherits each parameter's sharding, which *is* sharding stage-1/2/3
+depending on the placement rules used.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..jit.api import _Swap, functionalize
+
+__all__ = ["DistTrainStep"]
+
+
+class DistTrainStep:
+    """Compiled train step over (possibly sharded) params.
+
+    loss_fn(outputs, *labels) -> scalar Tensor. Batch arrays should be
+    device_put with their data sharding (Shard(0) on the dp axis) before the
+    call — or pass `data_sharding` to have the step do it.
+    """
+
+    def __init__(self, model, loss_fn: Callable, optimizer,
+                 data_sharding=None, donate: bool = True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.data_sharding = data_sharding
+        self._swap = _Swap(model)
+        self._params = self._swap.params
+        self._opt_state = None
+        self._jitted = None
+        self._donate = donate
+
+    def _init_opt_state(self):
+        """Optimizer state co-sharded with its parameter — the ZeRO contract
+        (ref: dygraph_sharding_optimizer.py partitions state by param
+        ownership; here ownership = the param's own placement)."""
+        state = {}
+        for k, p in self._params.items():
+            if p.stop_gradient:
+                continue
+            s = self.optimizer._init_state(p)
+            arr = p._data
+            if hasattr(arr, "sharding"):
+                s = {
+                    name: jax.device_put(v, arr.sharding)
+                    if getattr(v, "shape", None) == arr.shape else v
+                    for name, v in s.items()
+                }
+            state[k] = s
+        return state
+
+    def _build(self):
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        swap = self._swap
+        trainable = {k for k, p in self._params.items()
+                     if not p.stop_gradient}
+
+        def step_fn(params, buffers, opt_state, lr, key, batch, labels):
+            train_p = {k: v for k, v in params.items() if k in trainable}
+            frozen_p = {k: v for k, v in params.items()
+                        if k not in trainable}
+
+            def loss_of(tp):
+                full = {**tp, **frozen_p}
+                from ..core.autograd import no_grad
+                with no_grad(), random_mod.key_stream(key):
+                    out, new_buffers = swap.run(
+                        full, buffers, model.__call__,
+                        *[Tensor(b) for b in batch])
+                    loss_t = loss_fn(out, *[Tensor(x) for x in labels])
+                return loss_t._data.astype(jnp.float32), new_buffers
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_p)
+            new_params = dict(params)
+            new_opt = dict(opt_state)
+            for k in trainable:
+                new_p, new_s = opt._update(params[k], grads[k],
+                                           opt_state[k], lr)
+                new_params[k] = new_p
+                new_opt[k] = new_s
+            return loss, new_params, new_buffers, new_opt
+
+        donate = (0, 2) if self._donate else ()
+        self._jitted = jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, *batch_and_labels, num_labels: int = 1):
+        if self._jitted is None:
+            self._build()
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        raw = [b._data if isinstance(b, Tensor) else jnp.asarray(
+            np.asarray(b)) for b in batch_and_labels]
+        if self.data_sharding is not None:
+            raw = [jax.device_put(r, self.data_sharding) for r in raw]
+        if len(raw) <= num_labels:
+            raise ValueError(
+                f"need at least {num_labels + 1} arrays (inputs + "
+                f"{num_labels} labels), got {len(raw)}")
+        batch = tuple(raw[:len(raw) - num_labels])
+        labels = tuple(raw[len(raw) - num_labels:]) if num_labels else ()
+        params = {k: t._data for k, t in self._params.items()}
+        buffers = {k: t._data for k, t in self._swap.buffers.items()}
+        lr = jnp.float32(self.optimizer.get_lr())
+        key = random_mod.next_key()
+        loss, new_params, new_buffers, new_opt = self._jitted(
+            params, buffers, self._opt_state, lr, key, batch, labels)
+        for k, t in self._params.items():
+            t._data = new_params[k]
+        for k, t in self._swap.buffers.items():
+            t._data = new_buffers[k]
+        self._opt_state = new_opt
+        self.optimizer._global_step += 1
+        return Tensor(loss)

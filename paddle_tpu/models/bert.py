@@ -1,0 +1,105 @@
+"""BERT family (encoder + MLM head) — BASELINE.md workload 2.
+
+ref: the reference's BERT path is paddle.nn.TransformerEncoder assembled by
+user code (docs + test/book); here the encoder reuses
+paddle_tpu.nn.TransformerEncoder so the benchmark exercises the same layer
+stack a reference user would. Whole-model jit gives the "static graph +
+fusion" execution the reference gets from to_static + CINN.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout, Embedding, Linear
+from ..nn.layers_conv_norm import LayerNorm
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM"]
+
+
+@dataclass
+class BertConfig:
+    """Defaults = BERT-base."""
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.1
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return BertConfig(**base)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size,
+                                         weight_attr=I.Normal(0.0, 0.02))
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=I.Normal(0.0, 0.02))
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size,
+                                               weight_attr=I.Normal(0.0, 0.02))
+        self.layer_norm = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        l = input_ids.shape[1]
+        pos = Tensor(jnp.arange(l, dtype=jnp.int32)[None, :])
+        h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            h = h + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(h))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.dropout,
+            activation="gelu", layer_norm_eps=config.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer,
+                                          config.num_hidden_layers)
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids)
+        h = self.encoder(h, attention_mask)
+        pooled = F.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        config.layer_norm_eps)
+        self.decoder = Linear(config.hidden_size, config.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(h)))
+        return self.decoder(h)

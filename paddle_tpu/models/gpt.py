@@ -1,0 +1,141 @@
+"""GPT family (GPT-2/3-style decoder) — BASELINE.md workload 4.
+
+ref: the reference ships GPT through its fleet hybrid examples
+(test/collective/fleet/hybrid_parallel_*), architecture = pre-LN causal
+transformer with learned positions. Shares the placement-rule design of
+llama.shard_llama for hybrid TP x FSDP meshes.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout, Embedding, Linear
+from ..nn.layers_conv_norm import LayerNorm
+
+__all__ = ["GPTConfig", "GPTForCausalLM", "shard_gpt"]
+
+
+@dataclass
+class GPTConfig:
+    """Defaults approximate GPT-3 13B per-layer geometry scaled down; use
+    `GPTConfig(hidden_size=5120, num_hidden_layers=40, num_attention_heads=40)`
+    for the 13B benchmark config."""
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None    # default 4*hidden
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.0
+    use_flash_attention: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=128)
+        base.update(kw)
+        return GPTConfig(**base)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.hidden_size = config.hidden_size
+        self.use_flash = config.use_flash_attention
+        self.qkv_proj = Linear(config.hidden_size, 3 * config.hidden_size)
+        self.out_proj = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, h):
+        b, l, _ = h.shape
+        qkv = self.qkv_proj(h)
+
+        def attn(qkv_arr):
+            q, k, v = jnp.split(qkv_arr, 3, axis=-1)
+            q = q.reshape(b, l, self.num_heads, self.head_dim)
+            k = k.reshape(b, l, self.num_heads, self.head_dim)
+            v = v.reshape(b, l, self.num_heads, self.head_dim)
+            from ..ops.pallas.flash_attention import (_sdpa_xla,
+                                                      flash_attention)
+            if self.use_flash:
+                out = flash_attention(q, k, v, True, None)
+            else:
+                out = _sdpa_xla(q, k, v, causal=True)
+            return out.reshape(b, l, self.hidden_size)
+
+        return self.out_proj(apply_op(attn, qkv, op_name="gpt_attention"))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.fc_in = Linear(config.hidden_size, config.intermediate_size)
+        self.fc_out = Linear(config.intermediate_size, config.hidden_size)
+        self.drop = Dropout(config.dropout)
+
+    def forward(self, h):
+        h = h + self.attn(self.ln_1(h))
+        h = h + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(h)))))
+        return h
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=I.Normal(0.0, 0.02))
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size,
+                             weight_attr=I.Normal(0.0, 0.02))
+        self.blocks = LayerList([GPTBlock(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              bias_attr=False)
+
+    def forward(self, input_ids):
+        l = input_ids.shape[1]
+        pos = Tensor(jnp.arange(l, dtype=jnp.int32)[None, :])
+        h = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.blocks:
+            h = blk(h)
+        return self.lm_head(self.ln_f(h))
+
+
+def shard_gpt(model: GPTForCausalLM, mesh, tp_axis="mp", fsdp_axis=None):
+    """Placement rules for GPT: qkv/fc_in column-parallel, out_proj/fc_out
+    row-parallel (same algebra as shard_llama)."""
+    from ..distributed.api import shard_parameter
+
+    for name, p in model.named_parameters():
+        if p is None:
+            continue
+        if any(s in name for s in ("qkv_proj", "fc_in", "lm_head", "wte")):
+            tp_dim, fsdp_dim = (1, 0) if p._data.ndim > 1 else (0, None)
+        elif any(s in name for s in ("out_proj", "fc_out")):
+            tp_dim, fsdp_dim = (0, 1) if p._data.ndim > 1 else (None, 0)
+        else:
+            tp_dim, fsdp_dim = None, None
+        shard_parameter(p, mesh, tp_axis, fsdp_axis, tp_dim, fsdp_dim)
+    return model

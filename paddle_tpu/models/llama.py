@@ -1,0 +1,361 @@
+"""Llama family: the flagship LM for the framework's headline benchmark.
+
+ref: test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py
+(LlamaAttention/LlamaMLP/LlamaRMSNorm/LlamaForCausalLM and their
+shard_tensor placement choices), python/paddle/nn/functional/flash_attention.py
+(attention entry). TPU-native design: the decoder stack is ordinary Layer
+code; parallelism is *data placement* — `shard_llama` attaches
+NamedShardings (GSPMD) to the parameters and one `jax.jit` of the train
+step compiles the whole hybrid dp x fsdp x tp program with XLA
+collectives over ICI. RoPE/GQA/SwiGLU keep every matmul large and
+bfloat16-friendly for the MXU; attention rides the Pallas flash kernel.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import apply_op
+from ..nn import functional as F
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.layers_common import Embedding, Linear
+from ..nn.layers_conv_norm import RMSNorm
+from ..nn import initializer as I
+
+__all__ = [
+    "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+    "LlamaPretrainingCriterion", "shard_llama",
+]
+
+
+@dataclass
+class LlamaConfig:
+    """Defaults are Llama-2 7B (ref: semi_auto_llama.py model config)."""
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32          # < heads => GQA (Llama-2 70B / 3)
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    use_flash_attention: bool = True
+    sequence_parallel: bool = False        # shard activations on seq axis
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+    # recompute each decoder block in backward (ref: fleet recompute /
+    # paddle.distributed.fleet.utils.recompute) = jax.checkpoint
+    recompute: bool = False
+
+    @staticmethod
+    def tiny(**kw):
+        """Small config for tests / dry runs."""
+        base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=128)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def _rope_cos_sin(seq_len, head_dim, theta, dtype=jnp.float32,
+                  position_offset=0):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(position_offset, position_offset + seq_len,
+                     dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv_freq)              # [L, D/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def _apply_rope(x, cos, sin):
+    """x: [B, L, H, D] -> rotated. Pairs (x1, x2) are the two halves, the
+    Llama 'rotate_half' convention (ref: semi_auto_parallel_llama_model.py
+    apply_rotary_pos_emb)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+class LlamaAttention(Layer):
+    """GQA attention with RoPE; the sdpa is the Pallas flash kernel when
+    tiling allows (ref: LlamaAttention in semi_auto_parallel_llama_model.py)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.hidden_size = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        kv_out = self.num_kv_heads * self.head_dim
+        self.q_proj = Linear(self.hidden_size, self.hidden_size,
+                             bias_attr=False)
+        self.k_proj = Linear(self.hidden_size, kv_out, bias_attr=False)
+        self.v_proj = Linear(self.hidden_size, kv_out, bias_attr=False)
+        self.o_proj = Linear(self.hidden_size, self.hidden_size,
+                             bias_attr=False)
+
+    def forward(self, hidden_states, attention_mask=None, cache=None,
+                position_offset=0):
+        b, l, _ = hidden_states.shape
+        q = self.q_proj(hidden_states).reshape([b, l, self.num_heads,
+                                                self.head_dim])
+        k = self.k_proj(hidden_states).reshape([b, l, self.num_kv_heads,
+                                                self.head_dim])
+        v = self.v_proj(hidden_states).reshape([b, l, self.num_kv_heads,
+                                                self.head_dim])
+
+        # the whole rope+attend runs through apply_op so eager autograd
+        # records one fused node
+        cache_in = []
+        if cache is not None and cache[0] is not None:
+            cache_in = [cache[0], cache[1]]
+
+        def attn_impl(qa, ka, va, *cache_arrs):
+            cos, sin = _rope_cos_sin(l, self.head_dim,
+                                     self.config.rope_theta,
+                                     position_offset=position_offset)
+            qa = _apply_rope(qa, cos, sin)
+            ka = _apply_rope(ka, cos, sin)
+            if cache_arrs:
+                ka = jnp.concatenate([cache_arrs[0], ka], axis=1)
+                va = jnp.concatenate([cache_arrs[1], va], axis=1)
+            rep = self.num_heads // self.num_kv_heads
+            new_k, new_v = ka, va
+            if rep > 1:
+                ka = jnp.repeat(ka, rep, axis=2)
+                va = jnp.repeat(va, rep, axis=2)
+            from ..ops.pallas.flash_attention import (_sdpa_xla,
+                                                      flash_attention)
+            if (not cache_arrs and attention_mask is None
+                    and self.config.use_flash_attention):
+                out = flash_attention(qa, ka, va, True, None)
+            else:
+                # decode (Lq < Lk) and/or explicit-mask path
+                out = _sdpa_xla(qa, ka, va, causal=True,
+                                mask=attention_mask)
+            return out.reshape(b, l, self.hidden_size), new_k, new_v
+
+        if attention_mask is not None:
+            attention_mask = attention_mask._data if isinstance(
+                attention_mask, Tensor) else attention_mask
+        out, new_k, new_v = apply_op(
+            attn_impl, q, k, v, *cache_in, op_name="llama_attention")
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, (new_k, new_v)
+        return out
+
+
+class LlamaMLP(Layer):
+    """SwiGLU FFN (ref: LlamaMLP in semi_auto_parallel_llama_model.py)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.gate_proj = Linear(config.hidden_size, config.intermediate_size,
+                                bias_attr=False)
+        self.up_proj = Linear(config.hidden_size, config.intermediate_size,
+                              bias_attr=False)
+        self.down_proj = Linear(config.intermediate_size, config.hidden_size,
+                                bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+
+    def forward(self, hidden_states, attention_mask=None, cache=None,
+                position_offset=0):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        if cache is not None:
+            h, new_cache = self.self_attn(h, attention_mask, cache,
+                                          position_offset)
+        else:
+            h = self.self_attn(h, attention_mask, None, position_offset)
+        h = residual + h
+        residual = h
+        h = self.post_attention_layernorm(h)
+        h = self.mlp(h)
+        h = residual + h
+        if cache is not None:
+            return h, new_cache
+        return h
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size,
+                                      weight_attr=I.Normal(0.0, 0.02))
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, attention_mask=None, caches=None,
+                position_offset=0):
+        h = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            h = _seq_constraint(h)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            cache_i = caches[i] if caches is not None else None
+            if self.config.recompute and caches is None:
+                h = _remat_layer(layer, h, attention_mask, position_offset)
+            elif caches is not None:
+                h, c = layer(h, attention_mask, cache_i, position_offset)
+                new_caches.append(c)
+            else:
+                h = layer(h, attention_mask, None, position_offset)
+            if self.config.sequence_parallel:
+                h = _seq_constraint(h)
+        h = self.norm(h)
+        if caches is not None:
+            return h, new_caches
+        return h
+
+
+def _remat_layer(layer, h, attention_mask, position_offset):
+    """jax.checkpoint over one decoder block — the TPU-native recompute
+    (ref: paddle.distributed.fleet.utils.recompute). The layer's actual
+    Parameter objects are passed to apply_op so eager backward routes
+    gradients to them."""
+    params = [p for _, p in layer.named_parameters()]
+
+    def fn(h_arr, *param_arrs):
+        old = [p._data for p in params]
+        try:
+            for p, a in zip(params, param_arrs):
+                p._data = a
+            out = layer(Tensor(h_arr), attention_mask, None, position_offset)
+            return out._data
+        finally:
+            for p, o in zip(params, old):
+                p._data = o
+
+    return apply_op(jax.checkpoint(fn), h, *params,
+                    op_name="remat_decoder_layer")
+
+
+def _seq_constraint(h):
+    """Activation sharding constraint along the sequence axis ('sp' mesh
+    axis) — Megatron sequence parallel as pure placement
+    (ref: fleet/utils/sequence_parallel_utils.py)."""
+    def f(x):
+        try:
+            from jax.sharding import PartitionSpec as P
+            return jax.lax.with_sharding_constraint(
+                x, P(None, "sp", None))
+        except Exception:
+            return x
+    return apply_op(f, h, op_name="seq_parallel_constraint")
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def _logits(self, h):
+        if self.config.tie_word_embeddings:
+            # project through the transposed embedding table
+            return apply_op(lambda hh, w: hh @ w.T, h,
+                            self.llama.embed_tokens.weight,
+                            op_name="tied_lm_head")
+        return self.lm_head(h)
+
+    def forward(self, input_ids, attention_mask=None, caches=None,
+                position_offset=0):
+        out = self.llama(input_ids, attention_mask, caches, position_offset)
+        if caches is not None:
+            h, new_caches = out
+            return self._logits(h), new_caches
+        return self._logits(out)
+
+    def generate(self, input_ids, max_new_tokens=32):
+        """Greedy decode with per-layer KV caches (inference parity check,
+        not the serving path)."""
+        ids = input_ids
+        caches = [(None, None)] * self.config.num_hidden_layers
+        logits, caches = self.forward(ids, caches=caches)
+        for _ in range(max_new_tokens):
+            next_id = jnp.argmax(logits._data[:, -1, :], axis=-1)[:, None]
+            offset = caches[0][0]._data.shape[1] if isinstance(
+                caches[0][0], Tensor) else caches[0][0].shape[1]
+            ids = Tensor(jnp.concatenate([ids._data, next_id], axis=1))
+            logits, caches = self.forward(
+                Tensor(next_id), caches=caches, position_offset=offset)
+        return ids
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Causal-LM loss: shifted next-token cross entropy
+    (ref: LlamaPretrainingCriterion in semi_auto_parallel_llama_model.py)."""
+
+    def __init__(self, config: Optional[LlamaConfig] = None):
+        super().__init__()
+
+    def forward(self, logits, labels):
+        def f(lg, lb):
+            lg = lg[:, :-1, :].astype(jnp.float32)
+            lb = lb[:, 1:]
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            nll = -jnp.take_along_axis(logp, lb[..., None], axis=-1)
+            return jnp.mean(nll)
+        return apply_op(f, logits, labels, op_name="causal_lm_loss")
+
+
+# ---------------------------------------------------------------------------
+# Parallel placement rules (ref: the shard_tensor calls sprinkled through
+# semi_auto_parallel_llama_model.py, expressed here as one rule table).
+# ---------------------------------------------------------------------------
+
+def shard_llama(model: LlamaForCausalLM, mesh, tp_axis: Optional[str] = "mp",
+                fsdp_axis: Optional[str] = None):
+    """Attach NamedShardings to every parameter: tensor-parallel column/row
+    splits on `tp_axis`, ZeRO-3-style parameter sharding on `fsdp_axis`.
+
+    Mirrors the reference placements: column-parallel weights (q/k/v, gate/up,
+    lm_head, embedding hidden dim) shard their OUT dim on tp; row-parallel
+    (o_proj, down_proj) shard their IN dim. With weight layout [in, out]:
+    column => Shard(1), row => Shard(0). FSDP shards the remaining dim.
+    """
+    from ..distributed.api import shard_parameter
+
+    for name, p in model.named_parameters():
+        if p is None:
+            continue
+        if any(s in name for s in ("embed_tokens", "q_proj", "k_proj",
+                                   "v_proj", "gate_proj", "up_proj",
+                                   "lm_head")):
+            tp_dim, fsdp_dim = 1, 0               # column parallel
+        elif any(s in name for s in ("o_proj", "down_proj")):
+            tp_dim, fsdp_dim = 0, 1               # row parallel
+        else:                                      # norms
+            tp_dim, fsdp_dim = None, None
+        shard_parameter(p, mesh, tp_axis, fsdp_axis, tp_dim, fsdp_dim)
+    return model

@@ -24,8 +24,10 @@ __all__ = ["flash_attention_fwd", "flash_attention"]
 _NEG_INF = -1e30
 
 
-def _sdpa_xla(q, k, v, causal=False, scale=None):
-    """Numeric oracle, layout [B, L, H, D]."""
+def _sdpa_xla(q, k, v, causal=False, scale=None, mask=None):
+    """Numeric oracle, layout [B, L, H, D]. `mask` is additive, broadcast
+    against [B, H, Lq, Lk] logits. Handles Lq < Lk (KV-cache decode) by
+    offsetting the causal diagonal."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     qt = jnp.swapaxes(q, 1, 2)
@@ -36,6 +38,8 @@ def _sdpa_xla(q, k, v, causal=False, scale=None):
         ql, kl = logits.shape[-2], logits.shape[-1]
         cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
         logits = jnp.where(cm, logits, _NEG_INF)
+    if mask is not None:
+        logits = logits + mask
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,145 @@
+"""LM zoo tests: Llama/GPT/BERT forward+backward, sharded train step.
+
+Mirrors the reference's hybrid_strategy llama tests
+(ref: test/auto_parallel/hybrid_strategy/semi_auto_llama.py — loss must
+decrease and match across parallelism configs).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import ProcessMesh
+from paddle_tpu.distributed.dist_train import DistTrainStep
+from paddle_tpu.models import (
+    BertConfig, BertForMaskedLM, GPTConfig, GPTForCausalLM, LlamaConfig,
+    LlamaForCausalLM, LlamaPretrainingCriterion, shard_llama,
+)
+
+
+@pytest.fixture
+def ids(rng):
+    return paddle.to_tensor(
+        rng.integers(0, 128, (2, 16)).astype(np.int32))
+
+
+class TestLlama:
+    def test_forward_backward(self, ids):
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        crit = LlamaPretrainingCriterion()
+        logits = m(ids)
+        assert logits.shape == [2, 16, 128]
+        loss = crit(logits, ids)
+        loss.backward()
+        g = m.llama.layers[0].self_attn.q_proj.weight.grad
+        assert g is not None and float(abs(g).sum()) > 0
+        # every trainable param gets a grad
+        for name, p in m.named_parameters():
+            assert p.grad is not None, name
+
+    def test_gqa_matches_mha_shape(self, ids):
+        m = LlamaForCausalLM(LlamaConfig.tiny(num_key_value_heads=1))
+        assert m(ids).shape == [2, 16, 128]
+
+    def test_recompute_grads_flow(self, ids):
+        m = LlamaForCausalLM(LlamaConfig.tiny(recompute=True))
+        crit = LlamaPretrainingCriterion()
+        crit(m(ids), ids).backward()
+        g = m.llama.layers[0].self_attn.q_proj.weight.grad
+        assert g is not None and float(abs(g).sum()) > 0
+
+    def test_tied_embeddings(self, ids):
+        m = LlamaForCausalLM(LlamaConfig.tiny(tie_word_embeddings=True))
+        logits = m(ids)
+        assert logits.shape == [2, 16, 128]
+        crit = LlamaPretrainingCriterion()
+        crit(logits, ids).backward()
+        assert m.llama.embed_tokens.weight.grad is not None
+
+    def test_attention_mask_respected(self, rng):
+        """An additive mask must change the logits even on the default
+        (flash-enabled) config."""
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(rng.integers(0, 128, (1, 8)).astype(np.int32))
+        base = m(ids).numpy()
+        mask = np.zeros((1, 1, 8, 8), np.float32)
+        mask[..., -1] = -1e9  # hide the last key position
+        masked = m(ids, attention_mask=paddle.to_tensor(mask)).numpy()
+        assert np.abs(base - masked).max() > 1e-6
+
+    def test_generate_kv_cache_consistency(self, rng):
+        """Greedy decode with caches == rerunning full forward each step."""
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        m.eval()
+        ids = paddle.to_tensor(rng.integers(0, 128, (1, 8)).astype(np.int32))
+        out = m.generate(ids, max_new_tokens=4)
+        assert out.shape == [1, 12]
+        # no-cache re-check: argmax of full forward at each position
+        cur = ids
+        for _ in range(4):
+            logits = m(cur)
+            nxt = int(np.argmax(logits.numpy()[0, -1]))
+            cur = paddle.to_tensor(
+                np.concatenate([cur.numpy(), [[nxt]]], axis=1).astype(np.int32))
+        np.testing.assert_array_equal(out.numpy(), cur.numpy())
+
+    def test_loss_decreases_train_step(self, ids):
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        crit = LlamaPretrainingCriterion()
+        step = DistTrainStep(m, lambda lg, lb: crit(lg, lb), opt)
+        losses = [float(step(ids, ids)) for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_sharded_train_matches_single(self, rng):
+        """dp x fsdp x mp sharded step computes the same losses as the
+        unsharded step (the reference's acc-align gate,
+        ref: test/auto_parallel/hybrid_strategy/)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ids_np = rng.integers(0, 64, (4, 16)).astype(np.int32)
+        cfg_kw = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, use_flash_attention=False)
+
+        def run(shard):
+            paddle.seed(0)
+            m = LlamaForCausalLM(LlamaConfig.tiny(**cfg_kw))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=m.parameters())
+            crit = LlamaPretrainingCriterion()
+            data_sharding = None
+            if shard:
+                mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                                   dim_names=["dp", "fsdp", "mp"])
+                shard_llama(m, mesh, tp_axis="mp", fsdp_axis="fsdp")
+                data_sharding = NamedSharding(mesh.to_jax_mesh(),
+                                              P("dp", None))
+            step = DistTrainStep(m, lambda lg, lb: crit(lg, lb), opt,
+                                 data_sharding=data_sharding)
+            return [float(step(ids_np, ids_np)) for _ in range(3)]
+
+        single = run(False)
+        sharded = run(True)
+        np.testing.assert_allclose(single, sharded, rtol=2e-4)
+
+
+class TestGPT:
+    def test_forward_backward(self, ids):
+        m = GPTForCausalLM(GPTConfig.tiny())
+        logits = m(ids)
+        assert logits.shape == [2, 16, 128]
+        crit = LlamaPretrainingCriterion()
+        loss = crit(logits, ids)
+        loss.backward()
+        assert m.blocks[0].attn.qkv_proj.weight.grad is not None
+
+
+class TestBert:
+    def test_mlm_forward(self, ids):
+        m = BertForMaskedLM(BertConfig.tiny())
+        logits = m(ids)
+        assert logits.shape == [2, 16, 128]
