@@ -1,0 +1,7 @@
+"""paddle.incubate analog: experimental features.
+
+ref: python/paddle/incubate/ — the pieces with TPU relevance are the MoE
+stack (incubate/distributed/models/moe/) and fused transformer layers
+(incubate/nn/); fused ops are already XLA fusions here.
+"""
+from . import moe  # noqa: F401

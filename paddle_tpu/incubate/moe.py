@@ -1,0 +1,169 @@
+"""Mixture-of-Experts with expert parallelism.
+
+ref: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+(MoELayer), moe/gate/{naive,switch,gshard}_gate.py, and the alltoall
+dispatch ops global_scatter/global_gather
+(fluid/operators/collective/global_scatter_op.cu.cc:349).
+
+TPU-native design: the GShard dense dispatch algebra — one-hot combine
+weights einsum'd against tokens — instead of the reference's
+ragged alltoall. Expert weights live as one stacked [E, ...] array whose
+leading dim is sharded on the 'ep' mesh axis; when token batches are
+sharded too, XLA GSPMD lowers the dispatch einsum into the same
+all-to-all over ICI the reference issues through NCCL. Every expert FFN
+is a single batched matmul on the MXU (no per-expert Python loop).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Parameter, Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+
+__all__ = ["NaiveGate", "SwitchGate", "GShardGate", "MoELayer"]
+
+
+class _BaseGate(Layer):
+    def __init__(self, d_model: int, num_experts: int):
+        super().__init__()
+        self.num_experts = num_experts
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.XavierUniform())
+
+
+class NaiveGate(_BaseGate):
+    """Top-k softmax gate (ref: moe/gate/naive_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=2):
+        super().__init__(d_model, num_experts)
+        self.top_k = top_k
+
+
+class SwitchGate(_BaseGate):
+    """Top-1 gate with load-balancing aux loss (ref: moe/gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts):
+        super().__init__(d_model, num_experts)
+        self.top_k = 1
+
+
+class GShardGate(_BaseGate):
+    """Top-2 gate with capacity + aux loss (ref: moe/gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts):
+        super().__init__(d_model, num_experts)
+        self.top_k = 2
+
+
+def _gshard_dispatch(gate_logits, top_k, capacity):
+    """Pure dispatch algebra: logits [T, E] -> (combine [T, E, C],
+    dispatch-bool [T, E, C], aux_loss). The GShard formulation: per-expert
+    positions via a cumsum over the token axis, tokens past capacity
+    dropped."""
+    T, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    # aux load-balance loss (Switch/GShard form): E * sum(fraction_tokens *
+    # fraction_probs) over experts, using the top-1 assignment
+    top1 = jnp.argmax(probs, axis=-1)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
+    aux_loss = E * jnp.sum(me * ce)
+
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    dispatch = jnp.zeros((T, E, capacity), bool)
+    used = jnp.zeros((T, E), bool)
+    counts = jnp.zeros((E,), jnp.float32)  # slots taken per expert so far
+    # iterate k choices (k is tiny and static -> unrolled by trace)
+    for _ in range(min(top_k, E)):
+        choice = jnp.argmax(jnp.where(used, -jnp.inf, probs), axis=-1)
+        oh = jax.nn.one_hot(choice, E, dtype=jnp.float32)        # [T, E]
+        # slot index continues where the previous iterations stopped, so
+        # 2nd-choice tokens never collide with 1st-choice tokens
+        pos = (jnp.cumsum(oh, axis=0) - 1.0 + counts[None, :]) * oh
+        in_cap = pos < capacity
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)               # [T, E, C]
+        w = (probs * oh * in_cap)[..., None] * pos_oh
+        combine = combine + w
+        dispatch = dispatch | (w > 0)
+        used = used | (oh > 0)
+        counts = counts + oh.sum(axis=0)
+    return combine, dispatch, aux_loss
+
+
+class MoELayer(Layer):
+    """ref: moe_layer.py:263 MoELayer(d_model, experts, gate, ...). Experts
+    are a stacked SwiGLU/relu FFN; `ep_mesh_axis` shards the expert dim for
+    expert parallelism (the reference's global_scatter/global_gather
+    alltoall becomes a GSPMD-lowered all-to-all).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 gate: str = "gshard", top_k: int = 2,
+                 capacity_factor: float = 1.25, activation: str = "gelu"):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        if gate == "naive":
+            self.gate = NaiveGate(d_model, num_experts, top_k)
+        elif gate == "switch":
+            self.gate = SwitchGate(d_model, num_experts)
+        elif gate == "gshard":
+            self.gate = GShardGate(d_model, num_experts)
+        else:
+            raise ValueError(f"unknown gate {gate!r}")
+        self.top_k = self.gate.top_k
+        self.activation = activation
+        scale = 1.0 / math.sqrt(d_model)
+        self.w_in = Parameter(
+            I.Uniform(-scale, scale)((num_experts, d_model, d_hidden),
+                                     jnp.float32))
+        self.w_out = Parameter(
+            I.Uniform(-scale, scale)((num_experts, d_hidden, d_model),
+                                     jnp.float32))
+        self.aux_loss: Optional[Tensor] = None
+
+    def forward(self, x):
+        """x: [B, L, H] -> [B, L, H]; stores load-balance loss in
+        self.aux_loss (add it to the training loss, matching the
+        reference's gate loss contract)."""
+        b, l, h = x.shape
+        capacity = max(1, int(self.capacity_factor * b * l *
+                              self.top_k / self.num_experts))
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+               "silu": jax.nn.silu}[self.activation]
+
+        def impl(x_arr, gate_w, w_in, w_out):
+            tokens = x_arr.reshape(b * l, h)
+            logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+            combine, dispatch, aux = _gshard_dispatch(
+                logits, self.top_k, capacity)
+            # dispatch: [T,E,C] x [T,H] -> [E,C,H]  (the alltoall moment)
+            xs = jnp.einsum("tec,th->ech", dispatch.astype(x_arr.dtype),
+                            tokens)
+            hdn = act(jnp.einsum("ech,ehf->ecf", xs, w_in))
+            ys = jnp.einsum("ecf,efh->ech", hdn, w_out)
+            out = jnp.einsum("tec,ech->th", combine.astype(x_arr.dtype), ys)
+            return out.reshape(b, l, h), aux
+
+        out, aux = apply_op(impl, x, self.gate.weight, self.w_in,
+                            self.w_out, op_name="moe_layer")
+        self.aux_loss = aux
+        return out
+
+    def shard_experts(self, mesh, ep_axis: str = "ep"):
+        """Shard the stacked expert weights' leading (expert) dim on the
+        'ep' mesh axis — expert parallelism as placement."""
+        from ..distributed.api import shard_parameter
+        shard_parameter(self.w_in, mesh, tp_axis=ep_axis, tp_dim=0)
+        shard_parameter(self.w_out, mesh, tp_axis=ep_axis, tp_dim=0)
+        return self

@@ -11,5 +11,6 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaPretrainingCriterion,
     shard_llama,
 )
-from .gpt import GPTConfig, GPTForCausalLM  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, shard_gpt  # noqa: F401
 from .bert import BertConfig, BertForMaskedLM, BertModel  # noqa: F401
+from .ernie_moe import ErnieMoEConfig, ErnieMoEForCausalLM  # noqa: F401

@@ -1,0 +1,140 @@
+"""ERNIE-MoE: transformer encoder-LM with MoE FFNs — BASELINE.md workload 5.
+
+ref: the reference builds this from incubate/distributed/models/moe/
+MoELayer dropped into an ERNIE (post-LN encoder) stack; expert parallel
+dispatch/combine ran through global_scatter/global_gather alltoalls.
+Here alternate layers use paddle_tpu.incubate.moe.MoELayer, whose expert
+dim shards over the 'ep' mesh axis.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..incubate.moe import MoELayer
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.layers_common import Embedding, Linear
+from ..nn.layers_conv_norm import LayerNorm
+from .gpt import GPTAttention, GPTConfig
+
+__all__ = ["ErnieMoEConfig", "ErnieMoEForCausalLM"]
+
+
+@dataclass
+class ErnieMoEConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2          # every Nth layer is MoE
+    aux_loss_weight: float = 0.01
+    layer_norm_eps: float = 1e-5
+    use_flash_attention: bool = True
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=128, num_experts=4)
+        base.update(kw)
+        return ErnieMoEConfig(**base)
+
+    def _attn_cfg(self):
+        return GPTConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            use_flash_attention=self.use_flash_attention)
+
+
+class ErnieMoEBlock(Layer):
+    def __init__(self, config: ErnieMoEConfig, use_moe: bool):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.attn = GPTAttention(config._attn_cfg())
+        self.ln_2 = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.use_moe = use_moe
+        if use_moe:
+            self.moe = MoELayer(config.hidden_size,
+                                config.intermediate_size,
+                                config.num_experts, gate="gshard",
+                                top_k=config.top_k,
+                                capacity_factor=config.capacity_factor)
+        else:
+            self.fc_in = Linear(config.hidden_size,
+                                config.intermediate_size)
+            self.fc_out = Linear(config.intermediate_size,
+                                 config.hidden_size)
+
+    def forward(self, h):
+        h = h + self.attn(self.ln_1(h))
+        if self.use_moe:
+            h = h + self.moe(self.ln_2(h))
+        else:
+            h = h + self.fc_out(F.gelu(self.fc_in(self.ln_2(h))))
+        return h
+
+
+class ErnieMoEForCausalLM(Layer):
+    def __init__(self, config: ErnieMoEConfig):
+        super().__init__()
+        self.config = config
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=I.Normal(0.0, 0.02))
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size,
+                             weight_attr=I.Normal(0.0, 0.02))
+        self.blocks = LayerList([
+            ErnieMoEBlock(config, use_moe=(i % config.moe_every ==
+                                           config.moe_every - 1))
+            for i in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              bias_attr=False)
+
+    def forward(self, input_ids):
+        l = input_ids.shape[1]
+        pos = Tensor(jnp.arange(l, dtype=jnp.int32)[None, :])
+        h = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.blocks:
+            h = blk(h)
+        return self.lm_head(self.ln_f(h))
+
+    def total_aux_loss(self):
+        """Sum of gate load-balancing losses, weighted; add to the LM loss."""
+        total = None
+        for blk in self.blocks:
+            if blk.use_moe and blk.moe.aux_loss is not None:
+                total = blk.moe.aux_loss if total is None else \
+                    total + blk.moe.aux_loss
+        if total is None:
+            return None
+        return total * self.config.aux_loss_weight
+
+    def shard_experts(self, mesh, ep_axis: str = "ep"):
+        from ..distributed.api import shard_parameter
+        # all params must live on the mesh for one jit: non-expert weights
+        # replicate; expert stacks go straight to Shard(0) on ep (never
+        # materialize the full [E, ...] stack per chip)
+        expert_params = {id(blk.moe.w_in) for blk in self.blocks
+                         if blk.use_moe} | \
+                        {id(blk.moe.w_out) for blk in self.blocks
+                         if blk.use_moe}
+        for _, p in self.named_parameters():
+            if p is not None and id(p) not in expert_params:
+                shard_parameter(p, mesh)
+        for blk in self.blocks:
+            if blk.use_moe:
+                blk.moe.shard_experts(mesh, ep_axis)
+        return self

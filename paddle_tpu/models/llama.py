@@ -53,6 +53,11 @@ class LlamaConfig:
     # recompute each decoder block in backward (ref: fleet recompute /
     # paddle.distributed.fleet.utils.recompute) = jax.checkpoint
     recompute: bool = False
+    # context parallelism (above-parity vs reference, SURVEY §2.2): when a
+    # mesh + axis are set, attention runs the ring kernel with K/V blocks
+    # rotating over ICI and the sequence sharded across the axis
+    cp_mesh: object = None
+    cp_axis: str = "sp"
 
     @staticmethod
     def tiny(**kw):
@@ -137,7 +142,12 @@ class LlamaAttention(Layer):
                 va = jnp.repeat(va, rep, axis=2)
             from ..ops.pallas.flash_attention import (_sdpa_xla,
                                                       flash_attention)
-            if (not cache_arrs and attention_mask is None
+            if (self.config.cp_mesh is not None and not cache_arrs
+                    and attention_mask is None):
+                from ..distributed.ring_attention import ring_attention
+                out = ring_attention(qa, ka, va, self.config.cp_mesh,
+                                     self.config.cp_axis, causal=True)
+            elif (not cache_arrs and attention_mask is None
                     and self.config.use_flash_attention):
                 out = flash_attention(qa, ka, va, True, None)
             else:
