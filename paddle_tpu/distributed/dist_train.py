@@ -99,6 +99,47 @@ class DistTrainStep:
         donate = (0, 2) if self._donate else ()
         self._jitted = jax.jit(step_fn, donate_argnums=donate)
 
+    # -- checkpoint ---------------------------------------------------------
+    def state_dict(self) -> Dict[str, Tensor]:
+        """Optimizer-state slots as named Tensors for
+        dist.save_state_dict (ref: the sharded-optimizer ckpt merge
+        utilities in fleet; slot naming param.slot)."""
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        out = {}
+        for k, slots in self._opt_state.items():
+            for name, v in slots.items():
+                out[f"{k}#{name}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, sd: Dict) -> None:
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        unmatched = []
+        for key, t in sd.items():
+            if "#" not in key:
+                unmatched.append(key)
+                continue
+            pname, slot = key.rsplit("#", 1)
+            if pname not in self._opt_state:
+                unmatched.append(key)
+                continue
+            arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            param_arr = self._params[pname]._data
+            sharding = getattr(param_arr, "sharding", None)
+            from jax.sharding import NamedSharding, PartitionSpec
+            if isinstance(sharding, NamedSharding):
+                if arr.shape != param_arr.shape:
+                    # scalar slots (beta pows) replicate over the mesh
+                    sharding = NamedSharding(sharding.mesh, PartitionSpec())
+                arr = jax.device_put(arr, sharding)
+            self._opt_state[pname][slot] = arr
+        if unmatched:
+            raise ValueError(
+                "optimizer checkpoint keys do not match the current model "
+                f"(resuming would silently reset state): {unmatched[:5]}"
+                f"{'...' if len(unmatched) > 5 else ''}")
+
     def __call__(self, *batch_and_labels, num_labels: int = 1):
         if self._jitted is None:
             self._build()

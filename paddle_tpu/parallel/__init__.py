@@ -1,0 +1,7 @@
+"""Compiled (SPMD) parallelism building blocks.
+
+Unlike paddle_tpu.distributed.fleet (the reference-shaped host-driven
+wrappers, ref: fleet/meta_parallel/), these are mesh-axis programs that
+live entirely inside one jit: the compiler sees the whole schedule.
+"""
+from .pipeline_spmd import spmd_pipeline, stack_layer_params  # noqa: F401

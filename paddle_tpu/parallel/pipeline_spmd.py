@@ -1,0 +1,104 @@
+"""Fully-compiled pipeline parallelism: GPipe schedule inside one jit.
+
+The reference's PP is a host-driven micro-batch loop with NCCL p2p
+(ref: fleet/meta_parallel/pipeline_parallel.py:575-720 1F1B,
+pp_utils/p2p_communication.py send/recv). On TPU a host loop serializes on
+dispatch latency (SURVEY.md §7 hard parts), so this module compiles the
+whole schedule: per-stage parameters are STACKED with a leading stage dim
+sharded on the 'pp' mesh axis; a lax.fori_loop ticks M + S - 1 times, each
+tick running every stage on its in-flight micro-batch and rotating
+activations one hop with ppermute (p2p over ICI). Backward is jax.grad
+through the loop — autodiff reverses the schedule, giving the cooldown
+phase for free.
+
+Stages must be structurally identical (e.g. the decoder-layer stack);
+embedding/head run outside the pipelined region, as on stage-0/stage-N
+in the reference's PipelineLayer segmentation (ref: pp_layers.py:257).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["spmd_pipeline", "stack_layer_params"]
+
+
+def stack_layer_params(per_layer_params: Sequence[dict]) -> dict:
+    """[{name: arr}, ...] for S structurally-identical layers -> one pytree
+    {name: arr[S, ...]}; shard its leading dim on the pp axis."""
+    keys = list(per_layer_params[0].keys())
+    return {k: jnp.stack([p[k] for p in per_layer_params]) for k in keys}
+
+
+def _pipeline_local(params, microbatches, *, stage_fn, axis):
+    """Runs per-stage inside shard_map. params: leading dim 1 (this stage's
+    slice); microbatches: [M, B, ...] (replicated input feed)."""
+    S = jax.lax.psum(1, axis)
+    sid = jax.lax.axis_index(axis)
+    M = microbatches.shape[0]
+    # each mesh stage may hold several consecutive layers (stacked dim //
+    # axis size); it runs them back-to-back per tick
+    group = next(iter(jax.tree.leaves(params))).shape[0]
+    first = sid == 0
+    last = sid == S - 1
+
+    buf0 = jnp.zeros_like(microbatches[0])
+    outs0 = jnp.zeros_like(microbatches)
+
+    def tick(t, carry):
+        buf, outs = carry
+        # stage 0 feeds micro-batch t; the others consume the activation
+        # that rotated in from the previous stage last tick
+        x = jnp.where(first, microbatches[jnp.clip(t, 0, M - 1)], buf)
+        y = x
+        for g in range(group):
+            y = stage_fn(jax.tree.map(lambda a: a[g], params), y)
+        # the last stage finished micro-batch t-(S-1) this tick
+        w = t - (S - 1)
+        valid = jnp.logical_and(last, jnp.logical_and(w >= 0, w < M))
+        wc = jnp.clip(w, 0, M - 1)
+        outs = outs.at[wc].set(jnp.where(valid, y, outs[wc]))
+        # rotate activations one hop along the ring (stage s -> s+1)
+        buf_next = jax.lax.ppermute(
+            y, axis, [(i, (i + 1) % S) for i in range(S)])
+        return buf_next, outs
+
+    _, outs = jax.lax.fori_loop(0, M + S - 1, tick, (buf0, outs0))
+    # only the last stage holds real outputs; masked psum replicates them
+    outs = jax.lax.psum(jnp.where(last, outs, 0.0), axis)
+    return outs
+
+
+def spmd_pipeline(stage_fn: Callable, stacked_params, microbatches, mesh,
+                  axis: str = "pp", batch_axes=()):
+    """Run the compiled pipeline.
+
+    stage_fn(params_one_stage, x) -> y with y.shape == x.shape.
+    stacked_params: pytree of [L, ...] arrays (see stack_layer_params); L
+    must be a multiple of the pp axis size — each stage runs L/S
+    consecutive layers per tick.
+    microbatches: [M, B, ...] array; M micro-batches of the global batch.
+    batch_axes: mesh axes sharding the batch dim (dp composition).
+    Returns [M, B, ...] outputs of the final stage.
+    """
+    jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
+    n_stages = dict(zip(jmesh.axis_names, jmesh.devices.shape))[axis]
+    n_layers = next(iter(jax.tree.leaves(stacked_params))).shape[0]
+    if n_layers % n_stages != 0:
+        raise ValueError(
+            f"stacked layer count {n_layers} must be a multiple of the "
+            f"'{axis}' axis size {n_stages}")
+    ndim = microbatches.ndim
+    data_spec = P(None, tuple(batch_axes) or None,
+                  *([None] * (ndim - 2)))
+    param_specs = jax.tree.map(
+        lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params)
+    fn = jax.shard_map(
+        functools.partial(_pipeline_local, stage_fn=stage_fn, axis=axis),
+        mesh=jmesh, in_specs=(param_specs, data_spec),
+        out_specs=data_spec, check_vma=False)
+    return fn(stacked_params, microbatches)
