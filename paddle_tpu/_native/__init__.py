@@ -1,0 +1,61 @@
+"""Native runtime loader: compiles native.cpp with the system toolchain on
+first import (cached as _paddle_native.so next to the source), mirroring
+the reference's compiled core (`paddle.base.core`). Falls back to None if
+no compiler is available — callers must degrade gracefully.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_src = os.path.join(_here, "native.cpp")
+_so = os.path.join(_here, "_paddle_native.so")
+
+
+def _build() -> bool:
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        f"-I{include}", _src, "-o", _so, "-lpthread",
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=300)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        sys.stderr.write(
+            f"paddle_tpu: native build failed:\n{proc.stderr[-2000:]}\n")
+        return False
+    return True
+
+
+def _load():
+    if not os.path.exists(_so) or (
+            os.path.getmtime(_so) < os.path.getmtime(_src)):
+        if not _build():
+            return None
+    spec = importlib.util.spec_from_file_location("_paddle_native", _so)
+    try:
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except ImportError:
+        return None
+
+
+lib = _load()
+
+if lib is not None:
+    # back-fill flags that paddle_tpu.core.flags defined before the native
+    # registry existed (the python side mirrors lazily; see flags._native_lib)
+    try:
+        from ..core import flags as _flags
+        for _name, _info in _flags._registry.items():
+            lib.flag_define(_name, str(_info.value), _info.help)
+    except Exception:
+        pass

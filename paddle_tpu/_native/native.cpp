@@ -1,0 +1,701 @@
+// paddle_tpu native runtime: the components the reference implements in
+// C++ and that stay host-side in a TPU build.
+//
+//  - flags registry        (ref: paddle/common/flags.h:336-375, impl
+//                           flags_native.cc — FLAGS_* env parsing, typed
+//                           get/set, export map)
+//  - host tracer           (ref: paddle/fluid/platform/profiler/
+//                           host_tracer.h:26 — RecordEvent spans collected
+//                           into a buffer, dumped as Chrome trace JSON,
+//                           chrometracing_logger.cc)
+//  - TCPStore              (ref: paddle/phi/core/distributed/store/
+//                           tcp_store.h:121, socket.cpp — rank-0 TCP KV
+//                           server with set/get/add/wait, the rendezvous
+//                           bootstrap for multi-host meshes)
+//  - memory stats          (ref: paddle/phi/core/memory/stats.h —
+//                           current/peak counters per stat kind)
+//
+// Exposed through the CPython C API (no pybind11 in this image).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// flags registry
+// ---------------------------------------------------------------------------
+class FlagRegistry {
+ public:
+  static FlagRegistry& Instance() {
+    static FlagRegistry r;
+    return r;
+  }
+
+  void Define(const std::string& name, const std::string& def,
+              const std::string& help) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (values_.count(name)) return;
+    defaults_[name] = def;
+    help_[name] = help;
+    // env override: FLAGS_<name>
+    std::string env_key = "FLAGS_" + name;
+    const char* env = std::getenv(env_key.c_str());
+    values_[name] = env ? std::string(env) : def;
+  }
+
+  bool Set(const std::string& name, const std::string& v) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!values_.count(name)) return false;
+    values_[name] = v;
+    return true;
+  }
+
+  bool Get(const std::string& name, std::string* out) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = values_.find(name);
+    if (it == values_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  std::vector<std::string> Names() const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<std::string> out;
+    out.reserve(values_.size());
+    for (auto& kv : values_) out.push_back(kv.first);
+    return out;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::unordered_map<std::string, std::string> values_, defaults_, help_;
+};
+
+// ---------------------------------------------------------------------------
+// host tracer
+// ---------------------------------------------------------------------------
+struct TraceEvent {
+  std::string name;
+  uint64_t tid;
+  double t0_us;
+  double t1_us;
+};
+
+class HostTracer {
+ public:
+  static HostTracer& Instance() {
+    static HostTracer t;
+    return t;
+  }
+
+  void Start() {
+    std::lock_guard<std::mutex> g(mu_);
+    enabled_ = true;
+    events_.clear();
+  }
+
+  void Stop() {
+    std::lock_guard<std::mutex> g(mu_);
+    enabled_ = false;
+  }
+
+  bool enabled() const { return enabled_; }
+
+  double NowUs() const {
+    return std::chrono::duration<double, std::micro>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void Record(const std::string& name, double t0, double t1) {
+    if (!enabled_) return;
+    std::lock_guard<std::mutex> g(mu_);
+    events_.push_back(TraceEvent{
+        name,
+        std::hash<std::thread::id>{}(std::this_thread::get_id()) & 0xffff,
+        t0, t1});
+  }
+
+  static void EscapeJson(const std::string& s, std::ostringstream& os) {
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+  }
+
+  // Chrome trace format (ref: chrometracing_logger.cc)
+  std::string DumpJson() const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::ostringstream os;
+    os << "{\"traceEvents\":[";
+    for (size_t i = 0; i < events_.size(); ++i) {
+      const auto& e = events_[i];
+      if (i) os << ",";
+      os << "{\"name\":\"";
+      EscapeJson(e.name, os);
+      os << "\",\"ph\":\"X\",\"pid\":0,"
+         << "\"tid\":" << e.tid << ",\"ts\":" << e.t0_us
+         << ",\"dur\":" << (e.t1_us - e.t0_us) << "}";
+    }
+    os << "]}";
+    return os.str();
+  }
+
+  size_t Size() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return events_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::atomic<bool> enabled_{false};
+  std::vector<TraceEvent> events_;
+};
+
+// ---------------------------------------------------------------------------
+// memory stats
+// ---------------------------------------------------------------------------
+class MemStats {
+ public:
+  static MemStats& Instance() {
+    static MemStats s;
+    return s;
+  }
+
+  void Update(const std::string& key, long long delta) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto& e = stats_[key];
+    e.current += delta;
+    if (e.current > e.peak) e.peak = e.current;
+  }
+
+  bool Get(const std::string& key, long long* cur, long long* peak) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = stats_.find(key);
+    if (it == stats_.end()) return false;
+    *cur = it->second.current;
+    *peak = it->second.peak;
+    return true;
+  }
+
+ private:
+  struct Entry {
+    long long current = 0, peak = 0;
+  };
+  mutable std::mutex mu_;
+  std::map<std::string, Entry> stats_;
+};
+
+// ---------------------------------------------------------------------------
+// TCPStore: length-prefixed protocol
+//   request : u8 op ('S','G','A','W') | u32 klen | key | (u32 vlen | value)
+//   response: u32 vlen | value            (GET/ADD/WAIT)
+// ---------------------------------------------------------------------------
+bool SendAll(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class TCPStoreServer {
+ public:
+  ~TCPStoreServer() { StopNow(); }
+
+  bool Start(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return false;
+    if (::listen(listen_fd_, 64) != 0) return false;
+    running_ = true;
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void StopNow() {
+    if (!running_.exchange(false)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    cv_.notify_all();
+    {
+      // unblock workers parked in recv() on their client sockets
+      std::lock_guard<std::mutex> g(mu_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (running_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        client_fds_.push_back(fd);
+      }
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (running_) {
+      uint8_t op;
+      if (!RecvAll(fd, &op, 1)) break;
+      uint32_t klen;
+      if (!RecvAll(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !RecvAll(fd, &key[0], klen)) break;
+      if (op == 'S') {  // set (acked, so a later get on any conn sees it)
+        uint32_t vlen;
+        if (!RecvAll(fd, &vlen, 4)) break;
+        std::string val(vlen, '\0');
+        if (vlen && !RecvAll(fd, &val[0], vlen)) break;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          kv_[key] = val;
+        }
+        cv_.notify_all();
+        uint8_t ack = 1;
+        if (!SendAll(fd, &ack, 1)) break;
+      } else if (op == 'G' || op == 'W') {  // get / wait-get
+        std::unique_lock<std::mutex> lk(mu_);
+        if (op == 'W')
+          cv_.wait(lk, [&] { return kv_.count(key) || !running_; });
+        uint8_t found = kv_.count(key) ? 1 : 0;
+        std::string val = found ? kv_[key] : std::string();
+        lk.unlock();
+        uint32_t vlen = static_cast<uint32_t>(val.size());
+        if (!SendAll(fd, &found, 1)) break;
+        if (!SendAll(fd, &vlen, 4)) break;
+        if (vlen && !SendAll(fd, val.data(), vlen)) break;
+      } else if (op == 'A') {  // add (atomic counter), value = i64 delta
+        int64_t delta;
+        uint32_t vlen;
+        if (!RecvAll(fd, &vlen, 4) || vlen != 8) break;
+        if (!RecvAll(fd, &delta, 8)) break;
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          int64_t cur = 0;
+          auto it = kv_.find(key);
+          if (it != kv_.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          result = cur + delta;
+          std::string v(8, '\0');
+          std::memcpy(&v[0], &result, 8);
+          kv_[key] = v;
+        }
+        cv_.notify_all();
+        uint32_t rlen = 8;
+        if (!SendAll(fd, &rlen, 4)) break;
+        if (!SendAll(fd, &result, 8)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<std::string, std::string> kv_;
+  std::vector<int> client_fds_;
+};
+
+class TCPStoreClient {
+ public:
+  bool Connect(const std::string& host, int port, double timeout_s) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    return false;
+  }
+
+  ~TCPStoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool Set(const std::string& key, const std::string& val) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = 'S';
+    uint32_t klen = key.size(), vlen = val.size();
+    if (!(SendAll(fd_, &op, 1) && SendAll(fd_, &klen, 4) &&
+          SendAll(fd_, key.data(), klen) && SendAll(fd_, &vlen, 4) &&
+          (vlen == 0 || SendAll(fd_, val.data(), vlen))))
+      return false;
+    uint8_t ack;
+    return RecvAll(fd_, &ack, 1) && ack == 1;
+  }
+
+  // returns false on transport error; *found distinguishes a missing key
+  // from a key holding an empty value
+  bool Get(const std::string& key, bool wait, std::string* out,
+           bool* found) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = wait ? 'W' : 'G';
+    uint32_t klen = key.size();
+    if (!SendAll(fd_, &op, 1) || !SendAll(fd_, &klen, 4) ||
+        !SendAll(fd_, key.data(), klen))
+      return false;
+    uint8_t f;
+    if (!RecvAll(fd_, &f, 1)) return false;
+    *found = f != 0;
+    uint32_t vlen;
+    if (!RecvAll(fd_, &vlen, 4)) return false;
+    out->assign(vlen, '\0');
+    return vlen == 0 || RecvAll(fd_, &(*out)[0], vlen);
+  }
+
+  bool Add(const std::string& key, int64_t delta, int64_t* result) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = 'A';
+    uint32_t klen = key.size(), vlen = 8;
+    if (!SendAll(fd_, &op, 1) || !SendAll(fd_, &klen, 4) ||
+        !SendAll(fd_, key.data(), klen) || !SendAll(fd_, &vlen, 4) ||
+        !SendAll(fd_, &delta, 8))
+      return false;
+    uint32_t rlen;
+    if (!RecvAll(fd_, &rlen, 4) || rlen != 8) return false;
+    return RecvAll(fd_, result, 8);
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Python bindings (CPython C API)
+// ---------------------------------------------------------------------------
+extern "C" {
+
+static PyObject* py_flag_define(PyObject*, PyObject* args) {
+  const char *name, *def, *help = "";
+  if (!PyArg_ParseTuple(args, "ss|s", &name, &def, &help)) return nullptr;
+  FlagRegistry::Instance().Define(name, def, help);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_flag_set(PyObject*, PyObject* args) {
+  const char *name, *val;
+  if (!PyArg_ParseTuple(args, "ss", &name, &val)) return nullptr;
+  if (!FlagRegistry::Instance().Set(name, val)) {
+    PyErr_Format(PyExc_KeyError, "unknown flag %s", name);
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_flag_get(PyObject*, PyObject* args) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  std::string out;
+  if (!FlagRegistry::Instance().Get(name, &out)) {
+    PyErr_Format(PyExc_KeyError, "unknown flag %s", name);
+    return nullptr;
+  }
+  return PyUnicode_FromStringAndSize(out.data(), out.size());
+}
+
+static PyObject* py_flag_names(PyObject*, PyObject*) {
+  auto names = FlagRegistry::Instance().Names();
+  PyObject* list = PyList_New(names.size());
+  for (size_t i = 0; i < names.size(); ++i)
+    PyList_SET_ITEM(list, i, PyUnicode_FromString(names[i].c_str()));
+  return list;
+}
+
+static PyObject* py_tracer_start(PyObject*, PyObject*) {
+  HostTracer::Instance().Start();
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_tracer_stop(PyObject*, PyObject*) {
+  HostTracer::Instance().Stop();
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_tracer_now(PyObject*, PyObject*) {
+  return PyFloat_FromDouble(HostTracer::Instance().NowUs());
+}
+
+static PyObject* py_tracer_record(PyObject*, PyObject* args) {
+  const char* name;
+  double t0, t1;
+  if (!PyArg_ParseTuple(args, "sdd", &name, &t0, &t1)) return nullptr;
+  HostTracer::Instance().Record(name, t0, t1);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_tracer_enabled(PyObject*, PyObject*) {
+  if (HostTracer::Instance().enabled()) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
+}
+
+static PyObject* py_tracer_dump(PyObject*, PyObject*) {
+  std::string s = HostTracer::Instance().DumpJson();
+  return PyUnicode_FromStringAndSize(s.data(), s.size());
+}
+
+static PyObject* py_tracer_size(PyObject*, PyObject*) {
+  return PyLong_FromSize_t(HostTracer::Instance().Size());
+}
+
+static PyObject* py_stat_update(PyObject*, PyObject* args) {
+  const char* key;
+  long long delta;
+  if (!PyArg_ParseTuple(args, "sL", &key, &delta)) return nullptr;
+  MemStats::Instance().Update(key, delta);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_stat_get(PyObject*, PyObject* args) {
+  const char* key;
+  if (!PyArg_ParseTuple(args, "s", &key)) return nullptr;
+  long long cur = 0, peak = 0;
+  MemStats::Instance().Get(key, &cur, &peak);
+  return Py_BuildValue("(LL)", cur, peak);
+}
+
+// --- TCPStore capsules ---
+static void server_capsule_destructor(PyObject* cap) {
+  auto* s = static_cast<TCPStoreServer*>(
+      PyCapsule_GetPointer(cap, "TCPStoreServer"));
+  delete s;
+}
+
+static void client_capsule_destructor(PyObject* cap) {
+  auto* c = static_cast<TCPStoreClient*>(
+      PyCapsule_GetPointer(cap, "TCPStoreClient"));
+  delete c;
+}
+
+static PyObject* py_store_server_start(PyObject*, PyObject* args) {
+  int port;
+  if (!PyArg_ParseTuple(args, "i", &port)) return nullptr;
+  auto* s = new TCPStoreServer();
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS ok = s->Start(port);
+  Py_END_ALLOW_THREADS
+  if (!ok) {
+    delete s;
+    PyErr_Format(PyExc_OSError, "TCPStore server failed to bind port %d",
+                 port);
+    return nullptr;
+  }
+  return PyCapsule_New(s, "TCPStoreServer", server_capsule_destructor);
+}
+
+static PyObject* py_store_client_connect(PyObject*, PyObject* args) {
+  const char* host;
+  int port;
+  double timeout;
+  if (!PyArg_ParseTuple(args, "sid", &host, &port, &timeout)) return nullptr;
+  auto* c = new TCPStoreClient();
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS ok = c->Connect(host, port, timeout);
+  Py_END_ALLOW_THREADS
+  if (!ok) {
+    delete c;
+    PyErr_Format(PyExc_ConnectionError, "TCPStore connect %s:%d timed out",
+                 host, port);
+    return nullptr;
+  }
+  return PyCapsule_New(c, "TCPStoreClient", client_capsule_destructor);
+}
+
+static TCPStoreClient* GetClient(PyObject* cap) {
+  return static_cast<TCPStoreClient*>(
+      PyCapsule_GetPointer(cap, "TCPStoreClient"));
+}
+
+static PyObject* py_store_set(PyObject*, PyObject* args) {
+  PyObject* cap;
+  const char* key;
+  Py_buffer val;
+  if (!PyArg_ParseTuple(args, "Osy*", &cap, &key, &val)) return nullptr;
+  auto* c = GetClient(cap);
+  if (!c) return nullptr;
+  bool ok;
+  std::string v(static_cast<const char*>(val.buf),
+                static_cast<size_t>(val.len));
+  PyBuffer_Release(&val);
+  Py_BEGIN_ALLOW_THREADS ok = c->Set(key, v);
+  Py_END_ALLOW_THREADS
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "TCPStore set failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_store_get(PyObject*, PyObject* args) {
+  // returns bytes, or None when the key does not exist (non-wait mode)
+  PyObject* cap;
+  const char* key;
+  int wait;
+  if (!PyArg_ParseTuple(args, "Osp", &cap, &key, &wait)) return nullptr;
+  auto* c = GetClient(cap);
+  if (!c) return nullptr;
+  std::string out;
+  bool ok, found = false;
+  Py_BEGIN_ALLOW_THREADS ok = c->Get(key, wait != 0, &out, &found);
+  Py_END_ALLOW_THREADS
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "TCPStore get failed");
+    return nullptr;
+  }
+  if (!found) Py_RETURN_NONE;
+  return PyBytes_FromStringAndSize(out.data(), out.size());
+}
+
+static PyObject* py_store_add(PyObject*, PyObject* args) {
+  PyObject* cap;
+  const char* key;
+  long long delta;
+  if (!PyArg_ParseTuple(args, "OsL", &cap, &key, &delta)) return nullptr;
+  auto* c = GetClient(cap);
+  if (!c) return nullptr;
+  int64_t result = 0;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS ok = c->Add(key, delta, &result);
+  Py_END_ALLOW_THREADS
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "TCPStore add failed");
+    return nullptr;
+  }
+  return PyLong_FromLongLong(result);
+}
+
+static PyObject* py_store_server_stop(PyObject*, PyObject* args) {
+  PyObject* cap;
+  if (!PyArg_ParseTuple(args, "O", &cap)) return nullptr;
+  auto* s = static_cast<TCPStoreServer*>(
+      PyCapsule_GetPointer(cap, "TCPStoreServer"));
+  if (s) {
+    Py_BEGIN_ALLOW_THREADS s->StopNow();
+    Py_END_ALLOW_THREADS
+  }
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef Methods[] = {
+    {"flag_define", py_flag_define, METH_VARARGS, "define a flag"},
+    {"flag_set", py_flag_set, METH_VARARGS, "set a flag"},
+    {"flag_get", py_flag_get, METH_VARARGS, "get a flag"},
+    {"flag_names", py_flag_names, METH_NOARGS, "list flags"},
+    {"tracer_start", py_tracer_start, METH_NOARGS, "start host tracer"},
+    {"tracer_stop", py_tracer_stop, METH_NOARGS, "stop host tracer"},
+    {"tracer_now", py_tracer_now, METH_NOARGS, "monotonic us"},
+    {"tracer_record", py_tracer_record, METH_VARARGS, "record span"},
+    {"tracer_enabled", py_tracer_enabled, METH_NOARGS, "tracer on?"},
+    {"tracer_dump", py_tracer_dump, METH_NOARGS, "chrome trace json"},
+    {"tracer_size", py_tracer_size, METH_NOARGS, "event count"},
+    {"stat_update", py_stat_update, METH_VARARGS, "update mem stat"},
+    {"stat_get", py_stat_get, METH_VARARGS, "(current, peak)"},
+    {"store_server_start", py_store_server_start, METH_VARARGS,
+     "start TCPStore server"},
+    {"store_server_stop", py_store_server_stop, METH_VARARGS,
+     "stop TCPStore server"},
+    {"store_client_connect", py_store_client_connect, METH_VARARGS,
+     "connect TCPStore client"},
+    {"store_set", py_store_set, METH_VARARGS, "set key"},
+    {"store_get", py_store_get, METH_VARARGS, "get key (optionally wait)"},
+    {"store_add", py_store_add, METH_VARARGS, "atomic add"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,
+                                       "_paddle_native",
+                                       "paddle_tpu native runtime",
+                                       -1,
+                                       Methods,
+                                       nullptr,
+                                       nullptr,
+                                       nullptr,
+                                       nullptr};
+
+PyMODINIT_FUNC PyInit__paddle_native(void) {
+  return PyModule_Create(&moduledef);
+}
+
+}  // extern "C"

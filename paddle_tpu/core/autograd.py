@@ -111,6 +111,24 @@ def _is_diff_dtype(x) -> bool:
     return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
 
 
+def _maybe_check_nan_inf(name: str, outs) -> None:
+    """FLAGS_check_nan_inf per-op scan (ref: eager/nan_inf_utils.h:38 —
+    CheckTensorHasNanOrInf after each ad_func). Only active in eager mode
+    (concrete arrays); tracing skips it, matching the reference's
+    dygraph-only check."""
+    from .flags import flag_value
+    if not flag_value("check_nan_inf"):
+        return
+    for i, o in enumerate(outs):
+        if isinstance(o, jax.core.Tracer):
+            return  # inside jit trace, skip (dygraph-only check)
+        if isinstance(o, jax.Array) and jnp.issubdtype(o.dtype, jnp.inexact):
+            if bool(jnp.any(~jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"Operator {name} output {i} contains NaN or Inf "
+                    f"(FLAGS_check_nan_inf is set)")
+
+
 def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     """Run ``fn`` (a pure JAX function) on mixed Tensor/raw args, recording a
     GradNode when grad is enabled and any Tensor input requires grad.
@@ -141,6 +159,7 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
         out = fn(*datas, **kwargs)
         multi = isinstance(out, (tuple, list))
         outs = tuple(out) if multi else (out,)
+        _maybe_check_nan_inf(name, outs)
         wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
         return wrapped if multi else wrapped[0]
 
@@ -157,6 +176,7 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     primals = [datas[i] for i in diff_idx]
     outs, vjp_fn = jax.vjp(f, *primals)
     multi = struct["multi"]
+    _maybe_check_nan_inf(name, outs)
 
     out_avals = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs)
     node = GradNode(vjp_fn, tuple(args[i] for i in diff_idx), out_avals, name)

@@ -30,6 +30,17 @@ class _FlagInfo:
 _registry: Dict[str, _FlagInfo] = {}
 
 
+def _native_lib():
+    """The native registry mirror is best-effort and LAZY: only mirror when
+    the extension is already loaded, so `import paddle_tpu` never pays the
+    g++ build (paddle_tpu._native compiles on ITS first import, triggered
+    by the components that need it: store/profiler). _native/__init__
+    back-fills flags defined before it loaded."""
+    import sys
+    mod = sys.modules.get("paddle_tpu._native")
+    return getattr(mod, "lib", None)
+
+
 def define_flag(name: str, default: Any, help: str = "") -> None:
     if isinstance(default, bool):
         parser: Callable[[str], Any] = _parse_bool
@@ -44,6 +55,11 @@ def define_flag(name: str, default: Any, help: str = "") -> None:
     if env is not None:
         info.value = parser(env)
     _registry[name] = info
+    # mirror into the C++ registry (ref: flags_native.cc ExportedFlagInfoMap)
+    # so native components observe the same flags
+    lib = _native_lib()
+    if lib is not None:
+        lib.flag_define(name, str(info.value), help)
 
 
 def get_flags(flags):
@@ -66,6 +82,9 @@ def set_flags(flags: Dict[str, Any]) -> None:
             raise ValueError(f"Unknown flag {f}")
         info = _registry[key]
         info.value = info.parser(v) if isinstance(v, str) else v
+        lib = _native_lib()
+        if lib is not None:
+            lib.flag_set(key, str(info.value))
 
 
 def flag_value(name: str):

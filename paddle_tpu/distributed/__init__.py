@@ -27,6 +27,9 @@ from .parallel import (  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from .store import TCPStore  # noqa: F401
+from .ring_attention import ring_attention, ring_self_attention  # noqa: F401
+from .dist_train import DistTrainStep  # noqa: F401
 
 # paddle.distributed.split (TP sugar) lives in fleet.mp_ops
 from .fleet.mp_ops import split  # noqa: F401

@@ -116,6 +116,7 @@ class DistTrainStep:
         if self._opt_state is None:
             self._opt_state = self._init_opt_state()
         unmatched = []
+        covered = set()
         for key, t in sd.items():
             if "#" not in key:
                 unmatched.append(key)
@@ -124,6 +125,7 @@ class DistTrainStep:
             if pname not in self._opt_state:
                 unmatched.append(key)
                 continue
+            covered.add((pname, slot))
             arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
             param_arr = self._params[pname]._data
             sharding = getattr(param_arr, "sharding", None)
@@ -134,11 +136,14 @@ class DistTrainStep:
                     sharding = NamedSharding(sharding.mesh, PartitionSpec())
                 arr = jax.device_put(arr, sharding)
             self._opt_state[pname][slot] = arr
-        if unmatched:
+        missing = [f"{p}#{s}" for p, slots in self._opt_state.items()
+                   for s in slots if (p, s) not in covered]
+        if unmatched or missing:
             raise ValueError(
-                "optimizer checkpoint keys do not match the current model "
-                f"(resuming would silently reset state): {unmatched[:5]}"
-                f"{'...' if len(unmatched) > 5 else ''}")
+                "optimizer checkpoint does not match the current model "
+                "(resuming would silently reset state): "
+                f"unmatched keys {unmatched[:5]}, "
+                f"missing slots {missing[:5]}")
 
     def __call__(self, *batch_and_labels, num_labels: int = 1):
         if self._jitted is None:

@@ -1,0 +1,7 @@
+"""paddle.distributed.launch equivalent.
+
+ref: python/paddle/distributed/launch/main.py:23 (launch CLI), controllers/
+(collective controller: per-rank proc spawn, env injection, log dir),
+fleet/elastic/manager.py:125 (restart-on-failure protocol).
+"""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,126 @@
+"""Collective launch controller.
+
+ref: launch/main.py:23 + launch/controllers/collective.py — spawn one
+worker process per device/replica with the rank env the framework reads
+(PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_MASTER), aggregate logs
+under --log_dir, propagate the first failure, and (elastic mode) restart
+workers that exit with the restart code.
+
+TPU note: on a TPU pod each *host* is one worker (jax distributed
+single-process-per-host), so --nproc_per_node defaults to 1; the CPU-mesh
+test path uses --devices to emulate N single-chip workers.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+ELASTIC_RESTART_CODE = 101  # ref: fleet/elastic/manager.py:33-34
+ELASTIC_EXIT_CODE = 102
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="launch distributed training "
+                    "(ref: paddle.distributed.launch)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--devices", type=str, default=None,
+                   help="comma list; len(devices) overrides nproc_per_node")
+    p.add_argument("--master", type=str, default="127.0.0.1:29500",
+                   help="host:port of the rank-0 TCPStore")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--elastic_retries", type=int, default=0,
+                   help="restarts allowed on exit code 101")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if ":" not in args.master:
+        p.error(f"--master must be host:port, got {args.master!r}")
+    return args
+
+
+def _worker_env(args, local_rank: int, nproc: int) -> dict:
+    env = dict(os.environ)
+    rank = args.node_rank * nproc + local_rank
+    world = args.nnodes * nproc
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_MASTER": args.master,
+        "MASTER_ADDR": args.master.split(":")[0],
+        "MASTER_PORT": args.master.split(":")[1],
+        # jax multi-host bootstrap mirrors the same coordinates
+        "JAX_COORDINATOR_ADDRESS": args.master,
+        "JAX_NUM_PROCESSES": str(world),
+        "JAX_PROCESS_ID": str(rank),
+    })
+    if args.devices:
+        devs = args.devices.split(",")
+        env["PADDLE_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
+    return env
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    nproc = (len(args.devices.split(","))
+             if args.devices else args.nproc_per_node)
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    retries = {i: args.elastic_retries for i in range(nproc)}
+    procs: List[Optional[subprocess.Popen]] = [None] * nproc
+    logs = []
+
+    def spawn(i):
+        log = open(os.path.join(args.log_dir, f"workerlog.{i}"), "ab")
+        logs.append(log)
+        procs[i] = subprocess.Popen(
+            [sys.executable, args.training_script,
+             *args.training_script_args],
+            env=_worker_env(args, i, nproc), stdout=log, stderr=log)
+
+    for i in range(nproc):
+        spawn(i)
+
+    exit_code = 0
+    try:
+        while any(p is not None for p in procs):
+            time.sleep(0.2)
+            for i, p in enumerate(procs):
+                if p is None:
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    procs[i] = None
+                elif rc == ELASTIC_RESTART_CODE and retries[i] > 0:
+                    retries[i] -= 1
+                    spawn(i)  # elastic restart (ref: manager.py protocol)
+                else:
+                    exit_code = rc
+                    raise RuntimeError(
+                        f"worker {i} failed with exit code {rc} "
+                        f"(log: {args.log_dir}/workerlog.{i})")
+    except RuntimeError as e:
+        sys.stderr.write(str(e) + "\n")
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        exit_code = exit_code or 1
+    finally:
+        for log in logs:
+            log.close()
+    return exit_code
+
+
+def main():
+    sys.exit(launch())

@@ -1,0 +1,86 @@
+"""TCPStore: the rendezvous KV store for multi-host bootstrap.
+
+ref: paddle/phi/core/distributed/store/tcp_store.h:121 (TCPStore with
+set/get/add/wait, rank-0 hosts the server) — here backed by the C++
+implementation in paddle_tpu/_native/native.cpp. The multi-host mesh
+bootstrap (PJRT distributed init) uses this for address exchange the same
+way the reference's ProcessGroup creation broadcasts NCCL unique ids
+through its store (ref: process_group_nccl.cc CreateNCCLEnvCache).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .._native import lib as _lib
+
+__all__ = ["TCPStore"]
+
+
+class TCPStore:
+    """ref-parity API: TCPStore(host, port, is_master, world_size, timeout).
+
+    set/get/add/wait; `wait` blocks until the key exists (server-side
+    condition variable, no polling)."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 30.0):
+        if _lib is None:
+            raise RuntimeError(
+                "paddle_tpu native runtime unavailable (g++ build failed)")
+        self.host = host
+        self.port = port
+        self.is_master = is_master
+        self.world_size = world_size
+        self._server = None
+        self._barrier_gen = 0
+        if is_master:
+            self._server = _lib.store_server_start(port)
+        self._client = _lib.store_client_connect(host, port, timeout)
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        _lib.store_set(self._client, key, bytes(value))
+
+    def get(self, key: str) -> bytes:
+        """Blocks until the key is set (reference wait-then-get contract)."""
+        v = _lib.store_get(self._client, key, True)
+        if v is None:
+            raise ConnectionError(
+                f"TCPStore wait for {key!r} aborted (server shut down)")
+        return v
+
+    def get_nowait(self, key: str) -> Optional[bytes]:
+        """None means the key does not exist; b'' is a real empty value."""
+        return _lib.store_get(self._client, key, False)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return _lib.store_add(self._client, key, int(amount))
+
+    def wait(self, keys) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            _lib.store_get(self._client, k, True)
+
+    def barrier(self, name: str = "barrier") -> None:
+        """All world_size participants arrive, then proceed. Keys carry a
+        per-call generation so the barrier is reusable (each participant's
+        Nth call synchronizes with every peer's Nth call)."""
+        gen = self._barrier_gen
+        self._barrier_gen += 1
+        n = self.add(f"__{name}_{gen}_cnt", 1)
+        if n >= self.world_size:
+            self.set(f"__{name}_{gen}_done", b"1")
+        self.wait(f"__{name}_{gen}_done")
+
+    def shutdown(self):
+        if self._server is not None:
+            _lib.store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

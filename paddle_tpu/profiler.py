@@ -1,0 +1,141 @@
+"""Profiler: host-span tracer + device (XLA) profiler, two-plane design.
+
+ref: python/paddle/profiler/profiler.py:358 (Profiler context manager with
+scheduler states), paddle/fluid/platform/profiler/host_tracer.h:26
+(RecordEvent spans), chrometracing_logger.cc (Chrome trace export). The
+host plane is the C++ tracer in paddle_tpu._native; the device plane is
+jax.profiler (XLA/xplane), which TensorBoard renders — the same division
+the reference draws between HostTracer and CudaTracer/CUPTI.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ._native import lib as _lib
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget",
+           "export_chrome_tracing"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    TPU = "tpu"
+    GPUTrace = "gpu"  # reference-compat alias
+
+
+class RecordEvent:
+    """Host-span annotation (ref: paddle.profiler.RecordEvent; native analog
+    platform/profiler/event_tracing.h RecordEvent). Usable as context
+    manager or begin()/end() pair."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0: Optional[float] = None
+
+    def begin(self):
+        if _lib is not None and _lib.tracer_enabled():
+            self._t0 = _lib.tracer_now()
+
+    def end(self):
+        if _lib is not None and self._t0 is not None:
+            _lib.tracer_record(self.name, self._t0, _lib.tracer_now())
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    """ref: paddle.profiler.Profiler — start/stop/step, export.
+
+    targets including TPU adds the XLA device trace (jax.profiler), viewable
+    in TensorBoard; the host plane always records via the native tracer.
+    """
+
+    def __init__(self, targets=None, on_trace_ready=None, timer_only=False,
+                 profile_memory=False, scheduler=None):
+        self.targets = targets or [ProfilerTarget.CPU]
+        self.on_trace_ready = on_trace_ready
+        self._device_dir: Optional[str] = None
+        self._running = False
+        self._step_count = 0
+
+    def start(self):
+        if _lib is not None:
+            _lib.tracer_start()
+        if ProfilerTarget.TPU in self.targets or \
+                ProfilerTarget.GPUTrace in self.targets:
+            import jax
+            self._device_dir = os.environ.get(
+                "PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+            try:
+                jax.profiler.start_trace(self._device_dir)
+            except Exception:
+                self._device_dir = None
+        self._running = True
+        return self
+
+    def stop(self):
+        if not self._running:
+            return
+        if _lib is not None:
+            _lib.tracer_stop()
+        if self._device_dir is not None:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        self._running = False
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self):
+        self._step_count += 1
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- export -------------------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        export_chrome_tracing(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        if _lib is None:
+            return "native tracer unavailable"
+        data = json.loads(_lib.tracer_dump())
+        agg = {}
+        for e in data.get("traceEvents", []):
+            rec = agg.setdefault(e["name"], [0, 0.0])
+            rec[0] += 1
+            rec[1] += e.get("dur", 0.0)
+        lines = [f"{'name':<40} {'calls':>8} {'total_ms':>12}"]
+        for name, (calls, total) in sorted(agg.items(),
+                                           key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40} {calls:>8} {total / 1e3:>12.3f}")
+        return "\n".join(lines)
+
+
+def export_chrome_tracing(path: str, worker_name=None):
+    """Write the host plane as chrome://tracing JSON
+    (ref: chrometracing_logger.cc)."""
+    if _lib is None:
+        raise RuntimeError("native tracer unavailable")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(_lib.tracer_dump())
+    return path

@@ -1,0 +1,49 @@
+"""Aux-subsystem behavior tests: NaN-check mode (SURVEY §5 race-detection
+analog), AMP-adjacent numerics tooling."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestNanCheck:
+    def test_nan_raises_when_enabled(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(FloatingPointError, match="NaN or Inf"):
+                _ = x / x  # 0/0 -> NaN
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_clean_ops_pass(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+            y = (x * x).sum()
+            assert float(y) == 5.0
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_disabled_by_default(self):
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        z = x / x  # NaN, but no flag -> no raise
+        assert np.isnan(z.numpy()).all()
+
+    def test_skipped_under_jit(self):
+        """The scan is eager-only: tracing with the flag on must not crash
+        (regression: tracers passed the isinstance(jax.Array) check)."""
+        import jax
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+
+            def f(arr):
+                import paddle_tpu
+                t = paddle_tpu.Tensor(arr)
+                return (t * t)._data
+
+            out = jax.jit(f)(x._data)
+            assert float(out.sum()) == 5.0
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})

@@ -1,0 +1,60 @@
+"""Launcher CLI tests (ref: the reference tests its launcher by shelling
+out, test/collective/test_communication_api_base.py:58-79)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+
+def _run_launch(tmp_path, script_body, extra=(), env=None):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--log_dir", str(tmp_path / "log"), *extra, str(script)]
+    e = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    if env:
+        e.update(env)
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=120,
+                          env=e, cwd="/root/repo"), tmp_path / "log"
+
+
+def test_rank_env_injection(tmp_path):
+    proc, log = _run_launch(tmp_path, """
+        import os
+        print("rank", os.environ["PADDLE_TRAINER_ID"],
+              "of", os.environ["PADDLE_TRAINERS_NUM"])
+    """, extra=["--nproc_per_node", "2"])
+    assert proc.returncode == 0, proc.stderr
+    logs = sorted(os.listdir(log))
+    assert logs == ["workerlog.0", "workerlog.1"]
+    body0 = (log / "workerlog.0").read_text()
+    body1 = (log / "workerlog.1").read_text()
+    assert "rank 0 of 2" in body0
+    assert "rank 1 of 2" in body1
+
+
+def test_failure_propagates(tmp_path):
+    proc, _ = _run_launch(tmp_path, """
+        import sys
+        sys.exit(3)
+    """)
+    assert proc.returncode != 0
+    assert "failed with exit code 3" in proc.stderr
+
+
+def test_elastic_restart(tmp_path):
+    """Worker exits 101 once, then succeeds after restart
+    (ref: elastic/manager.py restart protocol)."""
+    proc, log = _run_launch(tmp_path, """
+        import os, sys
+        marker = os.environ["MARKER"]
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(101)
+        print("resumed ok")
+    """, extra=["--elastic_retries", "1"],
+        env={"MARKER": str(tmp_path / "marker")})
+    assert proc.returncode == 0, proc.stderr
+    assert "resumed ok" in (log / "workerlog.0").read_text()

@@ -1,0 +1,133 @@
+"""Native runtime tests: flags registry, host tracer, TCPStore, mem stats.
+
+ref analogs: test/cpp/phi (kernels/core gtest), tcp_store tests. These run
+through the Python bindings of paddle_tpu/_native/native.cpp.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu._native import lib
+
+pytestmark = pytest.mark.skipif(lib is None,
+                                reason="native extension unavailable")
+
+
+class TestFlags:
+    def test_define_set_get(self):
+        lib.flag_define("test_flag_xyz", "42", "test")
+        assert lib.flag_get("test_flag_xyz") == "42"
+        lib.flag_set("test_flag_xyz", "7")
+        assert lib.flag_get("test_flag_xyz") == "7"
+        assert "test_flag_xyz" in lib.flag_names()
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(KeyError):
+            lib.flag_get("no_such_flag_abc")
+
+    def test_python_registry_mirrors_native(self):
+        import paddle_tpu as paddle
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert lib.flag_get("check_nan_inf") == "True"
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        assert lib.flag_get("check_nan_inf") == "False"
+
+
+class TestTracer:
+    def test_record_and_dump(self):
+        import json
+        lib.tracer_start()
+        t0 = lib.tracer_now()
+        time.sleep(0.01)
+        lib.tracer_record("op_a", t0, lib.tracer_now())
+        lib.tracer_stop()
+        data = json.loads(lib.tracer_dump())
+        ev = data["traceEvents"]
+        assert len(ev) == 1 and ev[0]["name"] == "op_a"
+        assert ev[0]["dur"] >= 10_000 * 0.5  # at least ~5ms in us
+
+    def test_profiler_api(self, tmp_path):
+        import json
+        import paddle_tpu.profiler as profiler
+        with profiler.Profiler() as prof:
+            with profiler.RecordEvent("stepA"):
+                time.sleep(0.005)
+        out = str(tmp_path / "trace.json")
+        profiler.export_chrome_tracing(out)
+        names = [e["name"] for e in
+                 json.load(open(out))["traceEvents"]]
+        assert "stepA" in names
+        assert "stepA" in prof.summary()
+
+
+class TestMemStats:
+    def test_current_and_peak(self):
+        lib.stat_update("test_pool", 100)
+        lib.stat_update("test_pool", 200)
+        lib.stat_update("test_pool", -250)
+        cur, peak = lib.stat_get("test_pool")
+        assert cur == 50 and peak == 300
+
+
+class TestTCPStore:
+    def test_set_get_add_wait_barrier(self):
+        from paddle_tpu.distributed.store import TCPStore
+        port = 29901
+        master = TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+        worker = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+
+        master.set("k", b"v1")
+        assert worker.get("k") == b"v1"
+        assert master.add("ctr", 5) == 5
+        assert worker.add("ctr", 2) == 7
+
+        # wait blocks until set
+        res = {}
+
+        def waiter():
+            res["v"] = worker.get("late")
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.1)
+        master.set("late", b"x")
+        th.join(5)
+        assert res["v"] == b"x"
+
+        # barrier: both sides arrive concurrently
+        done = []
+
+        def arrive(store):
+            store.barrier("b1")
+            done.append(1)
+
+        t1 = threading.Thread(target=arrive, args=(master,))
+        t2 = threading.Thread(target=arrive, args=(worker,))
+        t1.start(), t2.start()
+        t1.join(5), t2.join(5)
+        assert len(done) == 2
+
+        # barrier is reusable: a second round must wait for BOTH again
+        # (regression: generation-less keys let round 2 pass instantly)
+        order = []
+
+        def round2(store, tag, delay):
+            time.sleep(delay)
+            order.append(("arrive", tag))
+            store.barrier("b1")
+            order.append(("pass", tag))
+
+        t3 = threading.Thread(target=round2, args=(master, "m", 0.0))
+        t4 = threading.Thread(target=round2, args=(worker, "w", 0.3))
+        t3.start(), t4.start()
+        t3.join(5), t4.join(5)
+        # master must not pass before worker arrives
+        assert order.index(("arrive", "w")) < order.index(("pass", "m"))
+
+        # empty value vs missing key distinction
+        master.set("empty_key", b"")
+        assert worker.get_nowait("empty_key") == b""
+        assert worker.get_nowait("never_set_key") is None
+        master.shutdown()
