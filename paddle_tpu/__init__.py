@@ -58,6 +58,17 @@ from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
 from . import metric  # noqa: F401
 from . import linalg  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import incubate  # noqa: F401
+from . import models  # noqa: F401
+# NOTE: paddle_tpu.profiler is intentionally NOT imported here — it pulls
+# in the native extension, whose first import compiles C++; users import
+# it explicitly (matching `import paddle.profiler` usage).
 from .framework.io import save, load  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 

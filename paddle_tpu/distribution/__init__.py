@@ -1,0 +1,11 @@
+"""paddle.distribution equivalent.
+
+ref: python/paddle/distribution/ — Distribution ABC (distribution.py),
+Normal/Uniform/Categorical/Bernoulli/Exponential/Laplace/Gumbel/
+LogNormal, kl_divergence registry (kl.py). Sampling draws keys from the
+framework generator (core.random), so paddle.seed governs determinism.
+"""
+from .distributions import (  # noqa: F401
+    Bernoulli, Categorical, Distribution, Exponential, Gumbel, Laplace,
+    LogNormal, Normal, Uniform, kl_divergence, register_kl,
+)

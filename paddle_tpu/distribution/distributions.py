@@ -1,0 +1,396 @@
+"""Distribution classes.
+
+ref: python/paddle/distribution/distribution.py (ABC: sample/rsample/
+log_prob/entropy/mean/variance), normal.py, uniform.py, categorical.py,
+bernoulli.py, exponential.py, laplace.py, gumbel.py, lognormal.py, kl.py
+(kl_divergence dispatch). Parameters are kept as Tensors and every
+computation goes through apply_op, so gradients flow to loc/scale/rate/
+logits — rsample is genuinely reparameterized (VAE/policy-gradient
+training works). Sampling keys come from core.random so paddle.seed
+governs determinism and jit tracing stays pure.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Exponential", "Laplace", "Gumbel", "LogNormal", "kl_divergence",
+    "register_kl",
+]
+
+
+def _t(x, dtype=jnp.float32) -> Tensor:
+    """Keep Tensor identity (and its grad path); wrap scalars/arrays."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(np.asarray(x), dtype))
+
+
+def _shape(s):
+    if s is None:
+        return ()
+    return tuple(int(v) for v in s)
+
+
+class Distribution:
+    """ref: distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op(jnp.exp, self.log_prob(value), op_name="exp")
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    """ref: normal.py Normal(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda l: jnp.broadcast_to(l, self.batch_shape), self.loc,
+            op_name="normal_mean")
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(s ** 2, self.batch_shape),
+            self.scale, op_name="normal_variance")
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        eps = jax.random.normal(key, _shape(shape) + self.batch_shape)
+        return apply_op(lambda l, s: l + s * eps, self.loc, self.scale,
+                        op_name="normal_rsample")
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            return (-((v - l) ** 2) / (2 * s ** 2)
+                    - jnp.log(s) - 0.5 * math.log(2 * math.pi))
+        return apply_op(f, value, self.loc, self.scale,
+                        op_name="normal_log_prob")
+
+    def entropy(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                self.batch_shape),
+            self.scale, op_name="normal_entropy")
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class LogNormal(Normal):
+    """ref: lognormal.py — exp transform of Normal."""
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        eps = jax.random.normal(key, _shape(shape) + self.batch_shape)
+        return apply_op(lambda l, s: jnp.exp(l + s * eps), self.loc,
+                        self.scale, op_name="lognormal_rsample")
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            logv = jnp.log(v)
+            return (-((logv - l) ** 2) / (2 * s ** 2) - logv
+                    - jnp.log(s) - 0.5 * math.log(2 * math.pi))
+        return apply_op(f, value, self.loc, self.scale,
+                        op_name="lognormal_log_prob")
+
+    @property
+    def mean(self):
+        return apply_op(lambda l, s: jnp.exp(l + s ** 2 / 2), self.loc,
+                        self.scale, op_name="lognormal_mean")
+
+    def entropy(self):
+        return apply_op(
+            lambda l, s: jnp.broadcast_to(
+                l + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                self.batch_shape),
+            self.loc, self.scale, op_name="lognormal_entropy")
+
+
+class Uniform(Distribution):
+    """ref: uniform.py Uniform(low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(self.low._data.shape,
+                                              self.high._data.shape))
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        u = jax.random.uniform(key, _shape(shape) + self.batch_shape)
+        return apply_op(lambda lo, hi: lo + (hi - lo) * u, self.low,
+                        self.high, op_name="uniform_rsample")
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = jnp.logical_and(v >= lo, v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return apply_op(f, value, self.low, self.high,
+                        op_name="uniform_log_prob")
+
+    def entropy(self):
+        return apply_op(
+            lambda lo, hi: jnp.broadcast_to(jnp.log(hi - lo),
+                                            self.batch_shape),
+            self.low, self.high, op_name="uniform_entropy")
+
+
+class Categorical(Distribution):
+    """ref: categorical.py Categorical(logits)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(self.logits._data.shape[:-1])
+
+    @property
+    def probs(self):
+        return apply_op(lambda lg: jax.nn.softmax(lg, -1), self.logits,
+                        op_name="categorical_probs")
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        return Tensor(jax.random.categorical(
+            key, self.logits._data,
+            shape=_shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        def f(v, lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return jnp.take_along_axis(
+                logp, v[..., None].astype(jnp.int32), -1)[..., 0]
+        return apply_op(f, value, self.logits,
+                        op_name="categorical_log_prob")
+
+    def entropy(self):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+        return apply_op(f, self.logits, op_name="categorical_entropy")
+
+
+class Bernoulli(Distribution):
+    """ref: bernoulli.py Bernoulli(probs)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_t = _t(probs)
+        super().__init__(self.probs_t._data.shape)
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        return Tensor(jax.random.bernoulli(
+            key, self.probs_t._data,
+            _shape(shape) + self.batch_shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v, p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply_op(f, value, self.probs_t,
+                        op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        def f(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return apply_op(f, self.probs_t, op_name="bernoulli_entropy")
+
+    @property
+    def mean(self):
+        return self.probs_t
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: p * (1 - p), self.probs_t,
+                        op_name="bernoulli_variance")
+
+
+class Exponential(Distribution):
+    """ref: exponential.py Exponential(rate)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate._data.shape)
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        e = jax.random.exponential(key, _shape(shape) + self.batch_shape)
+        return apply_op(lambda r: e / r, self.rate,
+                        op_name="exponential_rsample")
+
+    def log_prob(self, value):
+        return apply_op(lambda v, r: jnp.log(r) - r * v, value, self.rate,
+                        op_name="exponential_log_prob")
+
+    def entropy(self):
+        return apply_op(lambda r: 1.0 - jnp.log(r), self.rate,
+                        op_name="exponential_entropy")
+
+    @property
+    def mean(self):
+        return apply_op(lambda r: 1.0 / r, self.rate,
+                        op_name="exponential_mean")
+
+
+class Laplace(Distribution):
+    """ref: laplace.py Laplace(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        e = jax.random.laplace(key, _shape(shape) + self.batch_shape)
+        return apply_op(lambda l, s: l + s * e, self.loc, self.scale,
+                        op_name="laplace_rsample")
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            value, self.loc, self.scale, op_name="laplace_log_prob")
+
+    def entropy(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(1 + jnp.log(2 * s),
+                                       self.batch_shape),
+            self.scale, op_name="laplace_entropy")
+
+
+class Gumbel(Distribution):
+    """ref: gumbel.py Gumbel(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        g = jax.random.gumbel(key, _shape(shape) + self.batch_shape)
+        return apply_op(lambda l, s: l + s * g, self.loc, self.scale,
+                        op_name="gumbel_rsample")
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return apply_op(f, value, self.loc, self.scale,
+                        op_name="gumbel_log_prob")
+
+    def entropy(self):
+        # Euler-Mascheroni
+        return apply_op(
+            lambda s: jnp.broadcast_to(jnp.log(s) + 1.5772157,
+                                       self.batch_shape),
+            self.scale, op_name="gumbel_entropy")
+
+
+# -- KL registry (ref: distribution/kl.py register_kl/kl_divergence).
+# Dispatch is by EXACT class pair: subclass fallbacks silently produce
+# wrong values (e.g. LogNormal subclasses Normal but KL(Normal, LogNormal)
+# is not the normals' KL), so unknown pairs raise instead.
+_KL_REGISTRY: Dict[Tuple[Type, Type], Callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p: Normal, q: Normal):
+    def f(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return apply_op(f, p.loc, p.scale, q.loc, q.scale,
+                    op_name="kl_normal_normal")
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p: LogNormal, q: LogNormal):
+    # the exp transform cancels: KL equals that of the underlying normals
+    return _kl_normal_normal(p, q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p: Uniform, q: Uniform):
+    def f(plo, phi, qlo, qhi):
+        res = jnp.log((qhi - qlo) / (phi - plo))
+        oob = jnp.logical_or(plo < qlo, phi > qhi)
+        return jnp.where(oob, jnp.inf, res)
+    return apply_op(f, p.low, p.high, q.low, q.high,
+                    op_name="kl_uniform_uniform")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p: Categorical, q: Categorical):
+    def f(a, b):
+        lp = jax.nn.log_softmax(a, -1)
+        lq = jax.nn.log_softmax(b, -1)
+        return (jnp.exp(lp) * (lp - lq)).sum(-1)
+    return apply_op(f, p.logits, q.logits, op_name="kl_cat_cat")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p: Exponential, q: Exponential):
+    def f(pr, qr):
+        ratio = qr / pr
+        return jnp.log(1 / ratio) + ratio - 1
+    return apply_op(f, p.rate, q.rate, op_name="kl_exp_exp")

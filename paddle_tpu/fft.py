@@ -1,0 +1,76 @@
+"""paddle.fft equivalent over jnp.fft (XLA lowers to TPU-friendly FFTs).
+
+ref: python/paddle/fft.py — same surface: 1d/2d/nd complex, real, and
+hermitian transforms + helpers. Autograd rides apply_op like every op.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.autograd import apply_op
+from .core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return norm if norm in ("forward", "ortho") else "backward"
+
+
+def _wrap1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)),
+                        x, op_name=jfn.__name__)
+    return op
+
+
+def _wrap2(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)),
+                        x, op_name=jfn.__name__)
+    return op
+
+
+def _wrapn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)),
+                        x, op_name=jfn.__name__)
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+                    op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                    op_name="ifftshift")

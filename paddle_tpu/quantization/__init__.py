@@ -1,0 +1,13 @@
+"""paddle.quantization equivalent: QAT/PTQ with fake-quant layers.
+
+ref: python/paddle/quantization/ (QuantConfig config.py, QAT qat.py, PTQ
+ptq.py, observers in quanter/), legacy fake_quantize ops
+(fluid/operators/fake_quantize_op). TPU note: fake-quant is pure
+elementwise math so it fuses into surrounding XLA computations; int8
+deployment lowering is a compiler concern (XLA int8 matmul) — this module
+provides the calibration/training semantics.
+"""
+from .quantize import (  # noqa: F401
+    AbsmaxObserver, FakeQuantAbsMax, MovingAverageAbsmaxObserver, PTQ, QAT,
+    QuantConfig, QuantedLinear, fake_quantize_abs_max, quant_absmax,
+)

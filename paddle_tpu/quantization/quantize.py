@@ -1,0 +1,223 @@
+"""Fake-quant math, observers, QAT/PTQ drivers.
+
+ref: python/paddle/quantization/{config,qat,ptq}.py + factory quanters
+(quanter/abs_max.py FakeQuanterWithAbsMax...), op semantics
+fake_quantize_abs_max (fluid/operators/fake_quantize_op.cc): quantize to
+int range with straight-through-estimator gradients, scale from the abs
+max (per tensor or EMA during training).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn.layers_common import Linear
+
+__all__ = [
+    "quant_absmax", "fake_quantize_abs_max", "FakeQuantAbsMax",
+    "AbsmaxObserver", "MovingAverageAbsmaxObserver", "QuantConfig", "QAT",
+    "PTQ", "QuantedLinear",
+]
+
+
+def quant_absmax(x, bits: int = 8, scale=None):
+    """Quantize-dequantize with STE backward (ref: fake_quantize_op
+    FakeQuantizeAbsMax). scale=None computes the dynamic per-tensor abs
+    max; a float scale uses the static calibrated step (PTQ convert)."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    @jax.custom_vjp
+    def fq(a):
+        s = jnp.maximum(jnp.abs(a).max(), 1e-8) if scale is None \
+            else jnp.asarray(scale * qmax, a.dtype)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+        return q * s / qmax
+
+    def fwd(a):
+        return fq(a), None
+
+    def bwd(_, g):
+        return (g,)  # straight-through
+
+    fq.defvjp(fwd, bwd)
+    return fq(x)
+
+
+def fake_quantize_abs_max(x, bits: int = 8, scale=None):
+    return apply_op(lambda a: quant_absmax(a, bits, scale), x,
+                    op_name="fake_quantize_abs_max")
+
+
+class AbsmaxObserver(Layer):
+    """PTQ calibration observer (ref: quantization/observers/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def forward(self, x):
+        val = float(jnp.abs(x._data).max())
+        self._max = max(self._max, val)
+        return x
+
+    def scale(self) -> float:
+        return max(self._max, 1e-8) / (2 ** (self.quant_bits - 1) - 1)
+
+
+class MovingAverageAbsmaxObserver(AbsmaxObserver):
+    """ref: quanter/weighted_round.py moving-average absmax (QAT act
+    ranges)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def forward(self, x):
+        val = float(jnp.abs(x._data).max())
+        self._max = (self.moving_rate * self._max +
+                     (1 - self.moving_rate) * val)
+        return x
+
+
+class FakeQuantAbsMax(Layer):
+    """QAT quanter layer (ref: quanter/abs_max.py FakeQuanterWithAbsMax).
+    static_scale pins the quantization step (PTQ-converted layers)."""
+
+    def __init__(self, quant_bits: int = 8, static_scale=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.static_scale = static_scale
+
+    def forward(self, x):
+        return fake_quantize_abs_max(x, self.quant_bits, self.static_scale)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight + activation
+    (ref: quantization/quantized_linear.py / imperative qat layers).
+    act_scale, when given, freezes the activation step to the PTQ
+    calibration (otherwise dynamic per-batch absmax, the QAT behavior)."""
+
+    def __init__(self, inner: Linear, weight_bits=8, act_bits=8,
+                 act_scale=None):
+        super().__init__()
+        self.inner = inner
+        self.weight_quanter = FakeQuantAbsMax(weight_bits)
+        self.act_quanter = FakeQuantAbsMax(act_bits, act_scale)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = self.act_quanter(x)
+        wq = self.weight_quanter(self.inner.weight)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantConfig:
+    """ref: quantization/config.py QuantConfig — which layer types get
+    quantized, with what bit widths (per-type overrides via
+    add_layer_config)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.act_bits = getattr(activation, "quant_bits", 8) \
+            if activation is not None else 8
+        self.weight_bits = getattr(weight, "quant_bits", 8) \
+            if weight is not None else 8
+        # {layer_type: (weight_bits, act_bits)}
+        self._types = {Linear: (self.weight_bits, self.act_bits)}
+
+    def add_layer_config(self, layer_types, activation=None, weight=None):
+        ab = getattr(activation, "quant_bits", self.act_bits) \
+            if activation is not None else self.act_bits
+        wb = getattr(weight, "quant_bits", self.weight_bits) \
+            if weight is not None else self.weight_bits
+        for t in (layer_types if isinstance(layer_types, (list, tuple))
+                  else [layer_types]):
+            self._types[t] = (wb, ab)
+
+    def bits_for(self, layer):
+        return self._types.get(type(layer))
+
+    def matches(self, layer) -> bool:
+        return type(layer) in self._types
+
+
+def _swap_layers(model: Layer, predicate, make):
+    for name, sub in list(model._sub_layers.items()):
+        if sub is None:
+            continue
+        if predicate(sub):
+            model._sub_layers[name] = make(sub)
+        else:
+            _swap_layers(sub, predicate, make)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (ref: qat.py QAT.quantize)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        m = model if inplace else copy.deepcopy(model)
+
+        def make(l):
+            wb, ab = self.config.bits_for(l)
+            return QuantedLinear(l, wb, ab)
+
+        return _swap_layers(m, self.config.matches, make)
+
+
+class PTQ:
+    """Post-training quantization driver (ref: ptq.py PTQ.quantize →
+    calibration forward passes → convert, which FREEZES the observed
+    activation scales into the converted layers)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+        self._observers = []
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        """Instrument with observers; run calibration batches, then call
+        convert()."""
+        m = model if inplace else copy.deepcopy(model)
+
+        def make(l):
+            _, ab = self.config.bits_for(l)
+            obs = AbsmaxObserver(ab)
+            self._observers.append(obs)
+
+            class _Observed(Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.inner = l
+                    self.obs = obs
+
+                def forward(self, x):
+                    return self.inner(self.obs(x))
+
+            return _Observed()
+
+        return _swap_layers(m, self.config.matches, make)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Replace observed layers with statically-quantized ones using
+        each observer's calibrated scale."""
+        m = model if inplace else copy.deepcopy(model)
+
+        def pred(l):
+            return type(l).__name__ == "_Observed"
+
+        def make(l):
+            wb, ab = self.config.bits_for(l.inner)
+            return QuantedLinear(l.inner, wb, ab,
+                                 act_scale=l.obs.scale())
+
+        return _swap_layers(m, pred, make)

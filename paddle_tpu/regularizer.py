@@ -1,0 +1,44 @@
+"""paddle.regularizer equivalent: L1Decay / L2Decay.
+
+ref: python/paddle/regularizer.py — attached per-param via ParamAttr or
+globally via the optimizer's weight_decay argument; applied to gradients
+before the update (the optimizer folds coefficient * penalty' into grad).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param) (ref: regularizer.py L1Decay)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param, grad):
+        return grad + self._coeff * jnp.sign(param)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (ref: regularizer.py L2Decay)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param, grad):
+        return grad + self._coeff * param

@@ -1,0 +1,150 @@
+"""paddle.signal equivalent: STFT / iSTFT.
+
+ref: python/paddle/signal.py (stft :153, istft :310, frame :27,
+overlap_add :101) — frame + window + FFT composition, built on jnp so it
+lowers to XLA FFT kernels. One framing-index helper and one vectorized
+scatter-add reconstruction are shared by frame/overlap_add/stft/istft.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.autograd import apply_op
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _frame_idx(n: int, frame_length: int, hop_length: int):
+    """[num_frames, frame_length] gather indices."""
+    num = 1 + (n - frame_length) // hop_length
+    return (jnp.arange(frame_length)[None, :] +
+            hop_length * jnp.arange(num)[:, None])
+
+
+def _overlap_add_last(frames, hop_length: int):
+    """frames [..., frame_length, num] -> [..., out_len] via ONE
+    scatter-add (duplicate indices sum)."""
+    frame_length, num = frames.shape[-2], frames.shape[-1]
+    out_len = frame_length + hop_length * (num - 1)
+    idx = _frame_idx(out_len, frame_length, hop_length)  # [num, fl]
+    flat = jnp.moveaxis(frames, -1, -2)                  # [..., num, fl]
+    flat = flat.reshape(frames.shape[:-2] + (num * frame_length,))
+    out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    return out.at[..., idx.reshape(-1)].add(flat)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """ref: signal.py:27. axis=-1: [..., frame_length, num_frames];
+    axis=0: [num_frames, frame_length, ...]."""
+    if axis not in (0, -1):
+        raise ValueError("frame: axis must be 0 or -1")
+
+    def impl(a):
+        if axis == 0:
+            idx = _frame_idx(a.shape[0], frame_length, hop_length)
+            return a[idx]                      # [num, frame_length, ...]
+        idx = _frame_idx(a.shape[-1], frame_length, hop_length)
+        return jnp.moveaxis(a[..., idx], -2, -1)  # [..., fl, num]
+    return apply_op(impl, x, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """ref: signal.py:101. Inverse of frame for the same axis convention."""
+    if axis not in (0, -1):
+        raise ValueError("overlap_add: axis must be 0 or -1")
+
+    def impl(a):
+        if axis == 0:                          # [num, frame_length, ...]
+            moved = jnp.moveaxis(jnp.moveaxis(a, 0, -1), 0, -2)
+            out = _overlap_add_last(moved, hop_length)
+            return jnp.moveaxis(out, -1, 0)
+        return _overlap_add_last(a, hop_length)
+    return apply_op(impl, x, op_name="overlap_add")
+
+
+def _full_window(window, n_fft: int, win_length: int, dtype):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    w = window.astype(dtype)
+    wfull = jnp.zeros((n_fft,), dtype)
+    off = (n_fft - win_length) // 2
+    return wfull.at[off:off + win_length].set(w)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """ref: signal.py:153. x: [B, T] or [T] real -> complex spectrogram
+    [B, n_fft//2+1, num_frames] (onesided)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def impl(a, w):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0), (pad, pad)], mode=pad_mode)
+        idx = _frame_idx(a.shape[-1], n_fft, hop_length)
+        frames = a[:, idx]                      # [B, num, n_fft]
+        if w is not None:
+            frames = frames * _full_window(w, n_fft, win_length,
+                                           a.dtype)[None, None, :]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+            jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -2, -1)       # [B, freq, num]
+        return spec[0] if squeeze else spec
+
+    w = window._data if isinstance(window, Tensor) else window
+    return apply_op(lambda a: impl(a, w), x, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """ref: signal.py:310. Inverse via one vectorized overlap-add with
+    window-square normalization. return_complex requires onesided=False
+    and keeps the imaginary part."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: return_complex requires onesided=False (ref contract)")
+
+    def impl(spec, w):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        spec = jnp.swapaxes(spec, -2, -1)       # [B, num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        wfull = _full_window(
+            w, n_fft, win_length,
+            frames.real.dtype if jnp.iscomplexobj(frames) else frames.dtype)
+        frames = frames * wfull[None, None, :]
+        num = frames.shape[1]
+        out = _overlap_add_last(jnp.moveaxis(frames, 1, -1), hop_length)
+        norm = _overlap_add_last(
+            jnp.broadcast_to((wfull * wfull)[:, None], (n_fft, num)),
+            hop_length)
+        out = out / jnp.maximum(norm, 1e-11)[None, :]
+        out_len = n_fft + hop_length * (num - 1)
+        if center:
+            pad = n_fft // 2
+            out = out[:, pad:out_len - pad]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    w = window._data if isinstance(window, Tensor) else window
+    return apply_op(lambda a: impl(a, w), x, op_name="istft")
