@@ -46,10 +46,13 @@ def main():
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if on_tpu:
+        # ~1.2B-param Llama geometry chosen to saturate one v5e chip's HBM
+        # (AdamW fp32 state + bf16 params/grads + flash-attention
+        # activations); wide layers keep the MXU fed
         cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=24, num_attention_heads=8,
-            num_key_value_heads=8, max_position_embeddings=2048)
+            vocab_size=32000, hidden_size=3584, intermediate_size=9728,
+            num_hidden_layers=6, num_attention_heads=28,
+            num_key_value_heads=28, max_position_embeddings=2048)
         batch, seq, steps = 4, 2048, 10
     else:  # CI smoke path
         cfg = LlamaConfig.tiny()

@@ -90,7 +90,8 @@ class DistTrainStep:
             new_params = dict(params)
             new_opt = dict(opt_state)
             for k in trainable:
-                new_p, new_s = opt._update(params[k], grads[k],
+                g_k = opt._apply_regularizer(params[k], grads[k])
+                new_p, new_s = opt._update(params[k], g_k,
                                            opt_state[k], lr)
                 new_params[k] = new_p
                 new_opt[k] = new_s

@@ -18,7 +18,12 @@ __all__ = [
 
 
 def _norm(norm):
-    return norm if norm in ("forward", "ortho") else "backward"
+    if norm in (None, "backward"):
+        return "backward"
+    if norm in ("forward", "ortho"):
+        return norm
+    raise ValueError(
+        f"norm must be 'backward', 'forward', or 'ortho', got {norm!r}")
 
 
 def _wrap1(jfn):

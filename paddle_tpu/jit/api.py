@@ -262,7 +262,8 @@ class TrainStep:
             for k in trainable:
                 if hasattr(opt, "_current_pid"):
                     opt._current_pid = id(self._params[k])
-                new_p, new_s = opt._update(params[k], grads[k],
+                g_k = opt._apply_regularizer(params[k], grads[k])
+                new_p, new_s = opt._update(params[k], g_k,
                                            opt_state[k], lr)
                 new_params[k] = new_p
                 new_opt_state[k] = new_s

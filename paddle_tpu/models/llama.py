@@ -331,11 +331,8 @@ class LlamaPretrainingCriterion(Layer):
 
     def forward(self, logits, labels):
         def f(lg, lb):
-            lg = lg[:, :-1, :].astype(jnp.float32)
-            lb = lb[:, 1:]
-            logp = jax.nn.log_softmax(lg, axis=-1)
-            nll = -jnp.take_along_axis(logp, lb[..., None], axis=-1)
-            return jnp.mean(nll)
+            from ..ops.fused_ce import fused_softmax_ce_mean
+            return fused_softmax_ce_mean(lg[:, :-1, :], lb[:, 1:])
         return apply_op(f, logits, labels, op_name="causal_lm_loss")
 
 

@@ -1,0 +1,90 @@
+"""Memory-lean softmax cross-entropy for big-vocab LM heads.
+
+The reference fuses this on GPU as c_softmax_with_cross_entropy /
+fused kernels (ref: fluid/operators/collective/c_softmax_with_
+cross_entropy_op.cu, phi/kernels/fusion/). The naive XLA path materializes
+an fp32 [B, L, V] log-softmax and saves it for backward — ~4 GB at
+(8, 2047, 32000) — the top HBM allocation in the train step. This custom
+VJP instead:
+
+  fwd: scan over sequence chunks computing the per-position logsumexp and
+       target logit in fp32 — nothing [B, L, V]-sized in fp32, nothing
+       extra saved (residuals: the bf16 logits the caller already has,
+       labels, and the [B, L] lse);
+  bwd: scan over chunks emitting d_logits = (softmax - onehot) · g / N in
+       the logits dtype.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_softmax_ce_mean"]
+
+
+def _chunks(seq_len: int, target: int = 256) -> int:
+    """Largest chunk size <= target dividing seq_len (fallback: seq_len)."""
+    for c in range(min(target, seq_len), 0, -1):
+        if seq_len % c == 0:
+            return c
+    return seq_len
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def fused_softmax_ce_mean(logits, labels):
+    """mean over all positions of -log softmax(logits)[labels].
+    logits: [B, L, V] (any float dtype), labels: [B, L] int."""
+    loss, _ = _ce_fwd_impl(logits, labels)
+    return loss
+
+
+def _ce_fwd_impl(logits, labels):
+    b, l, v = logits.shape
+    c = _chunks(l)
+    lg = logits.reshape(b, l // c, c, v)
+    lb = labels.reshape(b, l // c, c)
+
+    def chunk(carry, xs):
+        lg_c, lb_c = xs  # [B, c, V], [B, c]
+        f = lg_c.astype(jnp.float32)
+        lse = jax.nn.logsumexp(f, axis=-1)               # [B, c]
+        tgt = jnp.take_along_axis(
+            f, lb_c[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return carry + jnp.sum(lse - tgt), lse
+
+    total, lses = jax.lax.scan(
+        chunk, jnp.float32(0.0),
+        (jnp.swapaxes(lg, 0, 1), jnp.swapaxes(lb, 0, 1)))
+    lse = jnp.swapaxes(lses, 0, 1).reshape(b, l)
+    return total / (b * l), lse
+
+
+def _ce_vjp_fwd(logits, labels):
+    loss, lse = _ce_fwd_impl(logits, labels)
+    return loss, (logits, labels, lse)
+
+
+def _ce_vjp_bwd(res, g):
+    logits, labels, lse = res
+    b, l, v = logits.shape
+    c = _chunks(l)
+    scale = g / (b * l)
+
+    def chunk(_, xs):
+        lg_c, lb_c, lse_c = xs
+        p = jnp.exp(lg_c.astype(jnp.float32) - lse_c[..., None])
+        onehot = jax.nn.one_hot(lb_c.astype(jnp.int32), v,
+                                dtype=jnp.float32)
+        return None, ((p - onehot) * scale).astype(logits.dtype)
+
+    _, dl = jax.lax.scan(
+        chunk, None,
+        (jnp.swapaxes(logits.reshape(b, l // c, c, v), 0, 1),
+         jnp.swapaxes(labels.reshape(b, l // c, c), 0, 1),
+         jnp.swapaxes(lse.reshape(b, l // c, c), 0, 1)))
+    return jnp.swapaxes(dl, 0, 1).reshape(b, l, v), None
+
+
+fused_softmax_ce_mean.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)

@@ -1,15 +1,16 @@
-"""Flash attention, Pallas-on-TPU.
+"""Flash attention, Pallas-on-TPU — forward AND backward kernels.
 
 TPU-native replacement for the reference's flash-attention wrapper
-(ref: paddle/phi/kernels/gpu/flash_attn_kernel.cu, which calls the vendored
-third_party/flashattn CUDA lib). Design: online-softmax tiling over the KV
-sequence so logits never materialize in HBM — the standard flash recipe —
-with block sizes aligned to the MXU (128) per the Pallas TPU guide.
+(ref: paddle/phi/kernels/gpu/flash_attn_kernel.cu fwd +
+flash_attn_grad_kernel.cu bwd, which call the vendored third_party/flashattn
+CUDA lib). Design: online-softmax tiling over the KV sequence so logits
+never materialize in HBM, with block sizes aligned to the MXU (128).
 
-Forward is the Pallas kernel; backward is a recompute-based VJP in plain
-XLA (flash bwd kernel is a later optimization; remat keeps memory flat).
-Falls back to the fused-XLA reference implementation when Pallas is
-unavailable (CPU mesh tests) or shapes don't tile.
+Forward emits the per-row logsumexp; backward uses the standard two-kernel
+flash recipe — a dq kernel tiled over Q blocks and a dk/dv kernel tiled
+over KV blocks, both re-computing P from (q, k, lse) so memory stays
+O(L·D) instead of O(L²). Falls back to a recompute-based XLA VJP when
+Pallas is unavailable (CPU mesh tests) or shapes don't tile.
 """
 from __future__ import annotations
 
@@ -45,10 +46,20 @@ def _sdpa_xla(q, k, v, causal=False, scale=None, mask=None):
     return jnp.swapaxes(out, 1, 2)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, seq_len,
-                  causal, scale):
-    """One (batch*head, q-block) program; inner loop tiles KV with online
-    softmax (running max m, normalizer l, accumulator acc)."""
+try:  # Pallas import is deferred-safe: CPU wheels ship it but TPU lowering
+    from jax.experimental import pallas as pl
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    pl = None
+    _HAS_PALLAS = False
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: one (batch*head, q-block) program; inner loop tiles KV
+# with online softmax; also emits logsumexp for the backward pass
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
+                seq_len, causal, scale):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
 
@@ -59,7 +70,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, seq_len,
     q_offset = qi * block_q
     num_k_blocks = seq_len // block_k
     if causal:
-        # only blocks at or before the diagonal contribute
         num_k_blocks_eff = (q_offset + block_q + block_k - 1) // block_k
     else:
         num_k_blocks_eff = num_k_blocks
@@ -84,24 +94,98 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, seq_len,
 
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks_eff, body, (m, l, acc))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
 
 
-try:  # Pallas import is deferred-safe: CPU wheels ship it but TPU lowering
-    from jax.experimental import pallas as pl
-    _HAS_PALLAS = True
-except Exception:  # pragma: no cover
-    pl = None
-    _HAS_PALLAS = False
+# ---------------------------------------------------------------------------
+# backward kernels (standard flash bwd algebra):
+#   P  = exp(scale·QKᵀ − lse)          (recomputed per tile)
+#   dV = Pᵀ @ dO
+#   dS = P ∘ (dO @ Vᵀ − Δ) · scale     with Δ = rowsum(dO ∘ O)
+#   dQ = dS @ K ;  dK = dSᵀ @ Q
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_q, block_k, seq_len, causal, scale):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]      # [block_q, 1]
+    delta = delta_ref[0]  # [block_q, 1]
+    q_offset = qi * block_q
+    if causal:
+        num_k_blocks_eff = (q_offset + block_q + block_k - 1) // block_k
+    else:
+        num_k_blocks_eff = seq_len // block_k
+
+    def body(ki, dq):
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = scale * (q @ k_blk.T)
+        p = jnp.exp(s - lse)
+        if causal:
+            q_ids = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(q_ids >= k_ids, p, 0.0)
+        dp = do @ v_blk.T
+        ds = p * (dp - delta) * scale
+        return dq + ds @ k_blk
+
+    dq = jax.lax.fori_loop(
+        0, num_k_blocks_eff, body,
+        jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, block_k, seq_len, causal,
+                    scale):
+    ki = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)      # [block_k, d]
+    v_blk = v_ref[0].astype(jnp.float32)
+    k_offset = ki * block_k
+    num_q_blocks = seq_len // block_q
+    # causal: only q blocks at or after this kv block contribute
+    q_start = k_offset // block_q if causal else 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
+        s = scale * (q_blk @ k_blk.T)         # [block_q, block_k]
+        p = jnp.exp(s - lse)
+        if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = k_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(q_ids >= k_ids, p, 0.0)
+        dv_new = dv + p.T @ do_blk
+        dp = do_blk @ v_blk.T
+        ds = p * (dp - delta) * scale
+        dk_new = dk + ds.T @ q_blk
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        q_start, num_q_blocks, body,
+        (jnp.zeros((block_k, k_blk.shape[-1]), jnp.float32),
+         jnp.zeros((block_k, v_blk.shape[-1]), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k"))
-def _flash_pallas_bhld(q, k, v, causal, scale, block_q=128, block_k=128):
-    """q,k,v: [BH, L, D] -> [BH, L, D]."""
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q=128, block_k=128):
+    """q,k,v: [BH, L, D] -> (out [BH, L, D], lse [BH, L])."""
     bh, seq_len, d = q.shape
     grid = (bh, seq_len // block_q)
     kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, seq_len=seq_len,
+        _fwd_kernel, block_q=block_q, block_k=block_k, seq_len=seq_len,
         causal=causal, scale=scale)
     return pl.pallas_call(
         kernel,
@@ -111,9 +195,68 @@ def _flash_pallas_bhld(q, k, v, causal, scale, block_q=128, block_k=128):
             pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
+        ],
+    )(q, k, v)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k"))
+def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, block_q=128,
+                      block_k=128):
+    """[BH, L, D] residuals + dO -> (dq, dk, dv)."""
+    bh, seq_len, d = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [BH, L, 1]
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, block_q=block_q, block_k=block_k, seq_len=seq_len,
+        causal=causal, scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, seq_len // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_len, d), q.dtype),
-    )(q, k, v)
+    )(q, k, v, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, block_q=block_q, block_k=block_k, seq_len=seq_len,
+        causal=causal, scale=scale)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, seq_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_len, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_len, d), v.dtype),
+        ],
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 def _tiles_ok(seq_len, d, block_q, block_k) -> bool:
@@ -121,34 +264,61 @@ def _tiles_ok(seq_len, d, block_q, block_k) -> bool:
             and d % 128 == 0 and seq_len >= block_q)
 
 
+def _use_pallas(l, d) -> bool:
+    return (_HAS_PALLAS and jax.default_backend() in ("tpu", "axon")
+            and _tiles_ok(l, d, 128, 128))
+
+
+def _to_bhld(x):
+    b, l, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, l, d)
+
+
+def _from_bhld(x, b, h):
+    bh, l, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h, l, d), 1, 2)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal=False, scale=None):
     """[B, L, H, D] in/out (paddle flash-attention layout)."""
-    return _flash_fwd_impl(q, k, v, causal, scale)
+    out, _ = _flash_fwd_res(q, k, v, causal, scale)
+    return out
 
 
-def _flash_fwd_impl(q, k, v, causal, scale):
+def _flash_fwd_res(q, k, v, causal, scale):
     b, l, h, d = q.shape
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    backend = jax.default_backend()
-    if _HAS_PALLAS and backend in ("tpu", "axon") and _tiles_ok(l, d, 128, 128):
-        def to_bhld(x):
-            return jnp.swapaxes(x, 1, 2).reshape(b * h, l, d)
-        out = _flash_pallas_bhld(to_bhld(q), to_bhld(k), to_bhld(v),
-                                 causal, s)
-        return jnp.swapaxes(out.reshape(b, h, l, d), 1, 2)
-    return _sdpa_xla(q, k, v, causal=causal, scale=s)
+    if _use_pallas(l, d):
+        out_bhld, lse = _flash_fwd_pallas(
+            _to_bhld(q), _to_bhld(k), _to_bhld(v), causal, s)
+        out = _from_bhld(out_bhld, b, h)
+        # residual keeps the blhd output (the array the caller holds
+        # anyway); bwd re-derives the bhld layout transiently — avoids
+        # pinning a second copy of every layer's attention output
+        return out, (out, lse)
+    return _sdpa_xla(q, k, v, causal=causal, scale=s), None
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale):
-    return _flash_fwd_impl(q, k, v, causal, scale), (q, k, v)
+    out, res = _flash_fwd_res(q, k, v, causal, scale)
+    return out, (q, k, v, res)
 
 
-def _flash_vjp_bwd(causal, scale, res, g):
-    # recompute-based backward in plain XLA; flat memory, MXU-friendly
-    q, k, v = res
+def _flash_vjp_bwd(causal, scale, residuals, g):
+    q, k, v, res = residuals
+    b, l, h, d = q.shape
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    if res is not None:  # pallas path: res = (out in blhd, lse)
+        out, lse = res
+        dq, dk, dv = _flash_bwd_pallas(
+            _to_bhld(q), _to_bhld(k), _to_bhld(v), _to_bhld(out), lse,
+            _to_bhld(g), causal, s)
+        return (_from_bhld(dq, b, h), _from_bhld(dk, b, h),
+                _from_bhld(dv, b, h))
+    # fallback: recompute-based XLA VJP
     _, vjp = jax.vjp(lambda a, b_, c: _sdpa_xla(a, b_, c, causal=causal,
-                                                scale=scale), q, k, v)
+                                                scale=s), q, k, v)
     return vjp(g)
 
 

@@ -30,13 +30,22 @@ class Optimizer:
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
+        self._regularizer = None  # non-L2 penalty applied to grads
         if isinstance(weight_decay, float):
             self._weight_decay = weight_decay
         elif weight_decay is None:
             self._weight_decay = 0.0
-        else:  # L2Decay-like object with a coeff
-            self._weight_decay = getattr(weight_decay, "_coeff",
-                                         getattr(weight_decay, "coeff", 0.0))
+        else:
+            from ..regularizer import L1Decay
+            if isinstance(weight_decay, L1Decay):
+                # L1 is NOT a coefficient-foldable decay: apply its grad
+                # penalty explicitly (ref: regularizer.py append to grad)
+                self._regularizer = weight_decay
+                self._weight_decay = 0.0
+            else:  # L2Decay-like object with a coeff
+                self._weight_decay = getattr(
+                    weight_decay, "_coeff",
+                    getattr(weight_decay, "coeff", 0.0))
         # per-param slot states keyed by id(param)
         self._states: Dict[int, Dict[str, Any]] = {}
         self._global_step = 0
@@ -68,6 +77,13 @@ class Optimizer:
     def _update(self, p, g, state, lr):
         raise NotImplementedError
 
+    def _apply_regularizer(self, p, g):
+        """Non-L2 grad penalty (e.g. L1Decay); pure, safe under jit. Called
+        by step() and the compiled train steps before _update."""
+        if self._regularizer is None:
+            return g
+        return self._regularizer(p, g)
+
     def _use_wd(self, p) -> float:
         return self._weight_decay
 
@@ -82,6 +98,7 @@ class Optimizer:
         lr = self.get_lr()
         for p, g in params_grads:
             gd = g._data if isinstance(g, Tensor) else g
+            gd = self._apply_regularizer(p._data, gd)
             state = self._state_for(p)
             self._cur_param = p  # lets _update consult Parameter metadata
             new_p, new_state = self._update(p._data, gd, state, lr)

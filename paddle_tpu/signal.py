@@ -64,9 +64,10 @@ def overlap_add(x, hop_length, axis=-1, name=None):
 
 
 def _full_window(window, n_fft: int, win_length: int, dtype):
-    if window is None:
-        return jnp.ones((n_fft,), dtype)
-    w = window.astype(dtype)
+    """Center a win_length window inside n_fft; window=None means a
+    rectangular window of win_length samples (NOT n_fft — ref contract)."""
+    w = jnp.ones((win_length,), dtype) if window is None \
+        else window.astype(dtype)
     wfull = jnp.zeros((n_fft,), dtype)
     off = (n_fft - win_length) // 2
     return wfull.at[off:off + win_length].set(w)
@@ -89,9 +90,8 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
             a = jnp.pad(a, [(0, 0), (pad, pad)], mode=pad_mode)
         idx = _frame_idx(a.shape[-1], n_fft, hop_length)
         frames = a[:, idx]                      # [B, num, n_fft]
-        if w is not None:
-            frames = frames * _full_window(w, n_fft, win_length,
-                                           a.dtype)[None, None, :]
+        frames = frames * _full_window(w, n_fft, win_length,
+                                       a.dtype)[None, None, :]
         spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
             jnp.fft.fft(frames, axis=-1)
         if normalized:
