@@ -284,6 +284,7 @@ class AdamW(Adam):
         for p, g in params_grads:
             self._current_pid = id(p)
             gd = g._data if isinstance(g, Tensor) else g
+            gd = self._apply_regularizer(p._data, gd)
             state = self._state_for(p)
             new_p, new_state = self._update(p._data, gd, state, lr)
             p._data = new_p

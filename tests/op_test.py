@@ -1,0 +1,130 @@
+"""OpTest: the reference's op-level test harness, TPU-native.
+
+ref: test/legacy_test/op_test.py:418 (OpTest.check_output :2139 — run the
+op, compare to a NumPy reference per dtype with per-dtype thresholds;
+check_grad :3129 — compare analytic gradients against central finite
+differences). Here the "op" is a framework callable over Tensors; each op
+is checked eagerly AND under jit (the dygraph/static dual of the
+reference), at fp32/bf16 with scaled tolerances.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+# per-dtype output tolerances (ref: op_accuracy thresholds — fp32 1e-5-ish,
+# bf16 ~1e-2 relative)
+_ATOL = {np.dtype(np.float32): 2e-5, np.dtype(np.float16): 2e-3,
+         np.dtype(jnp.bfloat16): 2e-2}
+_RTOL = {np.dtype(np.float32): 2e-5, np.dtype(np.float16): 2e-3,
+         np.dtype(jnp.bfloat16): 2e-2}
+
+
+class OpTest:
+    """Subclass and set:
+      op_fn(*tensors, **attrs) -> Tensor (the framework op)
+      ref_fn(*np_arrays, **attrs) -> np.ndarray (NumPy oracle)
+      inputs(): dict name -> np.ndarray (fp32)
+      attrs: dict of non-tensor kwargs (default {})
+      dtypes: dtypes to run (default fp32 + bf16)
+      grad_inputs: names to grad-check (default: all floating inputs)
+    """
+
+    op_fn: Callable = None
+    ref_fn: Callable = None
+    attrs: Dict = {}
+    dtypes = ("float32", "bfloat16")
+    grad_eps = 1e-3
+    grad_rtol = 5e-2  # central differences in fp32 (ref threshold 0.05)
+
+    def inputs(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    # -- check_output (ref: op_test.py:2139) --------------------------------
+    def test_check_output(self):
+        base = self.inputs()
+        for dtype in self.dtypes:
+            d = jnp.dtype(dtype)
+            arrs = {k: v.astype(d) if np.issubdtype(v.dtype, np.floating)
+                    else v for k, v in base.items()}
+            expect = type(self).ref_fn(
+                *[np.asarray(a, np.float32)
+                  if np.issubdtype(np.asarray(a).dtype, np.floating)
+                  else a for a in arrs.values()], **self.attrs)
+
+            # eager
+            tensors = [paddle.to_tensor(a) for a in arrs.values()]
+            got = type(self).op_fn(*tensors, **self.attrs)
+            self._compare(got.numpy(), expect, d, "eager")
+
+            # jit (the "static graph" leg of the reference's dual runs)
+            def raw(*xs):
+                return type(self).op_fn(
+                    *[Tensor(x) for x in xs], **self.attrs)._data
+            got_jit = jax.jit(raw)(*[t._data for t in tensors])
+            self._compare(np.asarray(got_jit), expect, d, "jit")
+
+    def _compare(self, got, expect, dtype, mode):
+        atol = _ATOL.get(np.dtype(dtype), 2e-5)
+        rtol = _RTOL.get(np.dtype(dtype), 2e-5)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(expect, np.float32),
+            atol=atol + 1e-8,
+            rtol=rtol,
+            err_msg=f"{type(self).__name__} {mode} {dtype} mismatch")
+
+    # -- check_grad (ref: op_test.py:3129) ----------------------------------
+    def test_check_grad(self):
+        base = self.inputs()
+        float_names = [k for k, v in base.items()
+                       if np.issubdtype(v.dtype, np.floating)]
+        names = list(getattr(self, "grad_inputs", float_names))
+        if not names:
+            return
+        arrs = {k: np.asarray(v, np.float32) for k, v in base.items()}
+
+        def scalar_loss(*xs):
+            out = type(self).op_fn(
+                *[Tensor(jnp.asarray(x)) for x in xs], **self.attrs)
+            return float((out * out).sum().numpy() / 2)
+
+        # analytic grads via the framework's eager backward
+        tensors = [paddle.to_tensor(arrs[k],
+                                    stop_gradient=k not in names)
+                   for k in arrs]
+        out = type(self).op_fn(*tensors, **self.attrs)
+        ((out * out).sum() * 0.5).backward()
+
+        for idx, k in enumerate(arrs):
+            if k not in names:
+                continue
+            analytic = tensors[idx].grad.numpy()
+            numeric = self._numeric_grad(scalar_loss, list(arrs.values()),
+                                         idx)
+            denom = np.maximum(np.abs(numeric), 1.0)
+            err = np.abs(analytic - numeric) / denom
+            assert err.max() < self.grad_rtol, (
+                f"{type(self).__name__} grad({k}): max rel err "
+                f"{err.max():.4f} (analytic vs central differences)")
+
+    def _numeric_grad(self, loss, args, idx):
+        """Central finite differences (ref: op_test get_numeric_gradient)."""
+        x = args[idx]
+        g = np.zeros_like(x)
+        flat = x.reshape(-1)
+        gf = g.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + self.grad_eps
+            fp = loss(*args)
+            flat[i] = orig - self.grad_eps
+            fm = loss(*args)
+            flat[i] = orig
+            gf[i] = (fp - fm) / (2 * self.grad_eps)
+        return g

@@ -264,3 +264,17 @@ class TestRegularizer:
         opt.step()
         # pure L1: p -= lr * coeff * sign(p) -> [1.5, -2.5]
         np.testing.assert_allclose(p.numpy(), [1.5, -2.5], atol=1e-6)
+
+    def test_l1_applied_in_adamw_step(self):
+        """Regression: AdamW.step() override missed _apply_regularizer."""
+        from paddle_tpu.regularizer import L1Decay
+        p = paddle.Parameter(np.array([2.0, -3.0], np.float32))
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[p],
+                                     weight_decay=L1Decay(0.5))
+        p.grad = paddle.to_tensor(np.zeros(2, np.float32))
+        before = p.numpy().copy()
+        opt.step()
+        after = p.numpy()
+        # L1 penalty must move both entries toward zero
+        assert abs(after[0]) < abs(before[0])
+        assert abs(after[1]) < abs(before[1])

@@ -1,0 +1,187 @@
+"""Op-level tests through the OpTest harness (ref: the per-op tests in
+test/legacy_test/test_*_op.py, e.g. test_matmul_v2_op.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import OpTest
+
+
+def _rng():
+    return np.random.default_rng(0)
+
+
+class TestMatmulOp(OpTest):
+    op_fn = staticmethod(paddle.matmul)
+    ref_fn = staticmethod(lambda x, y: x @ y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(4, 8)).astype(np.float32),
+                "y": r.normal(size=(8, 6)).astype(np.float32)}
+
+
+class TestMatmulBatchedOp(OpTest):
+    op_fn = staticmethod(paddle.matmul)
+    ref_fn = staticmethod(lambda x, y: x @ y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(2, 4, 8)).astype(np.float32),
+                "y": r.normal(size=(2, 8, 3)).astype(np.float32)}
+
+
+class TestAddOp(OpTest):
+    op_fn = staticmethod(paddle.add)
+    ref_fn = staticmethod(np.add)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 4)).astype(np.float32),
+                "y": r.normal(size=(3, 4)).astype(np.float32)}
+
+
+class TestMulBroadcastOp(OpTest):
+    op_fn = staticmethod(paddle.multiply)
+    ref_fn = staticmethod(np.multiply)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 1, 4)).astype(np.float32),
+                "y": r.normal(size=(5, 1)).astype(np.float32)}
+
+
+class TestExpOp(OpTest):
+    op_fn = staticmethod(paddle.exp)
+    ref_fn = staticmethod(np.exp)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(4, 4)).astype(np.float32)}
+
+
+class TestTanhOp(OpTest):
+    op_fn = staticmethod(paddle.tanh)
+    ref_fn = staticmethod(np.tanh)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(16,)).astype(np.float32)}
+
+
+class TestSigmoidOp(OpTest):
+    op_fn = staticmethod(F.sigmoid)
+    ref_fn = staticmethod(lambda x: 1 / (1 + np.exp(-x)))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(8, 3)).astype(np.float32)}
+
+
+class TestSoftmaxOp(OpTest):
+    op_fn = staticmethod(F.softmax)
+    ref_fn = staticmethod(
+        lambda x: np.exp(x - x.max(-1, keepdims=True)) /
+        np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(4, 7)).astype(np.float32)}
+
+
+class TestMeanOp(OpTest):
+    op_fn = staticmethod(paddle.mean)
+    ref_fn = staticmethod(np.mean)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(5, 6)).astype(np.float32)}
+
+
+class TestSumAxisOp(OpTest):
+    op_fn = staticmethod(paddle.sum)
+    ref_fn = staticmethod(lambda x, axis: np.sum(x, axis=axis))
+    attrs = {"axis": 1}
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 5, 2)).astype(np.float32)}
+
+
+class TestTransposeOp(OpTest):
+    op_fn = staticmethod(paddle.transpose)
+    ref_fn = staticmethod(lambda x, perm: np.transpose(x, perm))
+    attrs = {"perm": [1, 0, 2]}
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 4, 2)).astype(np.float32)}
+
+
+class TestReshapeOp(OpTest):
+    op_fn = staticmethod(paddle.reshape)
+    ref_fn = staticmethod(lambda x, shape: np.reshape(x, shape))
+    attrs = {"shape": [8, 3]}
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(4, 6)).astype(np.float32)}
+
+
+class TestConcatOp(OpTest):
+    op_fn = staticmethod(lambda x, y, axis=0: paddle.concat([x, y], axis))
+    ref_fn = staticmethod(
+        lambda x, y, axis=0: np.concatenate([x, y], axis))
+    attrs = {"axis": 1}
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(2, 3)).astype(np.float32),
+                "y": r.normal(size=(2, 5)).astype(np.float32)}
+
+
+class TestLayerNormOp(OpTest):
+    op_fn = staticmethod(
+        lambda x, w, b: F.layer_norm(x, [6], weight=w, bias=b))
+
+    @staticmethod
+    def ref_fn(x, w, b):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+    dtypes = ("float32",)  # bf16 layernorm tolerance is model-level
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(4, 6)).astype(np.float32),
+                "w": r.normal(size=(6,)).astype(np.float32),
+                "b": r.normal(size=(6,)).astype(np.float32)}
+
+
+class TestGeluOp(OpTest):
+    op_fn = staticmethod(F.gelu)
+
+    @staticmethod
+    def ref_fn(x):
+        from scipy.special import erf  # pragma: no cover - fallback below
+        return 0.5 * x * (1 + erf(x / np.sqrt(2)))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(10,)).astype(np.float32)}
+
+    def test_check_output(self):
+        try:
+            import scipy  # noqa: F401
+        except ImportError:
+            import math
+            type(self).ref_fn = staticmethod(
+                lambda x: np.asarray([0.5 * v * (1 + math.erf(v / 2 ** 0.5))
+                                      for v in x.reshape(-1)],
+                                     np.float32).reshape(x.shape))
+        super().test_check_output()
+
+
+class TestWhereOp(OpTest):
+    op_fn = staticmethod(paddle.where)
+    ref_fn = staticmethod(np.where)
+    grad_inputs = ["x", "y"]
+
+    def inputs(self):
+        r = _rng()
+        return {"cond": r.random(size=(4, 4)) > 0.5,
+                "x": r.normal(size=(4, 4)).astype(np.float32),
+                "y": r.normal(size=(4, 4)).astype(np.float32)}
