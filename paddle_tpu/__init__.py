@@ -66,6 +66,9 @@ from . import quantization  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import incubate  # noqa: F401
 from . import models  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import utils  # noqa: F401
 # NOTE: paddle_tpu.profiler is intentionally NOT imported here — it pulls
 # in the native extension, whose first import compiles C++; users import
 # it explicitly (matching `import paddle.profiler` usage).

@@ -1,0 +1,179 @@
+"""paddle.audio equivalent: spectrogram/mel/MFCC features.
+
+ref: python/paddle/audio/ — functional (hz_to_mel/mel_to_hz/
+compute_fbank_matrix/create_dct, functional/functional.py) and features
+(Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC, features/layers.py).
+Built on paddle_tpu.signal.stft so features compile into the same XLA
+program as the model consuming them.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.autograd import apply_op
+from .core.tensor import Tensor
+from .nn.layer import Layer
+from . import signal as _signal
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "compute_fbank_matrix", "create_dct",
+    "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """ref: audio/functional/functional.py hz_to_mel (slaney default)."""
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return out if out.shape else float(out)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return out if out.shape else float(out)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm: str = "slaney"):
+    """[n_mels, n_fft//2+1] triangular mel filter bank (ref: functional.py
+    compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fft_freqs = np.linspace(0, sr / 2.0, n_fft // 2 + 1)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = np.asarray([mel_to_hz(m, htk) for m in mel_pts])
+    fb = np.zeros((n_mels, n_fft // 2 + 1), np.float32)
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str = "ortho"):
+    """[n_mels, n_mfcc] DCT-II matrix (ref: functional.py create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k).astype(np.float32)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.T))
+
+
+class Spectrogram(Layer):
+    """ref: audio/features/layers.py Spectrogram — |STFT|^power."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        if window == "hann":
+            w = jnp.asarray(np.hanning(self.win_length).astype(np.float32))
+        elif window == "hamming":
+            w = jnp.asarray(np.hamming(self.win_length).astype(np.float32))
+        else:
+            w = jnp.ones((self.win_length,), jnp.float32)
+        self.window = Tensor(w)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length,
+                            self.win_length, self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+        return apply_op(
+            lambda s: jnp.abs(s) ** self.power, spec, op_name="spec_power")
+
+
+class MelSpectrogram(Layer):
+    """ref: features/layers.py MelSpectrogram."""
+
+    def __init__(self, sr=16000, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, n_mels=64,
+                 f_min=0.0, f_max=None):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)   # [..., freq, time]
+        return apply_op(lambda s, fb: jnp.einsum("...ft,mf->...mt", s, fb),
+                        spec, self.fbank, op_name="mel_fbank")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=16000, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, n_mels=64,
+                 f_min=0.0, f_max=None, ref_value=1.0, amin=1e-10,
+                 top_db=None):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, n_mels, f_min, f_max)
+        self.amin = amin
+        self.ref_value = ref_value
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+
+        def f(s):
+            log_spec = 10.0 * jnp.log10(jnp.maximum(s, self.amin)
+                                        / self.ref_value)
+            if self.top_db is not None:
+                log_spec = jnp.maximum(log_spec,
+                                       log_spec.max() - self.top_db)
+            return log_spec
+
+        return apply_op(f, m, op_name="log_mel")
+
+
+class MFCC(Layer):
+    """ref: features/layers.py MFCC = DCT(log-mel)."""
+
+    def __init__(self, sr=16000, n_mfcc=40, n_fft=512, n_mels=64, **kw):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, n_fft=n_fft, n_mels=n_mels,
+                                         **kw)
+        self.dct = create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        lm = self.log_mel(x)         # [..., n_mels, time]
+        return apply_op(lambda s, d: jnp.einsum("...mt,mk->...kt", s, d),
+                        lm, self.dct, op_name="mfcc_dct")

@@ -1,0 +1,12 @@
+"""Semi-auto / static auto-parallel.
+
+ref: python/paddle/distributed/auto_parallel/ — the dygraph API
+(shard_tensor/reshard, re-exported from distributed.api) + the static
+Engine (static/engine.py:100). Under XLA the "static" pipeline is the
+same jit; Engine is the orchestration wrapper.
+"""
+from ..api import (  # noqa: F401
+    DistAttr, dtensor_from_fn, reshard, shard_layer, shard_parameter,
+    shard_tensor, unshard_dtensor,
+)
+from .engine import Engine, Strategy  # noqa: F401

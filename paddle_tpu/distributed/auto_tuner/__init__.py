@@ -1,0 +1,9 @@
+"""Auto-tuner: black-box search over parallel configurations.
+
+ref: python/paddle/distributed/auto_tuner/{tuner,search,prune,recorder}.py
+— enumerate (dp, mp, pp, sharding-stage, micro-batch) candidates, prune
+infeasible ones (divisibility, memory model), run timed trials, record and
+rank. The TPU build reuses the same harness shape with a mesh-aware
+candidate space; trials are callables so tests can stub the runner.
+"""
+from .tuner import AutoTuner, Prune, Recorder, SearchSpace  # noqa: F401

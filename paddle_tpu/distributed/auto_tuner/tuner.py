@@ -1,0 +1,144 @@
+"""Auto-tuner core.
+
+ref: auto_tuner/tuner.py:21 (AutoTuner: search_once/get_best loop),
+search.py (GridSearch over the cartesian candidate space), prune.py
+(registered prune rules), recorder.py (sorted history + best).
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["SearchSpace", "Prune", "Recorder", "AutoTuner"]
+
+
+@dataclass
+class SearchSpace:
+    """Candidate axes (ref: the tuner's default space over hybrid dims)."""
+    num_devices: int = 8
+    dp_degree: Sequence[int] = (1, 2, 4, 8)
+    mp_degree: Sequence[int] = (1, 2, 4, 8)
+    pp_degree: Sequence[int] = (1, 2, 4)
+    sharding_degree: Sequence[int] = (1, 2, 4, 8)
+    sharding_stage: Sequence[int] = (1, 2, 3)
+    micro_batch_size: Sequence[int] = (1, 2, 4, 8)
+    global_batch_size: int = 8
+    num_layers: int = 24
+
+    def candidates(self) -> List[Dict]:
+        out = []
+        for dp, mp, pp, sh_deg, sh_st, mbs in itertools.product(
+                self.dp_degree, self.mp_degree, self.pp_degree,
+                self.sharding_degree, self.sharding_stage,
+                self.micro_batch_size):
+            out.append({
+                "dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                "sharding_degree": sh_deg, "sharding_stage": sh_st,
+                "micro_batch_size": mbs,
+            })
+        return out
+
+
+class Prune:
+    """Registered prune rules (ref: prune.py @register_prune functions).
+    Each rule returns True if the candidate should be DROPPED."""
+
+    def __init__(self, space: SearchSpace):
+        self.space = space
+        self.rules: List[Callable[[Dict], bool]] = [
+            self._prune_by_device_product,
+            self._prune_by_batch_divisibility,
+            self._prune_by_layer_divisibility,
+            self._prune_sharding_with_dp,
+            self._prune_degenerate_sharding_stage,
+        ]
+
+    def _prune_by_device_product(self, c) -> bool:
+        # dp*mp*pp*sharding must cover exactly the device count
+        return (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                * c["sharding_degree"]) != self.space.num_devices
+
+    def _prune_by_batch_divisibility(self, c) -> bool:
+        per_dp = self.space.global_batch_size / (
+            c["dp_degree"] * c["sharding_degree"])
+        if per_dp != int(per_dp) or per_dp < 1:
+            return True
+        return int(per_dp) % c["micro_batch_size"] != 0
+
+    def _prune_by_layer_divisibility(self, c) -> bool:
+        return self.space.num_layers % c["pp_degree"] != 0
+
+    def _prune_degenerate_sharding_stage(self, c) -> bool:
+        # stages are indistinguishable at sharding_degree 1: keep only
+        # stage 1 so duplicate configs aren't trialed repeatedly
+        return c["sharding_degree"] == 1 and c["sharding_stage"] > 1
+
+    def _prune_sharding_with_dp(self, c) -> bool:
+        # stage-3 with plain dp>1 duplicates params per dp replica for no
+        # benefit (ref prune rule: prefer folding dp into sharding)
+        return c["sharding_stage"] == 3 and c["dp_degree"] > 1
+
+    def keep(self, c: Dict) -> bool:
+        return not any(rule(c) for rule in self.rules)
+
+
+@dataclass
+class Recorder:
+    """ref: recorder.py — history sorted by the metric (lower=better time
+    or higher=better throughput)."""
+    higher_is_better: bool = True
+    history: List[Dict] = field(default_factory=list)
+
+    def add(self, cfg: Dict, metric: Optional[float], error: str = ""):
+        self.history.append({"config": cfg, "metric": metric,
+                             "error": error})
+
+    def best(self) -> Optional[Dict]:
+        ok = [h for h in self.history if h["metric"] is not None]
+        if not ok:
+            return None
+        return (max if self.higher_is_better else min)(
+            ok, key=lambda h: h["metric"])
+
+
+class AutoTuner:
+    """ref: tuner.py:21. trial_fn(config) -> metric (throughput); raise to
+    mark the config failed (e.g. OOM)."""
+
+    def __init__(self, space: SearchSpace,
+                 trial_fn: Callable[[Dict], float],
+                 higher_is_better: bool = True,
+                 max_trials: Optional[int] = None):
+        self.space = space
+        self.trial_fn = trial_fn
+        self.prune = Prune(space)
+        self.recorder = Recorder(higher_is_better)
+        self.max_trials = max_trials
+        self._pending = [c for c in space.candidates()
+                         if self.prune.keep(c)]
+
+    @property
+    def pending(self) -> List[Dict]:
+        return list(self._pending)
+
+    def search_once(self) -> Optional[Dict]:
+        """Run the next candidate; returns its record or None when done."""
+        if not self._pending:
+            return None
+        if self.max_trials is not None and \
+                len(self.recorder.history) >= self.max_trials:
+            return None
+        cfg = self._pending.pop(0)
+        try:
+            metric = self.trial_fn(cfg)
+            self.recorder.add(cfg, float(metric))
+        except Exception as e:  # trial failure (OOM...) is data, not fatal
+            self.recorder.add(cfg, None, error=f"{type(e).__name__}: {e}")
+        return self.recorder.history[-1]
+
+    def tune(self) -> Optional[Dict]:
+        """Run all candidates (up to max_trials); returns the best record."""
+        while self.search_once() is not None:
+            pass
+        return self.recorder.best()

@@ -1,0 +1,151 @@
+"""Megatron sequence parallelism: activations sharded along the sequence
+axis inside the TP group.
+
+ref: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp (:42-127), ColumnSequenceParallelLinear (:427),
+RowSequenceParallelLinear (:562), register_sequence_parallel_allreduce
+(:192). TPU-native: the scatter/all-gather/reduce-scatter choreography is
+*placement* — a with_sharding_constraint on the sequence dim before/after
+the sharded matmuls; GSPMD inserts the same collectives the reference
+issues manually, and fuses them with the matmuls where profitable.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ..api import shard_parameter
+from .mp_layers import _current_mp_mesh
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "mark_as_sequence_parallel_parameter",
+]
+
+
+def _seq_axis_name() -> Optional[str]:
+    mesh = _current_mp_mesh()
+    if mesh is None:
+        return None
+    if "sp" in mesh.dim_names:
+        return "sp"
+    if "mp" in mesh.dim_names:
+        return "mp"  # reference: SP reuses the TP group
+    return None
+
+
+def _constrain(x, dim: Optional[int], axis: Optional[str]):
+    """Sharding constraint on one dim (None axis or no trace: identity).
+    Errors inside a traced program (bad axis name etc.) surface — a
+    swallowed constraint would make SP a silent no-op."""
+    if axis is None:
+        return x
+
+    def f(a):
+        if not isinstance(a, jax.core.Tracer):
+            return a  # eager arrays already have a concrete placement
+        spec = [None] * a.ndim
+        if dim is not None and a.ndim > dim:
+            spec[dim] = axis
+        return jax.lax.with_sharding_constraint(a, P(*spec))
+    return apply_op(f, x, op_name="sharding_constraint")
+
+
+def _constrain_seq(x, shard: bool):
+    """Constrain activation sharding along dim 1 (sequence)."""
+    return _constrain(x, 1 if shard else None, _seq_axis_name())
+
+
+class ScatterOp:
+    """ref: sequence_parallel_utils.py ScatterOp — split along seq."""
+
+    @staticmethod
+    def apply(x):
+        return _constrain_seq(x, shard=True)
+
+
+class GatherOp:
+    """ref: GatherOp — all-gather along seq."""
+
+    @staticmethod
+    def apply(x):
+        return _constrain_seq(x, shard=False)
+
+
+AllGatherOp = GatherOp
+ReduceScatterOp = ScatterOp
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """ref: :192 register_sequence_parallel_allreduce — under GSPMD the
+    gradient reduction falls out of the sharded program; the mark is kept
+    for API parity."""
+    param._sequence_parallel = True
+    return param
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """ref: :427 — input arrives seq-sharded, is (implicitly) gathered for
+    the column-parallel matmul; output stays TP-sharded on features."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+        mesh = _current_mp_mesh()
+        if mesh is not None:
+            shard_parameter(self.weight, mesh, tp_axis="mp", tp_dim=1)
+            if self.bias is not None:
+                shard_parameter(self.bias, mesh, tp_axis="mp", tp_dim=0)
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        x = GatherOp.apply(x)          # [B, L/sp, H] -> full seq
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            # all-gather the TP-sharded feature dim (ref: gather_output)
+            mesh = _current_mp_mesh()
+            if mesh is not None and "mp" in mesh.dim_names:
+                out = _constrain(out, None, "mp")
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """ref: :562 — row-parallel matmul whose partial outputs reduce-scatter
+    back onto the sequence axis."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+        mesh = _current_mp_mesh()
+        if mesh is not None:
+            shard_parameter(self.weight, mesh, tp_axis="mp", tp_dim=0)
+        self.input_is_parallel = input_is_parallel
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            # split the full input's feature dim across the TP group
+            # (ref: input_is_parallel=False path)
+            mesh = _current_mp_mesh()
+            if mesh is not None and "mp" in mesh.dim_names:
+                x = _constrain(x, x.ndim - 1, "mp")
+        out = F.linear(x, self.weight, self.bias)
+        return ScatterOp.apply(out)    # reduce-scatter onto seq axis

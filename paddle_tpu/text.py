@@ -1,0 +1,97 @@
+"""paddle.text equivalent: sequence-labeling decode ops.
+
+ref: python/paddle/text/viterbi_decode.py (ViterbiDecoder layer +
+viterbi_decode functional over the CRF transition matrix; native op
+phi/kernels/cpu/viterbi_decode_kernel.cc). The dataset zoo in the
+reference's paddle.text is download-based and out of scope in a
+zero-egress environment.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.autograd import apply_op
+from .core.tensor import Tensor
+from .nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """CRF Viterbi decode. potentials: [B, T, N] emission scores,
+    transition: [N, N]; returns (scores [B], paths [B, T]).
+
+    ref: text/viterbi_decode.py viterbi_decode — with include_bos_eos_tag
+    the last two tags are BOS/EOS (reference convention: transition from
+    BOS starts the sequence, transition to EOS ends it).
+    """
+    def impl(pot, trans, *len_arr):
+        b, t, n = pot.shape
+        if include_bos_eos_tag:
+            bos, eos = n - 2, n - 1
+            init = pot[:, 0] + trans[bos][None, :]
+        else:
+            init = pot[:, 0]
+        lens = len_arr[0] if len_arr else jnp.full((b,), t, jnp.int32)
+
+        def step(carry, xs):
+            emit, t_idx = xs
+            score = carry                      # [B, N]
+            # [B, N_prev, N_next]
+            cand = score[:, :, None] + trans[None] + emit[:, None, :]
+            best = cand.max(axis=1)
+            back = cand.argmax(axis=1).astype(jnp.int32)
+            # padded steps (t_idx >= length) freeze the score and record
+            # an identity backpointer so the path parks on the last tag
+            active = (t_idx < lens)[:, None]
+            best = jnp.where(active, best, score)
+            ident = jnp.broadcast_to(
+                jnp.arange(n, dtype=jnp.int32)[None, :], (b, n))
+            back = jnp.where(active, back, ident)
+            return best, back
+
+        scores, backs = jax.lax.scan(
+            step, init,
+            (jnp.swapaxes(pot[:, 1:], 0, 1),
+             jnp.arange(1, t, dtype=jnp.int32)))
+        if include_bos_eos_tag:
+            scores = scores + trans[:, eos][None, :]
+        last = scores.argmax(axis=-1).astype(jnp.int32)   # [B]
+        final_scores = scores.max(axis=-1)
+
+        def backtrack(carry, back_t):
+            tag = carry
+            prev = jnp.take_along_axis(back_t, tag[:, None], 1)[:, 0]
+            return prev, tag
+
+        # reverse scan emits ys[t] = tag at step t+1 (stacked in forward
+        # index order); the final carry is the step-0 tag
+        first, ys = jax.lax.scan(backtrack, last, backs, reverse=True)
+        paths = jnp.concatenate(
+            [first[:, None], jnp.swapaxes(ys, 0, 1)], axis=1)
+        if len_arr:  # zero the padded tail (reference masks by length)
+            paths = jnp.where(
+                jnp.arange(t)[None, :] < lens[:, None], paths, 0)
+        return final_scores, paths
+
+    args = (potentials, transition)
+    if lengths is not None:
+        args = args + (lengths,)
+    return apply_op(impl, *args, op_name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """ref: text/viterbi_decode.py ViterbiDecoder(transitions)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,1 +1,3 @@
 from . import clip_grad  # noqa: F401
+from . import custom_op  # noqa: F401
+from .custom_op import register_op  # noqa: F401

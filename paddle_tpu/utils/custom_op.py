@@ -1,0 +1,79 @@
+"""Custom-op registration: user kernels joined to the framework op surface.
+
+ref: paddle/phi/api/ext/op_meta_info.h PD_BUILD_OP +
+fluid/framework/custom_operator.cc + python/paddle/utils/cpp_extension/
+(JIT-built C++ ops). The TPU equivalent of "bring your own kernel" is a
+Pallas kernel (or any pure JAX function): register it with an optional
+custom VJP and it becomes `paddle_tpu.ops.<name>`, differentiable through
+the eager tape and traceable under jit — the same contract the
+reference's custom ops get from the eager engine.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+
+from ..core.autograd import apply_op
+
+__all__ = ["CustomOp", "register_op", "get_op"]
+
+_REGISTRY: Dict[str, "CustomOp"] = {}
+
+
+class CustomOp:
+    def __init__(self, name: str, fn: Callable,
+                 vjp: Optional[Callable] = None):
+        self.name = name
+        self._has_vjp = vjp is not None
+        if vjp is not None:
+            raw = jax.custom_vjp(fn)
+            raw.defvjp(lambda *args: (fn(*args), args),
+                       lambda res, g: vjp(res, g))
+            self._fn = raw
+        else:
+            self._fn = fn
+
+    def __call__(self, *tensors, **kwargs):
+        if self._has_vjp and kwargs:
+            # jax.custom_vjp folds kwargs into the primal tuple, breaking
+            # the "one gradient per positional input" contract
+            raise ValueError(
+                f"custom op {self.name!r} has a custom vjp and must be "
+                "called with positional arguments only")
+        return apply_op(self._fn, *tensors, op_name=self.name, **kwargs)
+
+
+def register_op(name: str, fn: Callable = None, *,
+                vjp: Optional[Callable] = None,
+                override: bool = False):
+    """Register `fn` (pure JAX, arrays in/out) as op `name`.
+
+    vjp(saved_inputs, cotangent) -> tuple of input gradients; omit it to
+    let JAX differentiate through fn. Usable as a decorator:
+
+        @register_op("my_norm")
+        def my_norm(x): ...
+
+    The op lands on paddle_tpu.ops.<name> (ref: custom ops appearing under
+    paddle._C_ops after PD_BUILD_OP registration).
+    """
+    def _do(f):
+        from .. import ops as ops_module
+        if not override and (name in _REGISTRY
+                             or hasattr(ops_module, name)):
+            raise ValueError(
+                f"op {name!r} already exists (pass override=True to "
+                "replace it deliberately)")
+        op = CustomOp(name, f, vjp)
+        _REGISTRY[name] = op
+        setattr(ops_module, name, op)
+        return op
+
+    if fn is not None:
+        return _do(fn)
+    return _do
+
+
+def get_op(name: str) -> CustomOp:
+    return _REGISTRY[name]
