@@ -1,0 +1,146 @@
+"""Inference engine: saved model -> compiled serving predictor.
+
+ref: paddle/fluid/inference/api/analysis_predictor.h (AnalysisPredictor:
+load program+params, run analysis/fusion passes, zero-copy IO) and
+python/paddle/inference (Config + create_predictor). The TPU analog: the
+"analysis passes + fusion" role belongs to XLA — a Predictor functionalizes
+the model, jit-compiles forward per input signature (shape/dtype-keyed
+cache), and serves batches. Saved artifacts are paddle.jit.save outputs:
+state_dict + a model-factory reference, so a server process can
+reconstruct without the training script.
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+from .framework.io import load as _load, save as _save
+from .jit.api import functionalize
+
+__all__ = ["Config", "Predictor", "create_predictor", "save_inference_model",
+           "load_inference_model"]
+
+
+def save_inference_model(path: str, model, input_spec=None):
+    """ref: paddle.static.save_inference_model / jit.save — persist params
+    plus the importable factory so inference can rebuild the module.
+    input_spec (shapes/dtypes) is stored for consumers that pre-compile."""
+    cls = type(model)
+    payload = {
+        "state_dict": model.state_dict(),
+        "module": cls.__module__,
+        "class_name": cls.__qualname__,
+        "init_config": getattr(model, "config", None),
+        "input_spec": [
+            {"shape": list(s.shape), "dtype": str(s.dtype)}
+            for s in (input_spec or [])
+        ],
+    }
+    _save(payload, path + ".pdmodel")
+
+
+def load_inference_model(path: str):
+    """Rebuild the Layer from a save_inference_model artifact. Raises if
+    the reconstructed module's parameters don't match the checkpoint —
+    serving silently-random weights is the worst failure mode."""
+    payload = _load(path + ".pdmodel", return_numpy=False)
+    mod = importlib.import_module(payload["module"])
+    cls = mod
+    for part in payload["class_name"].split("."):
+        cls = getattr(cls, part)
+    cfg = payload["init_config"]
+    model = cls(cfg) if cfg is not None else cls()
+    missing, unexpected = model.set_state_dict(payload["state_dict"])
+    if missing or unexpected:
+        raise ValueError(
+            f"saved model does not match reconstructed "
+            f"{payload['class_name']}: missing={missing[:5]}, "
+            f"unexpected={unexpected[:5]} (models whose __init__ needs "
+            "arguments must expose them as a .config attribute)")
+    model.eval()
+    return model
+
+
+class Config:
+    """ref: paddle.inference.Config — carries the model path + runtime
+    options (the CUDA/TensorRT knobs become XLA-level choices here)."""
+
+    def __init__(self, model_path: Optional[str] = None):
+        self.model_path = model_path
+        self._bf16 = False
+
+    def enable_bf16(self):
+        self._bf16 = True
+
+    # GPU-era knobs kept as accepted no-ops for API compatibility (XLA
+    # already does the fusion/memory planning these toggled)
+    def enable_memory_optim(self, *a, **k):
+        return None
+
+    def enable_use_gpu(self, *a, **k):
+        return None
+
+    def switch_ir_optim(self, *a, **k):
+        return None
+
+
+class Predictor:
+    """Compiled serving wrapper (ref: AnalysisPredictor::Run contract:
+    named inputs in, named outputs out, internal exec state reused)."""
+
+    def __init__(self, model_or_config):
+        if isinstance(model_or_config, Config):
+            cfg = model_or_config
+            if cfg.model_path is None:
+                raise ValueError(
+                    "Config has no model_path; pass Config(path) pointing "
+                    "at a save_inference_model artifact")
+            model = load_inference_model(cfg.model_path)
+            if cfg._bf16:
+                model.bfloat16()
+        else:
+            model = model_or_config
+            model.eval()
+        self.model = model
+        apply, params, buffers = functionalize(model)
+        self._apply = apply
+        self._params = params
+        self._buffers = buffers
+
+        def fwd(params, buffers, *args):
+            out, _ = apply(params, buffers, *args)
+            return out
+
+        self._jitted = jax.jit(fwd)  # shape/dtype-keyed compile cache
+
+    def run(self, *inputs):
+        """numpy/Tensor inputs -> list of numpy outputs (zero extra copies
+        beyond host->device)."""
+        raw = [i._data if isinstance(i, Tensor) else jnp.asarray(
+            np.asarray(i)) for i in inputs]
+        out = self._jitted(self._params, self._buffers, *raw)
+        if isinstance(out, (tuple, list)):
+            return [np.asarray(o) for o in out]
+        return [np.asarray(out)]
+
+    # reference-style named-handle API: names come from the model's
+    # forward signature
+    def get_input_names(self) -> Sequence[str]:
+        sig = inspect.signature(self.model.forward)
+        return [n for n, p in sig.parameters.items()
+                if p.default is inspect.Parameter.empty
+                and p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+
+    def predict(self, *inputs):
+        return self.run(*inputs)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """ref: paddle.inference.create_predictor."""
+    return Predictor(config)

@@ -299,14 +299,16 @@ class TrainStep:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — persists params + a loadable program description.
-    ref: python/paddle/jit/api.py save. v1: state_dict + class info."""
-    from ..framework.io import save as _save
-    state = {"state_dict": layer.state_dict(),
-             "layer_class": type(layer).__name__}
-    _save(state, path + ".pdparams")
+    """paddle.jit.save — persists params + the importable factory so load
+    reconstructs a runnable Layer (ref: python/paddle/jit/api.py save /
+    TranslatedLayer). Shares the .pdmodel format with
+    paddle_tpu.inference.save_inference_model."""
+    from ..inference import save_inference_model
+    save_inference_model(path, layer, input_spec=input_spec)
 
 
 def load(path, **configs):
-    from ..framework.io import load as _load
-    return _load(path + ".pdparams")
+    """Returns a reconstructed Layer in eval mode (ref: jit.load →
+    TranslatedLayer)."""
+    from ..inference import load_inference_model
+    return load_inference_model(path)

@@ -193,6 +193,47 @@ class TestEngineModePreserved:
         assert not m.training  # was eval before, stays eval
 
 
+class TestMultiPrecision:
+    def test_bf16_moments_halve_state_and_track_fp32(self, rng):
+        """multi_precision=False stores Adam moments in the param dtype;
+        short-horizon training must stay close to the fp32-moment run."""
+        import jax.numpy as jnp
+        w_np = rng.normal(size=(8, 8))
+        x_np = rng.normal(size=(4, 8)).astype(np.float32)
+
+        def run(mp):
+            w = paddle.Parameter(jnp.asarray(w_np, jnp.bfloat16))
+            opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=[w],
+                                        multi_precision=mp)
+            x = paddle.to_tensor(x_np)
+            losses = []
+            for _ in range(10):
+                out = paddle.matmul(x, w.astype("float32"))
+                loss = (out * out).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            state = opt._states[id(w)]
+            return losses, state["moment1"].dtype
+
+        l32, d32 = run(True)
+        l16, d16 = run(False)
+        assert str(d32) == "float32" and str(d16) == "bfloat16"
+        assert l16[-1] < l16[0]  # still trains
+        np.testing.assert_allclose(l16, l32, rtol=0.05)
+
+    def test_adamw_forwards_multi_precision(self):
+        """Regression: AdamW dropped the flag on the way to Adam."""
+        import jax.numpy as jnp
+        w = paddle.Parameter(jnp.zeros((2, 2), jnp.bfloat16))
+        opt = paddle.optimizer.AdamW(parameters=[w],
+                                     multi_precision=False)
+        state = opt._init_state(w)
+        assert str(state["moment1"].dtype) == "bfloat16"
+
+
 class TestCustomOp:
     def test_register_and_autograd(self, rng):
         import jax.numpy as jnp
