@@ -30,13 +30,31 @@ __all__ = ["Config", "Predictor", "create_predictor", "save_inference_model",
 def save_inference_model(path: str, model, input_spec=None):
     """ref: paddle.static.save_inference_model / jit.save — persist params
     plus the importable factory so inference can rebuild the module.
-    input_spec (shapes/dtypes) is stored for consumers that pre-compile."""
+    input_spec (shapes/dtypes) is stored for consumers that pre-compile.
+
+    Reconstructability is validated AT SAVE TIME: a model whose __init__
+    needs arguments must expose them as `.config` (the LM zoo convention),
+    otherwise load would fail later in the serving process.
+    """
     cls = type(model)
+    cfg = getattr(model, "config", None)
+    if cfg is None:
+        sig = inspect.signature(cls.__init__)
+        required = [n for n, p in list(sig.parameters.items())[1:]
+                    if p.default is inspect.Parameter.empty
+                    and p.kind in (p.POSITIONAL_OR_KEYWORD,
+                                   p.POSITIONAL_ONLY)]
+        if required:
+            raise ValueError(
+                f"cannot save {cls.__qualname__} for inference: __init__ "
+                f"requires {required} but the model has no .config "
+                "attribute to rebuild from. Store constructor arguments "
+                "on `self.config`, or save weights only via paddle.save")
     payload = {
         "state_dict": model.state_dict(),
         "module": cls.__module__,
         "class_name": cls.__qualname__,
-        "init_config": getattr(model, "config", None),
+        "init_config": cfg,
         "input_spec": [
             {"shape": list(s.shape), "dtype": str(s.dtype)}
             for s in (input_spec or [])
@@ -56,13 +74,25 @@ def load_inference_model(path: str):
         cls = getattr(cls, part)
     cfg = payload["init_config"]
     model = cls(cfg) if cfg is not None else cls()
-    missing, unexpected = model.set_state_dict(payload["state_dict"])
+    # install weights preserving the CHECKPOINT dtype (a bf16-saved model
+    # must serve in bf16; Layer.set_state_dict would cast to init dtype)
+    own = model.state_dict()
+    saved = payload["state_dict"]
+    missing = [k for k in own if k not in saved]
+    unexpected = [k for k in saved if k not in own]
     if missing or unexpected:
         raise ValueError(
             f"saved model does not match reconstructed "
             f"{payload['class_name']}: missing={missing[:5]}, "
-            f"unexpected={unexpected[:5]} (models whose __init__ needs "
-            "arguments must expose them as a .config attribute)")
+            f"unexpected={unexpected[:5]}")
+    for k, v in saved.items():
+        src = v._data if isinstance(v, Tensor) else jnp.asarray(
+            np.asarray(v))
+        if tuple(src.shape) != tuple(own[k]._data.shape):
+            raise ValueError(
+                f"shape mismatch for {k}: checkpoint {tuple(src.shape)} "
+                f"vs model {tuple(own[k]._data.shape)}")
+        own[k]._data = src
     model.eval()
     return model
 
@@ -106,7 +136,6 @@ class Predictor:
                 model.bfloat16()
         else:
             model = model_or_config
-            model.eval()
         self.model = model
         apply, params, buffers = functionalize(model)
         self._apply = apply
@@ -114,16 +143,24 @@ class Predictor:
         self._buffers = buffers
 
         def fwd(params, buffers, *args):
-            out, _ = apply(params, buffers, *args)
+            # serve in eval semantics without permanently flipping a live
+            # model's mode: toggle only around the trace
+            was_training = model.training
+            try:
+                if was_training:
+                    model.eval()
+                out, _ = apply(params, buffers, *args)
+            finally:
+                if was_training:
+                    model.train()
             return out
 
         self._jitted = jax.jit(fwd)  # shape/dtype-keyed compile cache
 
     def run(self, *inputs):
-        """numpy/Tensor inputs -> list of numpy outputs (zero extra copies
-        beyond host->device)."""
-        raw = [i._data if isinstance(i, Tensor) else jnp.asarray(
-            np.asarray(i)) for i in inputs]
+        """numpy/Tensor/jax-array inputs -> list of numpy outputs."""
+        raw = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+               for i in inputs]
         out = self._jitted(self._params, self._buffers, *raw)
         if isinstance(out, (tuple, list)):
             return [np.asarray(o) for o in out]

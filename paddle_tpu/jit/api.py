@@ -309,6 +309,13 @@ def save(layer, path, input_spec=None, **configs):
 
 def load(path, **configs):
     """Returns a reconstructed Layer in eval mode (ref: jit.load →
-    TranslatedLayer)."""
+    TranslatedLayer). Falls back to the legacy .pdparams payload (raw
+    state-dict dict) for artifacts written by earlier versions."""
+    import os
+
     from ..inference import load_inference_model
+    if not os.path.exists(path + ".pdmodel") and \
+            os.path.exists(path + ".pdparams"):
+        from ..framework.io import load as _load
+        return _load(path + ".pdparams")
     return load_inference_model(path)

@@ -80,3 +80,25 @@ def test_predictor_from_live_model(rng):
     out = pred.run(x)
     np.testing.assert_allclose(out[0], m(paddle.to_tensor(x)).numpy(),
                                atol=1e-6)
+    # building a predictor must not flip a live model into eval mode
+    assert m.training
+
+
+def test_save_unreconstructable_model_raises_at_save(tmp_path):
+    """nn.Sequential has required __init__ args and no .config: refuse at
+    SAVE time, not in the serving process."""
+    import pytest
+    import paddle_tpu.nn as nn
+    m = nn.Linear(4, 2)
+    with pytest.raises(ValueError, match="config"):
+        save_inference_model(str(tmp_path / "bad"), m)
+
+
+def test_bf16_dtype_preserved_through_load(tmp_path, rng):
+    paddle.seed(4)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.bfloat16()
+    path = str(tmp_path / "bf16model")
+    save_inference_model(path, m)
+    m2 = load_inference_model(path)
+    assert str(m2.lm_head.weight.dtype) == "bfloat16"
