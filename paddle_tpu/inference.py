@@ -40,14 +40,18 @@ def save_inference_model(path: str, model, input_spec=None):
     cfg = getattr(model, "config", None)
     if cfg is None:
         sig = inspect.signature(cls.__init__)
-        required = [n for n, p in list(sig.parameters.items())[1:]
-                    if p.default is inspect.Parameter.empty
-                    and p.kind in (p.POSITIONAL_OR_KEYWORD,
-                                   p.POSITIONAL_ONLY)]
+        P_ = inspect.Parameter
+        required = [
+            n for n, p in list(sig.parameters.items())[1:]
+            if (p.kind in (P_.POSITIONAL_OR_KEYWORD, P_.POSITIONAL_ONLY,
+                           P_.KEYWORD_ONLY)
+                and p.default is P_.empty)
+            or p.kind is P_.VAR_POSITIONAL  # e.g. Sequential(*layers)
+        ]
         if required:
             raise ValueError(
                 f"cannot save {cls.__qualname__} for inference: __init__ "
-                f"requires {required} but the model has no .config "
+                f"takes {required} but the model has no .config "
                 "attribute to rebuild from. Store constructor arguments "
                 "on `self.config`, or save weights only via paddle.save")
     payload = {
@@ -75,24 +79,14 @@ def load_inference_model(path: str):
     cfg = payload["init_config"]
     model = cls(cfg) if cfg is not None else cls()
     # install weights preserving the CHECKPOINT dtype (a bf16-saved model
-    # must serve in bf16; Layer.set_state_dict would cast to init dtype)
-    own = model.state_dict()
-    saved = payload["state_dict"]
-    missing = [k for k in own if k not in saved]
-    unexpected = [k for k in saved if k not in own]
+    # must serve in bf16)
+    missing, unexpected = model.set_state_dict(payload["state_dict"],
+                                               cast_dtype=False)
     if missing or unexpected:
         raise ValueError(
             f"saved model does not match reconstructed "
             f"{payload['class_name']}: missing={missing[:5]}, "
             f"unexpected={unexpected[:5]}")
-    for k, v in saved.items():
-        src = v._data if isinstance(v, Tensor) else jnp.asarray(
-            np.asarray(v))
-        if tuple(src.shape) != tuple(own[k]._data.shape):
-            raise ValueError(
-                f"shape mismatch for {k}: checkpoint {tuple(src.shape)} "
-                f"vs model {tuple(own[k]._data.shape)}")
-        own[k]._data = src
     model.eval()
     return model
 
@@ -143,16 +137,18 @@ class Predictor:
         self._buffers = buffers
 
         def fwd(params, buffers, *args):
-            # serve in eval semantics without permanently flipping a live
-            # model's mode: toggle only around the trace
-            was_training = model.training
+            # serve in eval semantics without disturbing the caller's
+            # (possibly per-sublayer) modes: snapshot every training flag,
+            # force eval for the trace, restore exactly
+            layers = model.sublayers(include_self=True)
+            snapshot = [(l, l.training) for l in layers]
             try:
-                if was_training:
-                    model.eval()
+                for l in layers:
+                    l.training = False
                 out, _ = apply(params, buffers, *args)
             finally:
-                if was_training:
-                    model.train()
+                for l, t in snapshot:
+                    l.training = t
             return out
 
         self._jitted = jax.jit(fwd)  # shape/dtype-keyed compile cache

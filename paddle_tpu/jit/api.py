@@ -309,13 +309,15 @@ def save(layer, path, input_spec=None, **configs):
 
 def load(path, **configs):
     """Returns a reconstructed Layer in eval mode (ref: jit.load →
-    TranslatedLayer). Falls back to the legacy .pdparams payload (raw
-    state-dict dict) for artifacts written by earlier versions."""
+    TranslatedLayer). Legacy .pdparams artifacts (raw state-dicts, not
+    reconstructable Layers) fail loudly with the right tool named."""
     import os
 
     from ..inference import load_inference_model
     if not os.path.exists(path + ".pdmodel") and \
             os.path.exists(path + ".pdparams"):
-        from ..framework.io import load as _load
-        return _load(path + ".pdparams")
+        raise ValueError(
+            f"{path}.pdparams is a legacy weights-only artifact and "
+            "cannot be reconstructed into a Layer; load it with "
+            "paddle_tpu.load() and apply set_state_dict on your model")
     return load_inference_model(path)

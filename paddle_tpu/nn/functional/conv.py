@@ -57,6 +57,10 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, nd,
     dn_in, dn_w, dn_out = _dims(nd, channel_last)
 
     def f(a, w, *maybe_b):
+        # align input dtype to the weights (bf16 models take fp32 feeds,
+        # matching F.linear's promotion behavior)
+        if a.dtype != w.dtype:
+            a = a.astype(w.dtype)
         # weight arrives paddle-layout [O, I/g, *k]; lax wants per dn_w
         if channel_last:
             # OIHW -> HWIO etc.

@@ -238,8 +238,11 @@ class Layer:
                 dest[f"{lname}.{bname}" if lname else bname] = b
         return dest
 
-    def set_state_dict(self, state_dict, use_structured_name=True):
-        """Returns (missing_keys, unexpected_keys) like the reference."""
+    def set_state_dict(self, state_dict, use_structured_name=True,
+                       cast_dtype=True):
+        """Returns (missing_keys, unexpected_keys) like the reference.
+        cast_dtype=False installs checkpoint values in THEIR dtype (a
+        bf16-saved model stays bf16) instead of the model's init dtype."""
         own = self.state_dict()
         missing, unexpected = [], []
         for k, v in state_dict.items():
@@ -252,7 +255,8 @@ class Layer:
                         f"shape mismatch for {k}: checkpoint "
                         f"{tuple(data.shape)} vs model "
                         f"{tuple(target._data.shape)}")
-                target._data = data.astype(target._data.dtype)
+                target._data = data.astype(target._data.dtype) \
+                    if cast_dtype else data
             else:
                 unexpected.append(k)
         for k in own:

@@ -1,0 +1,100 @@
+"""AMP tests: auto_cast autocasting, GradScaler dynamic loss scaling.
+
+ref: the reference exercises AMP through test/amp/ (O1/O2 lists,
+check_finite_and_unscale, dynamic loss scale update)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestAutoCast:
+    def test_matmul_autocasts_to_bf16(self, rng):
+        x = paddle.to_tensor(rng.normal(size=(4, 4)).astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(4, 4)).astype(np.float32))
+        with paddle.amp.auto_cast(level="O1"):
+            out = paddle.matmul(x, y)
+        assert str(out.dtype) == "bfloat16"
+        # outside the context: fp32 again
+        out2 = paddle.matmul(x, y)
+        assert str(out2.dtype) == "float32"
+
+    def test_blacklisted_op_stays_fp32(self, rng):
+        x = paddle.to_tensor(rng.normal(size=(8,)).astype(np.float32))
+        with paddle.amp.auto_cast(level="O1"):
+            s = paddle.nn.functional.softmax(x)
+        assert str(s.dtype) == "float32"
+
+    def test_training_under_autocast_converges(self, rng):
+        m = paddle.nn.Sequential(paddle.nn.Linear(4, 16),
+                                 paddle.nn.ReLU(),
+                                 paddle.nn.Linear(16, 1))
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=m.parameters())
+        X = paddle.to_tensor(rng.normal(size=(32, 4)).astype(np.float32))
+        yt = paddle.to_tensor(
+            (rng.normal(size=(32, 1))).astype(np.float32))
+        first = None
+        for _ in range(60):
+            with paddle.amp.auto_cast():
+                out = m(X)
+                loss = ((out.astype("float32") - yt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss)
+        assert float(loss) < first
+
+
+class TestDtypePromotion:
+    def test_bf16_conv_accepts_fp32_input(self, rng):
+        """bf16 models take fp32 feeds: conv aligns the input dtype to the
+        weights (regression: lax.conv requires matching dtypes)."""
+        m = paddle.nn.Conv2D(3, 8, 3)
+        m.bfloat16()
+        x = paddle.to_tensor(
+            rng.normal(size=(1, 3, 8, 8)).astype(np.float32))
+        out = m(x)
+        assert str(out.dtype) == "bfloat16"
+
+
+class TestGradScaler:
+    def test_scale_unscale_roundtrip(self, rng):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        p = paddle.Parameter(np.ones(4, np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        loss = (p * p).sum()
+        scaled = scaler.scale(loss)
+        np.testing.assert_allclose(float(scaled), float(loss) * 1024.0,
+                                   rtol=1e-6)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        # effective update == unscaled grad (2*p) * lr
+        np.testing.assert_allclose(p.numpy(), 1.0 - 0.1 * 2.0, rtol=1e-5)
+
+    def test_skips_step_on_nonfinite_and_backs_off(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                       decr_every_n_nan_or_inf=1)
+        p = paddle.Parameter(np.ones(2, np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        p.grad = paddle.to_tensor(
+            np.array([np.inf, 1.0], np.float32))
+        before = p.numpy().copy()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(p.numpy(), before)  # step skipped
+        assert scaler.get_loss_scaling() < 1024.0         # scale backed off
+
+    def test_scale_grows_after_good_steps(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       incr_every_n_steps=2)
+        p = paddle.Parameter(np.ones(2, np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[p])
+        for _ in range(4):
+            loss = (p * p).sum()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+        assert scaler.get_loss_scaling() > 8.0

@@ -85,13 +85,26 @@ def test_predictor_from_live_model(rng):
 
 
 def test_save_unreconstructable_model_raises_at_save(tmp_path):
-    """nn.Sequential has required __init__ args and no .config: refuse at
-    SAVE time, not in the serving process."""
+    """Models whose __init__ takes args (positional, *args, or required
+    keyword-only) without a .config must be refused at SAVE time, not in
+    the serving process."""
     import pytest
     import paddle_tpu.nn as nn
-    m = nn.Linear(4, 2)
-    with pytest.raises(ValueError, match="config"):
-        save_inference_model(str(tmp_path / "bad"), m)
+    for bad in (nn.Linear(4, 2),
+                nn.Sequential(nn.Linear(4, 2))):  # *layers VAR_POSITIONAL
+        with pytest.raises(ValueError, match="config"):
+            save_inference_model(str(tmp_path / "bad"), bad)
+
+
+def test_predictor_preserves_mixed_sublayer_modes(rng):
+    """Frozen-BN style mixed modes survive a Predictor trace."""
+    import paddle_tpu.nn as nn
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    m.train()
+    m[1].training = False  # deliberately frozen sublayer
+    pred = Predictor(m)
+    pred.run(rng.normal(size=(2, 4)).astype(np.float32))
+    assert m.training and m[0].training and not m[1].training
 
 
 def test_bf16_dtype_preserved_through_load(tmp_path, rng):
