@@ -136,3 +136,12 @@ init = fleet.init
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+
+def _reset_for_tests():
+    """Clear the global hybrid-parallel state so one test's fleet.init
+    cannot leak an active mesh into later tests."""
+    global _hcg, _strategy
+    _hcg = None
+    _strategy = None
+    fleet._is_initialized = False

@@ -49,6 +49,11 @@ def _constrain(x, dim: Optional[int], axis: Optional[str]):
     swallowed constraint would make SP a silent no-op."""
     if axis is None:
         return x
+    mesh = _current_mp_mesh()
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding
+    jmesh = mesh.to_jax_mesh()
 
     def f(a):
         if not isinstance(a, jax.core.Tracer):
@@ -56,7 +61,8 @@ def _constrain(x, dim: Optional[int], axis: Optional[str]):
         spec = [None] * a.ndim
         if dim is not None and a.ndim > dim:
             spec[dim] = axis
-        return jax.lax.with_sharding_constraint(a, P(*spec))
+        return jax.lax.with_sharding_constraint(
+            a, NamedSharding(jmesh, P(*spec)))
     return apply_op(f, x, op_name="sharding_constraint")
 
 

@@ -1,0 +1,96 @@
+"""Collective/step watchdog: timeout detection for enqueued device work.
+
+ref: paddle/phi/core/distributed/comm_task_manager.h:37-57 (CommTaskManager
+background loop: per-collective start/end events, timeout detection, error
+propagation, async trace dump enabled by FLAGS_enable_async_trace,
+process_group_nccl.cc:156). TPU mapping: the unit of watching is the
+compiled program (collectives live inside it), so the watchdog monitors
+host-observed completion of each enqueued step; on timeout it dumps the
+native host-tracer buffer and invokes the abort callback — the role the
+reference fills by aborting NCCL comms.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["Watchdog", "WatchdogTimeout"]
+
+
+class WatchdogTimeout(RuntimeError):
+    pass
+
+
+class Watchdog:
+    """Wrap blocking step executions with a timeout monitor.
+
+        wd = Watchdog(timeout=300.0)
+        loss = wd.run(lambda: float(step(x, y)))     # raises on hang
+
+    The callable must block until device completion (a host value
+    transfer — see the tunnel-timing contract used by bench.py)."""
+
+    def __init__(self, timeout: float = 600.0,
+                 on_timeout: Optional[Callable[[], None]] = None,
+                 trace_path: Optional[str] = None):
+        self.timeout = timeout
+        self.on_timeout = on_timeout
+        self.trace_path = trace_path
+        self._task_counter = 0
+        self._stuck_thread: Optional[threading.Thread] = None
+
+    def _dump_trace(self):
+        """Async trace dump on failure (ref: FLAGS_enable_async_trace)."""
+        try:
+            from .._native import lib
+            if lib is not None and self.trace_path:
+                with open(self.trace_path, "w") as f:
+                    f.write(lib.tracer_dump())
+                return self.trace_path
+        except Exception:
+            pass
+        return None
+
+    def run(self, fn: Callable, *args, **kwargs):
+        """NOTE a Python thread cannot be killed: on timeout the worker may
+        STILL complete later and land its side effects (the reference
+        aborts the NCCL comm from on_timeout — do the equivalent abort in
+        your callback). A subsequent run() while the timed-out worker is
+        still alive refuses to start, so a retry can never double-apply an
+        update on top of a late-finishing one."""
+        if self._stuck_thread is not None:
+            if self._stuck_thread.is_alive():
+                raise WatchdogTimeout(
+                    "previous timed-out step is still running; refusing "
+                    "to launch another (restart the process or abort the "
+                    "device work from on_timeout)")
+            self._stuck_thread = None
+        self._task_counter += 1
+        task_id = self._task_counter
+        result = {}
+        done = threading.Event()
+
+        def worker():
+            try:
+                result["value"] = fn(*args, **kwargs)
+            except BaseException as e:  # propagate into the caller
+                result["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=worker, daemon=True)
+        start = time.monotonic()
+        t.start()
+        if not done.wait(self.timeout):
+            self._stuck_thread = t
+            dump = self._dump_trace()
+            if self.on_timeout is not None:
+                self.on_timeout()
+            raise WatchdogTimeout(
+                f"step {task_id} exceeded {self.timeout:.0f}s "
+                f"(started {time.monotonic() - start:.0f}s ago)"
+                + (f"; host trace dumped to {dump}" if dump else ""))
+        if "error" in result:
+            raise result["error"]
+        return result["value"]

@@ -1,0 +1,149 @@
+"""Pipeline-parallel Llama: decoder stack on the compiled GPipe schedule.
+
+ref: the reference expresses this as PipelineLayer segmentation + the
+fleet PP runtime (fleet/meta_parallel/pp_layers.py:257 LayerDesc
+segmentation, pipeline_parallel.py 1F1B) — embedding on the first stage,
+head on the last. TPU-native: embedding and head run data-parallel
+outside the pipelined region (they are one matmul each); the decoder
+stack runs inside parallel.spmd_pipeline with its stacked params sharded
+on the 'pp' mesh axis, and jax.grad reverses the whole schedule. One jit
+covers embed -> pipeline -> head -> loss -> backward -> optimizer.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.api import functionalize
+from ..parallel import spmd_pipeline, stack_layer_params
+from .llama import (LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM)
+
+__all__ = ["LlamaForCausalLMPipe"]
+
+
+class LlamaForCausalLMPipe:
+    """Owns a LlamaForCausalLM's parameters but runs the decoder layers
+    through the compiled pipeline. train_step is one jitted program.
+
+    batch_axes: mesh axes sharding the micro-batch dim (dp composition,
+    ref: hybrid pp+dp in HybridCommunicateGroup).
+    """
+
+    def __init__(self, config: LlamaConfig, mesh, pp_axis: str = "pp",
+                 batch_axes=(), num_microbatches: int = 4):
+        if config.num_hidden_layers % _axis_size(mesh, pp_axis) != 0:
+            raise ValueError(
+                f"num_hidden_layers={config.num_hidden_layers} must divide "
+                f"over the '{pp_axis}' axis")
+        self.config = config
+        self.mesh = mesh
+        self.pp_axis = pp_axis
+        self.batch_axes = tuple(batch_axes)
+        self.num_microbatches = num_microbatches
+        self.model = LlamaForCausalLM(config)
+
+        # functionalize one decoder layer as the stage program; stack all
+        # layers' params into [L, ...] pytrees for the pipeline
+        layer0 = self.model.llama.layers[0]
+        self._stage_apply, _, _ = functionalize(layer0)
+        per_layer = []
+        for layer in self.model.llama.layers:
+            per_layer.append({k: t._data
+                              for k, t in dict(
+                                  layer.named_parameters()).items()})
+        self.stacked = stack_layer_params(per_layer)
+        self._embed = self.model.llama.embed_tokens.weight
+        self._norm_w = self.model.llama.norm.weight
+        self._head = (None if config.tie_word_embeddings
+                      else self.model.lm_head.weight)
+        self._jitted = None
+
+    def _stage_fn(self, p, h):
+        out, _ = self._stage_apply(p, {}, Tensor(h))
+        return out._data if isinstance(out, Tensor) else out
+
+    def _forward(self, stacked, embed_w, norm_w, head_w, ids):
+        """ids: [B, L] -> logits [B, L, V]; pipeline over micro-batches."""
+        m = self.num_microbatches
+        b = ids.shape[0]
+        if b % m != 0:
+            raise ValueError(
+                f"batch size {b} must be divisible by "
+                f"num_microbatches={m}")
+        h = jnp.take(embed_w, ids, axis=0)       # embed (outside pipe)
+        mb = h.reshape(m, b // m, *h.shape[1:])
+        out = spmd_pipeline(self._stage_fn, stacked, mb, self.mesh,
+                            self.pp_axis, self.batch_axes)
+        h = out.reshape(b, *h.shape[1:])
+        # final RMSNorm + head (outside pipe); same casting order as
+        # F.rms_norm — fp32 through the weight multiply, ONE downcast
+        h32 = h.astype(jnp.float32)
+        var = jnp.mean(jnp.square(h32), -1, keepdims=True)
+        h = (h32 * jax.lax.rsqrt(var + self.config.rms_norm_eps)
+             * norm_w.astype(jnp.float32)).astype(h.dtype)
+        w = embed_w.T if head_w is None else head_w
+        return h @ w
+
+    def forward_logits(self, ids):
+        """Eager-facing forward through the pipeline (for parity tests)."""
+        params = self._param_tree()
+        return self._forward(params["stacked"], params["embed"],
+                             params["norm"], params.get("head"),
+                             jnp.asarray(ids))
+
+    def _param_tree(self):
+        params = {"stacked": self.stacked, "embed": self._embed._data,
+                  "norm": self._norm_w._data}
+        if self._head is not None:
+            params["head"] = self._head._data
+        return params
+
+    def train_step(self, learning_rate: float = 1e-3):
+        """Returns step(ids, labels) -> loss; pipeline fwd + bwd + SGD
+        update compiled into one program."""
+        from ..ops.fused_ce import fused_softmax_ce_mean
+
+        def step_fn(params, ids, labels, lr):
+            def loss_of(ps):
+                logits = self._forward(
+                    ps["stacked"], ps["embed"], ps["norm"],
+                    ps.get("head"), ids)
+                return fused_softmax_ce_mean(logits[:, :-1, :],
+                                             labels[:, 1:])
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            new_params = jax.tree.map(lambda p, g: p - lr * g, params,
+                                      grads)
+            return loss, new_params
+
+        jitted = jax.jit(step_fn)
+
+        def step(ids, labels):
+            loss, new_params = jitted(self._param_tree(),
+                                      jnp.asarray(ids),
+                                      jnp.asarray(labels),
+                                      jnp.float32(learning_rate))
+            self._install(new_params)
+            return loss
+
+        return step
+
+    def _install(self, params):
+        """Write updated params back onto the object (and the owned serial
+        model), so forward_logits / a new train_step resume from them."""
+        self.stacked = params["stacked"]
+        self._embed._data = params["embed"]
+        self._norm_w._data = params["norm"]
+        if self._head is not None:
+            self._head._data = params["head"]
+        for i, layer in enumerate(self.model.llama.layers):
+            for k, t in dict(layer.named_parameters()).items():
+                t._data = params["stacked"][k][i]
+
+
+def _axis_size(mesh, axis: str) -> int:
+    jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
+    return dict(zip(jmesh.axis_names, jmesh.devices.shape))[axis]

@@ -57,10 +57,13 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, nd,
     dn_in, dn_w, dn_out = _dims(nd, channel_last)
 
     def f(a, w, *maybe_b):
-        # align input dtype to the weights (bf16 models take fp32 feeds,
-        # matching F.linear's promotion behavior)
+        # standard jnp promotion (same as the `x @ w` in F.linear):
+        # fp32 input x bf16 weight computes in fp32 — lax.conv just needs
+        # both sides pre-cast to the common type
         if a.dtype != w.dtype:
-            a = a.astype(w.dtype)
+            common = jnp.result_type(a, w)
+            a = a.astype(common)
+            w = w.astype(common)
         # weight arrives paddle-layout [O, I/g, *k]; lax wants per dn_w
         if channel_last:
             # OIHW -> HWIO etc.

@@ -47,15 +47,19 @@ class TestAutoCast:
 
 
 class TestDtypePromotion:
-    def test_bf16_conv_accepts_fp32_input(self, rng):
-        """bf16 models take fp32 feeds: conv aligns the input dtype to the
-        weights (regression: lax.conv requires matching dtypes)."""
+    def test_mixed_dtype_conv_promotes_like_linear(self, rng):
+        """fp32 input x bf16 conv weights promotes to fp32, the same
+        semantics as F.linear's `x @ w` (regression: lax.conv used to
+        reject mixed dtypes; then an early fix silently downcast)."""
         m = paddle.nn.Conv2D(3, 8, 3)
         m.bfloat16()
         x = paddle.to_tensor(
             rng.normal(size=(1, 3, 8, 8)).astype(np.float32))
         out = m(x)
-        assert str(out.dtype) == "bfloat16"
+        assert str(out.dtype) == "float32"
+        # fully-bf16 path stays bf16
+        out_bf16 = m(x.astype("bfloat16"))
+        assert str(out_bf16.dtype) == "bfloat16"
 
 
 class TestGradScaler:

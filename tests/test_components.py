@@ -22,6 +22,55 @@ class TestSequenceParallel:
         np.testing.assert_allclose(ScatterOp.apply(x).numpy(), x.numpy())
 
 
+class TestSequenceParallelMeshed:
+    def test_sp_linears_match_serial_under_mesh(self, rng):
+        """With fleet mp active, the SP column/row pair inside jit must
+        produce the same numbers as an unsharded matmul pair (the
+        constraints change placement, never values)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.sequence_parallel_utils import (
+            ColumnSequenceParallelLinear, RowSequenceParallelLinear)
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4,
+                                   "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            paddle.seed(11)
+            col = ColumnSequenceParallelLinear(8, 16)
+            row = RowSequenceParallelLinear(16, 8)
+            assert col.weight._dist_attr is not None  # mp-sharded
+
+            x_np = rng.normal(size=(2, 8, 8)).astype(np.float32)
+
+            def fwd(x_arr, cw, cb, rw, rb):
+                old = [col.weight._data, col.bias._data,
+                       row.weight._data, row.bias._data]
+                try:
+                    col.weight._data, col.bias._data = cw, cb
+                    row.weight._data, row.bias._data = rw, rb
+                    from paddle_tpu.core.tensor import Tensor
+                    return row(col(Tensor(x_arr)))._data
+                finally:
+                    (col.weight._data, col.bias._data,
+                     row.weight._data, row.bias._data) = old
+
+            out = jax.jit(fwd)(
+                jnp.asarray(x_np), col.weight._data, col.bias._data,
+                row.weight._data, row.bias._data)
+            # serial oracle with the same (gathered) weights
+            ref = (x_np @ np.asarray(col.weight._data)
+                   + np.asarray(col.bias._data))
+            ref = ref @ np.asarray(row.weight._data) + np.asarray(
+                row.bias._data)
+            np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+        finally:
+            from paddle_tpu.distributed.fleet.fleet import _reset_for_tests
+            _reset_for_tests()
+
+
 class TestAutoTuner:
     def test_prune_rules(self):
         from paddle_tpu.distributed.auto_tuner import Prune, SearchSpace
@@ -191,6 +240,54 @@ class TestEngineModePreserved:
         m.eval()
         eng.predict([rng.normal(size=(2, 4)).astype(np.float32)])
         assert not m.training  # was eval before, stays eval
+
+
+class TestWatchdog:
+    def test_passthrough_and_timeout(self, tmp_path):
+        import time
+        from paddle_tpu.distributed import Watchdog, WatchdogTimeout
+        wd = Watchdog(timeout=5.0)
+        assert wd.run(lambda: 42) == 42
+        # errors propagate
+        import pytest as _pytest
+        with _pytest.raises(ZeroDivisionError):
+            wd.run(lambda: 1 / 0)
+        # hang detection + trace dump + abort callback
+        aborted = []
+        trace = str(tmp_path / "hang_trace.json")
+        wd2 = Watchdog(timeout=0.2, on_timeout=lambda: aborted.append(1),
+                       trace_path=trace)
+        from paddle_tpu._native import lib
+        if lib is not None:
+            lib.tracer_start()
+        with _pytest.raises(WatchdogTimeout):
+            wd2.run(lambda: time.sleep(3))
+        if lib is not None:
+            lib.tracer_stop()
+        assert aborted == [1]
+        import os
+        if lib is not None:
+            assert os.path.exists(trace)
+
+    def test_watched_train_step(self, rng):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed import Watchdog
+        m = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        wd = Watchdog(timeout=60.0)
+        x = paddle.to_tensor(rng.normal(size=(4, 4)).astype(np.float32))
+
+        def step():
+            loss = (m(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return float(loss)
+
+        l0 = wd.run(step)
+        l1 = wd.run(step)
+        assert l1 < l0
 
 
 class TestMultiPrecision:
