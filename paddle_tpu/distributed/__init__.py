@@ -28,7 +28,7 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore  # noqa: F401
-from .watchdog import Watchdog, WatchdogTimeout  # noqa: F401
+from .watchdog import Watchdog, WatchdogBusy, WatchdogTimeout  # noqa: F401
 from .ring_attention import ring_attention, ring_self_attention  # noqa: F401
 from .dist_train import DistTrainStep  # noqa: F401
 

@@ -15,11 +15,17 @@ import threading
 import time
 from typing import Callable, Optional
 
-__all__ = ["Watchdog", "WatchdogTimeout"]
+__all__ = ["Watchdog", "WatchdogTimeout", "WatchdogBusy"]
 
 
 class WatchdogTimeout(RuntimeError):
     pass
+
+
+class WatchdogBusy(WatchdogTimeout):
+    """A previous timed-out step is still running. Subclasses
+    WatchdogTimeout so existing handlers still fire, but lets retry logic
+    distinguish 'refused to start' from a fresh hang."""
 
 
 class Watchdog:
@@ -61,7 +67,7 @@ class Watchdog:
         update on top of a late-finishing one."""
         if self._stuck_thread is not None:
             if self._stuck_thread.is_alive():
-                raise WatchdogTimeout(
+                raise WatchdogBusy(
                     "previous timed-out step is still running; refusing "
                     "to launch another (restart the process or abort the "
                     "device work from on_timeout)")
@@ -85,12 +91,18 @@ class Watchdog:
         if not done.wait(self.timeout):
             self._stuck_thread = t
             dump = self._dump_trace()
+            abort_err = None
             if self.on_timeout is not None:
-                self.on_timeout()
+                try:
+                    self.on_timeout()
+                except BaseException as e:  # the timeout must still surface
+                    abort_err = e
             raise WatchdogTimeout(
                 f"step {task_id} exceeded {self.timeout:.0f}s "
                 f"(started {time.monotonic() - start:.0f}s ago)"
-                + (f"; host trace dumped to {dump}" if dump else ""))
+                + (f"; host trace dumped to {dump}" if dump else "")
+                + (f"; on_timeout callback itself failed: {abort_err!r}"
+                   if abort_err is not None else "")) from abort_err
         if "error" in result:
             raise result["error"]
         return result["value"]

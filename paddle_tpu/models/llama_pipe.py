@@ -11,16 +11,13 @@ covers embed -> pipeline -> head -> loss -> backward -> optimizer.
 """
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.tensor import Tensor
 from ..jit.api import functionalize
 from ..parallel import spmd_pipeline, stack_layer_params
-from .llama import (LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM)
+from .llama import LlamaConfig, LlamaForCausalLM
 
 __all__ = ["LlamaForCausalLMPipe"]
 
@@ -44,22 +41,29 @@ class LlamaForCausalLMPipe:
         self.pp_axis = pp_axis
         self.batch_axes = tuple(batch_axes)
         self.num_microbatches = num_microbatches
-        self.model = LlamaForCausalLM(config)
+        self._model = LlamaForCausalLM(config)
 
         # functionalize one decoder layer as the stage program; stack all
         # layers' params into [L, ...] pytrees for the pipeline
-        layer0 = self.model.llama.layers[0]
+        layer0 = self._model.llama.layers[0]
         self._stage_apply, _, _ = functionalize(layer0)
         per_layer = []
-        for layer in self.model.llama.layers:
+        for layer in self._model.llama.layers:
             per_layer.append({k: t._data
                               for k, t in dict(
                                   layer.named_parameters()).items()})
         self.stacked = stack_layer_params(per_layer)
-        self._embed = self.model.llama.embed_tokens.weight
-        self._norm_w = self.model.llama.norm.weight
+        # the stacks are now the single authoritative copy of the decoder
+        # weights: drop the serial model's per-layer buffers (halves param
+        # memory) and rematerialize them lazily via the `model` property
+        for layer in self._model.llama.layers:
+            for t in dict(layer.named_parameters()).values():
+                t._data = None
+        self._serial_stale = True
+        self._embed = self._model.llama.embed_tokens.weight
+        self._norm_w = self._model.llama.norm.weight
         self._head = (None if config.tie_word_embeddings
-                      else self.model.lm_head.weight)
+                      else self._model.lm_head.weight)
         self._jitted = None
 
     def _stage_fn(self, p, h):
@@ -79,12 +83,10 @@ class LlamaForCausalLMPipe:
         out = spmd_pipeline(self._stage_fn, stacked, mb, self.mesh,
                             self.pp_axis, self.batch_axes)
         h = out.reshape(b, *h.shape[1:])
-        # final RMSNorm + head (outside pipe); same casting order as
-        # F.rms_norm — fp32 through the weight multiply, ONE downcast
-        h32 = h.astype(jnp.float32)
-        var = jnp.mean(jnp.square(h32), -1, keepdims=True)
-        h = (h32 * jax.lax.rsqrt(var + self.config.rms_norm_eps)
-             * norm_w.astype(jnp.float32)).astype(h.dtype)
+        # final RMSNorm + head (outside pipe)
+        from ..nn.functional.norm import rms_norm
+        h = rms_norm(Tensor(h), Tensor(norm_w),
+                     self.config.rms_norm_eps)._data
         w = embed_w.T if head_w is None else head_w
         return h @ w
 
@@ -131,19 +133,44 @@ class LlamaForCausalLMPipe:
 
         return step
 
+    @property
+    def model(self):
+        """The owned serial LlamaForCausalLM. The decoder weights live in
+        the pp-sharded stacks between steps; reading this property slices
+        them back onto the serial layers first, so state_dict()/save always
+        see current weights."""
+        self.sync_serial_model()
+        return self._model
+
     def _install(self, params):
-        """Write updated params back onto the object (and the owned serial
-        model), so forward_logits / a new train_step resume from them."""
+        """Write updated params back onto the object, so forward_logits / a
+        new train_step resume from them. The per-layer writeback onto the
+        owned serial model slices the pp-sharded stacks (cross-device
+        gathers), so it is deferred to the `model` property rather than run
+        every step."""
         self.stacked = params["stacked"]
         self._embed._data = params["embed"]
         self._norm_w._data = params["norm"]
         if self._head is not None:
             self._head._data = params["head"]
-        for i, layer in enumerate(self.model.llama.layers):
+        self._serial_stale = True
+
+    def sync_serial_model(self):
+        """Slice the stacked pipeline params back onto the serial layers
+        (runs automatically when `self.model` is read)."""
+        if not self._serial_stale:
+            return
+        for i, layer in enumerate(self._model.llama.layers):
             for k, t in dict(layer.named_parameters()).items():
-                t._data = params["stacked"][k][i]
+                t._data = self.stacked[k][i]
+        self._serial_stale = False
 
 
 def _axis_size(mesh, axis: str) -> int:
     jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
-    return dict(zip(jmesh.axis_names, jmesh.devices.shape))[axis]
+    sizes = dict(zip(jmesh.axis_names, jmesh.devices.shape))
+    if axis not in sizes:
+        raise ValueError(
+            f"mesh has no '{axis}' axis (axes: {list(sizes)}); pass the "
+            f"pipeline axis name via pp_axis")
+    return sizes[axis]

@@ -3,4 +3,5 @@ from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adagrad, Adam, AdamW, Adamax, RMSProp, Lamb,
     Adadelta,
 )
+from .extra import ASGD, LBFGS, NAdam, RAdam, Rprop  # noqa: F401
 from . import lr  # noqa: F401

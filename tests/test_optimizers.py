@@ -1,0 +1,199 @@
+"""Numeric tests for the extended optimizer zoo (ASGD/NAdam/RAdam/Rprop/
+LBFGS) against NumPy reference implementations of the documented update
+equations (ref: python/paddle/optimizer/{asgd,nadam,radam,rprop,lbfgs}.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _make_param(shape=(3, 4), seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=shape).astype(np.float32)
+    p = paddle.Parameter(paddle.to_tensor(w.copy())._data)
+    return p, w
+
+
+def _grads(n, shape=(3, 4), seed=1):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=shape).astype(np.float32) for _ in range(n)]
+
+
+def _run(opt, p, grads):
+    for g in grads:
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        opt.clear_grad()
+    return p.numpy()
+
+
+class TestASGD:
+    def test_matches_reference_equations(self):
+        n = 3
+        p, w = _make_param()
+        grads = _grads(5)
+        opt = paddle.optimizer.ASGD(learning_rate=0.1, batch_num=n,
+                                    parameters=[p])
+        got = _run(opt, p, grads)
+
+        d = np.zeros_like(w)
+        ys = np.zeros((n,) + w.shape, np.float32)
+        x = w.copy()
+        for m, g in enumerate(grads):
+            i = m % n
+            d = d - ys[i] + g
+            ys[i] = g
+            x = x - 0.1 * (d / min(m + 1, n))
+        np.testing.assert_allclose(got, x, rtol=1e-5, atol=1e-6)
+
+    def test_batch_num_validation(self):
+        p, _ = _make_param()
+        with pytest.raises(ValueError):
+            paddle.optimizer.ASGD(batch_num=0, parameters=[p])
+
+
+class TestNAdam:
+    def test_matches_reference_equations(self):
+        b1, b2, eps, psi, lr = 0.9, 0.999, 1e-8, 0.004, 0.01
+        p, w = _make_param()
+        grads = _grads(4)
+        opt = paddle.optimizer.NAdam(learning_rate=lr, beta1=b1, beta2=b2,
+                                     epsilon=eps, parameters=[p])
+        got = _run(opt, p, grads)
+
+        m = np.zeros_like(w)
+        v = np.zeros_like(w)
+        mu_prod = 1.0
+        x = w.copy()
+        for t, g in enumerate(grads, start=1):
+            mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+            mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mu_prod_t = mu_prod * mu_t
+            mu_prod_t1 = mu_prod_t * mu_t1
+            m_hat = mu_t1 * m / (1 - mu_prod_t1) + \
+                (1 - mu_t) * g / (1 - mu_prod_t)
+            v_hat = v / (1 - b2 ** t)
+            x = x - lr * m_hat / (np.sqrt(v_hat) + eps)
+            mu_prod = mu_prod_t
+        np.testing.assert_allclose(got, x, rtol=1e-5, atol=1e-6)
+
+
+class TestRAdam:
+    def test_matches_reference_equations(self):
+        b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.01
+        p, w = _make_param()
+        grads = _grads(8)
+        opt = paddle.optimizer.RAdam(learning_rate=lr, beta1=b1, beta2=b2,
+                                     epsilon=eps, parameters=[p])
+        got = _run(opt, p, grads)
+
+        m = np.zeros_like(w)
+        v = np.zeros_like(w)
+        x = w.copy()
+        rho_inf = 2 / (1 - b2) - 1
+        # beta powers accumulate in float32 state (like the impl / reference
+        # accumulators), which matters because 1 - beta2^t cancels
+        b1p = np.float32(1.0)
+        b2p = np.float32(1.0)
+        for t, g in enumerate(grads, start=1):
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            b1p = np.float32(b1p * np.float32(b1))
+            b2p = np.float32(b2p * np.float32(b2))
+            rho_t = rho_inf - 2 * t * b2p / (1 - b2p)
+            m_hat = m / (1 - b1p)
+            if rho_t > 5:
+                r_t = np.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                              ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+                x = x - lr * m_hat * r_t / (np.sqrt(v / (1 - b2p)) + eps)
+            else:
+                x = x - lr * m_hat
+        np.testing.assert_allclose(got, x, rtol=1e-5, atol=1e-6)
+
+    def test_early_steps_unrectified(self):
+        """rho_t <= 5 for the first few steps -> plain momentum update."""
+        p, w = _make_param()
+        g = _grads(1)[0]
+        opt = paddle.optimizer.RAdam(learning_rate=0.01, parameters=[p])
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        expect = w - 0.01 * g  # m_hat == g at t=1
+        np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5, atol=1e-6)
+
+
+class TestRprop:
+    def test_matches_reference_equations(self):
+        lr, lo, hi, etas = 0.01, 1e-5, 50.0, (0.5, 1.2)
+        p, w = _make_param()
+        grads = _grads(6)
+        opt = paddle.optimizer.Rprop(learning_rate=lr,
+                                     learning_rate_range=(lo, hi),
+                                     etas=etas, parameters=[p])
+        got = _run(opt, p, grads)
+
+        prev = np.zeros_like(w)
+        step = np.full_like(w, lr)
+        x = w.copy()
+        for g in grads:
+            sign = g * prev
+            factor = np.where(sign > 0, etas[1],
+                              np.where(sign < 0, etas[0], 1.0))
+            step = np.clip(step * factor, lo, hi)
+            g_eff = np.where(sign < 0, 0.0, g)
+            x = x - np.sign(g_eff) * step
+            prev = g_eff
+        np.testing.assert_allclose(got, x, rtol=1e-5, atol=1e-6)
+
+    def test_validation(self):
+        p, _ = _make_param()
+        with pytest.raises(ValueError):
+            paddle.optimizer.Rprop(learning_rate=100.0, parameters=[p])
+        with pytest.raises(ValueError):
+            paddle.optimizer.Rprop(etas=(1.5, 1.2), parameters=[p])
+
+
+class TestLBFGS:
+    def _quadratic_problem(self):
+        """min 0.5 ||A x - b||^2 — LBFGS should converge fast."""
+        rng = np.random.default_rng(7)
+        A = rng.normal(size=(6, 4)).astype(np.float32)
+        b = rng.normal(size=(6,)).astype(np.float32)
+        x0 = np.zeros((4,), np.float32)
+        p = paddle.Parameter(paddle.to_tensor(x0)._data)
+        A_t = paddle.to_tensor(A)
+        b_t = paddle.to_tensor(b)
+
+        def closure():
+            r = paddle.matmul(A_t, p) - b_t
+            loss = (r * r).sum() * 0.5
+            p.clear_gradient()
+            loss.backward()
+            return loss
+
+        x_star = np.linalg.lstsq(A, b, rcond=None)[0]
+        return p, closure, x_star
+
+    def test_converges_plain(self):
+        p, closure, x_star = self._quadratic_problem()
+        opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=50,
+                                     parameters=[p])
+        for _ in range(5):
+            opt.step(closure)
+        np.testing.assert_allclose(p.numpy(), x_star, rtol=1e-2, atol=1e-2)
+
+    def test_converges_strong_wolfe(self):
+        p, closure, x_star = self._quadratic_problem()
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                     line_search_fn="strong_wolfe",
+                                     parameters=[p])
+        opt.step(closure)
+        np.testing.assert_allclose(p.numpy(), x_star, rtol=1e-3, atol=1e-3)
+
+    def test_requires_closure(self):
+        p, _ = _make_param()
+        opt = paddle.optimizer.LBFGS(parameters=[p])
+        with pytest.raises(ValueError):
+            opt.step()
