@@ -59,28 +59,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     use_batch_stats = training and not use_global_stats
 
-    if use_batch_stats:
-        xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-        axes = tuple(i for i in range(xd.ndim)
-                     if i != (channel_axis % xd.ndim))
-        batch_mean = jnp.mean(xd.astype(jnp.float32), axis=axes)
-        batch_var = jnp.var(xd.astype(jnp.float32), axis=axes)
-        # in-place running-stat update (leaf buffers)
-        if isinstance(running_mean, Tensor):
-            running_mean._data = (momentum * running_mean._data +
-                                  (1 - momentum) * batch_mean.astype(
-                                      running_mean._data.dtype))
-        if isinstance(running_var, Tensor):
-            n = xd.size // xd.shape[channel_axis % xd.ndim]
-            unbiased = batch_var * (n / max(n - 1, 1))
-            running_var._data = (momentum * running_var._data +
-                                 (1 - momentum) * unbiased.astype(
-                                     running_var._data.dtype))
-        mean_used, var_used = Tensor(batch_mean), Tensor(batch_var)
-    else:
-        mean_used, var_used = running_mean, running_var
-
-    def f(a, m, v, *wb):
+    def _normalize(a, m, v, wb):
+        """Shared normalize + affine body for both stat sources."""
         shape = [1] * a.ndim
         shape[channel_axis] = a.shape[channel_axis]
         out = ((a.astype(jnp.float32) - m.reshape(shape)) *
@@ -94,7 +74,39 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         return out.astype(a.dtype)
 
     args = [a for a in (weight, bias) if a is not None]
-    return apply_op(f, x, mean_used, var_used, *args, op_name="batch_norm")
+
+    if use_batch_stats:
+        # batch stats are computed INSIDE the differentiated fn — backward
+        # must flow through mean/var (the centering terms), else deep BN
+        # stacks get exploding gradients — and returned as extra outputs so
+        # the running-stat update below doesn't recompute the reductions
+        def f_train(a, *wb):
+            a32 = a.astype(jnp.float32)
+            axes = tuple(i for i in range(a.ndim)
+                         if i != (channel_axis % a.ndim))
+            m = jnp.mean(a32, axis=axes)
+            v = jnp.var(a32, axis=axes)
+            return _normalize(a, m, v, wb), m, v
+
+        out, bm, bv = apply_op(f_train, x, *args, op_name="batch_norm")
+        if isinstance(running_mean, Tensor):
+            running_mean._data = (momentum * running_mean._data +
+                                  (1 - momentum) * bm._data.astype(
+                                      running_mean._data.dtype))
+        if isinstance(running_var, Tensor):
+            xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            n = xd.size // xd.shape[channel_axis % xd.ndim]
+            unbiased = bv._data * (n / max(n - 1, 1))
+            running_var._data = (momentum * running_var._data +
+                                 (1 - momentum) * unbiased.astype(
+                                     running_var._data.dtype))
+        return out
+
+    def f(a, m, v, *wb):
+        return _normalize(a, m, v, wb)
+
+    return apply_op(f, x, running_mean, running_var, *args,
+                    op_name="batch_norm")
 
 
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-05,
