@@ -76,17 +76,22 @@ from . import inference  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 
-# paddle-style `paddle.disable_static()` no-ops: we are always "dygraph with
-# compilation underneath" (SURVEY.md §7 design stance).
+from . import static  # noqa: F401
+
+
 def disable_static(place=None):
-    return None
+    """Back to eager (the default). ref: paddle.disable_static."""
+    from .static.program import _set_static_mode
+    _set_static_mode(False)
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has a single execution path (trace->StableHLO->XLA); "
-        "use paddle_tpu.jit.to_static for compiled execution.")
+    """Record subsequent ops into static.default_main_program(); run them
+    with static.Executor. ref: paddle.enable_static (SURVEY layer 14)."""
+    from .static.program import _set_static_mode
+    _set_static_mode(True)
 
 
 def in_dynamic_mode():
-    return True
+    from .static.program import _static_mode
+    return not _static_mode()

@@ -129,6 +129,12 @@ def _maybe_check_nan_inf(name: str, outs) -> None:
                     f"(FLAGS_check_nan_inf is set)")
 
 
+# When paddle_tpu.static is recording (enable_static / program_guard), this
+# holds a callable(fn, args, kwargs, outs, name) appending to the Program
+# tape; None in the (default) eager mode — one global check per op.
+_op_recorder = None
+
+
 def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     """Run ``fn`` (a pure JAX function) on mixed Tensor/raw args, recording a
     GradNode when grad is enabled and any Tensor input requires grad.
@@ -161,6 +167,8 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
         outs = tuple(out) if multi else (out,)
         _maybe_check_nan_inf(name, outs)
         wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        if _op_recorder is not None:
+            _op_recorder(fn, args, kwargs, wrapped, name)
         return wrapped if multi else wrapped[0]
 
     struct = {"multi": False}
@@ -184,6 +192,8 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     wrapped = tuple(
         Tensor(o, stop_gradient=False, node=node, out_index=k)
         for k, o in enumerate(outs))
+    if _op_recorder is not None:
+        _op_recorder(fn, args, kwargs, wrapped, name)
     if not multi:
         return wrapped[0]
     return wrapped

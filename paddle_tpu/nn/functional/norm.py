@@ -89,17 +89,33 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             return _normalize(a, m, v, wb), m, v
 
         out, bm, bv = apply_op(f_train, x, *args, op_name="batch_norm")
+
+        xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        n = xd.size // xd.shape[channel_axis % xd.ndim]
+        bias_corr = n / max(n - 1, 1)
+
+        def _upd_mean(old, m):
+            return momentum * old + (1 - momentum) * m.astype(old.dtype)
+
+        def _upd_var(old, v):
+            return momentum * old + (1 - momentum) * (
+                v * bias_corr).astype(old.dtype)
+
+        from ...static.program import current_program
+        prog = current_program()
+        if prog is not None:
+            # recording a static program: the eager mutation below would
+            # only ever see the record-time placeholder values, so register
+            # the update to run after every Executor.run replay instead
+            if isinstance(running_mean, Tensor):
+                prog.register_buffer_update(running_mean, bm, _upd_mean)
+            if isinstance(running_var, Tensor):
+                prog.register_buffer_update(running_var, bv, _upd_var)
+            return out
         if isinstance(running_mean, Tensor):
-            running_mean._data = (momentum * running_mean._data +
-                                  (1 - momentum) * bm._data.astype(
-                                      running_mean._data.dtype))
+            running_mean._data = _upd_mean(running_mean._data, bm._data)
         if isinstance(running_var, Tensor):
-            xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-            n = xd.size // xd.shape[channel_axis % xd.ndim]
-            unbiased = bv._data * (n / max(n - 1, 1))
-            running_var._data = (momentum * running_var._data +
-                                 (1 - momentum) * unbiased.astype(
-                                     running_var._data.dtype))
+            running_var._data = _upd_var(running_var._data, bv._data)
         return out
 
     def f(a, m, v, *wb):

@@ -113,6 +113,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import current_program
+        prog = current_program()
+        if prog is not None:
+            # static mode: attach; Executor.run compiles loss->grads->update
+            # into the replayed program (ref: append_backward + optimizer
+            # ops in static Program)
+            prog._optimizer = self
+            prog._loss = loss
+            prog.version += 1
+            return [], [(p, None) for p in self._parameter_list]
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,0 +1,32 @@
+"""paddle.static equivalent: record/replay static graphs compiled by XLA.
+
+ref: python/paddle/static/__init__.py. SURVEY.md layer 14 (paddle.static
+Program/Executor). The reference's ProgramDesc + StandaloneExecutor pair
+maps to an op tape recorded from the eager stream and replayed as one
+jitted function (§3.3 call stack collapses to a single XLA launch).
+
+    paddle.enable_static()
+    x = paddle.static.data("x", [None, 4], "float32")
+    y = paddle.matmul(x, w)
+    loss = ...
+    opt.minimize(loss)
+    exe = paddle.static.Executor()
+    exe.run(paddle.static.default_startup_program())
+    out, = exe.run(feed={"x": arr}, fetch_list=[loss])
+"""
+from __future__ import annotations
+
+from ..jit.api import InputSpec  # noqa: F401
+from .program import (  # noqa: F401
+    Program, data, default_main_program, default_startup_program,
+    program_guard,
+)
+from .executor import Executor, global_scope  # noqa: F401
+from .io import load_inference_model, save_inference_model  # noqa: F401
+from . import nn  # noqa: F401
+
+__all__ = [
+    "InputSpec", "Program", "data", "default_main_program",
+    "default_startup_program", "program_guard", "Executor", "global_scope",
+    "save_inference_model", "load_inference_model", "nn",
+]

@@ -1,0 +1,197 @@
+"""Static-graph Executor: replays a Program tape as one jitted function.
+
+ref: python/paddle/base/executor.py Executor.run -> StandaloneExecutor
+(SURVEY.md §3.3). TPU-native: the whole Program (and, when an optimizer
+was attached by minimize(), loss -> grads -> optimizer update) compiles to
+ONE XLA executable per (feed shapes, fetch set) signature, cached.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .program import Program, default_main_program
+
+__all__ = ["Executor", "global_scope"]
+
+
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+def _replay(program: Program, feed_vals: Dict[str, jax.Array],
+            ref_vals: Sequence[jax.Array]):
+    """Pure replay of the tape. Returns env mapping tensor-id -> value."""
+    env: Dict[int, jax.Array] = {}
+
+    def resolve(spec):
+        kind, v = spec
+        if kind == "feed":
+            return feed_vals[v]
+        if kind == "var":
+            return env[v]
+        if kind == "ref":
+            return ref_vals[v]
+        return v
+
+    for op in program.ops:
+        vals = [resolve(spec) for spec in op.arg_specs]
+        out = op.fn(*vals, **op.kwargs)
+        outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+        for oid, o in zip(op.out_ids, outs):
+            if oid is not None:
+                env[oid] = o
+    return env
+
+
+def _lookup_fetch(program, env, feed_arrays, ref_vals, t: Tensor):
+    tid = id(t)
+    if tid in env:
+        return env[tid]
+    name = getattr(t, "_static_feed_name", None)
+    if name is not None and name in feed_arrays:
+        return feed_arrays[name]
+    slot = program._refs.get(tid)
+    if slot is not None:
+        # resolve through ref_vals (a traced input), NOT t._data: inside
+        # jit the latter would bake the current value in as a constant
+        return ref_vals[slot]
+    raise KeyError(
+        f"fetch target {getattr(t, 'name', t)} is not produced by this "
+        f"program (was it created outside the program_guard?)")
+
+
+class Executor:
+    """ref: static.Executor. `place` is accepted for API parity; execution
+    always targets the default JAX backend."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, object] = {}
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list: Optional[Sequence[Tensor]] = None,
+            return_numpy: bool = True):
+        # loaded inference programs carry their own compiled callable
+        if program is not None and hasattr(program, "_exported_call"):
+            return program.run(feed, fetch_list, return_numpy)
+        if program is None:
+            program = default_main_program()
+        if not program.ops:  # e.g. the startup program: params are already
+            return []        # initialized eagerly at Layer construction
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        feed_arrays = {k: jnp.asarray(np.asarray(v)) for k, v in
+                       feed.items()}
+
+        opt = program._optimizer
+        key = (id(program), program.version,
+               tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in feed_arrays.items())),
+               tuple(id(t) for t in fetch_list), id(opt) if opt else None)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(program, fetch_list, opt)
+            self._cache[key] = compiled
+        outs = compiled(feed_arrays)
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    # -- compilation --------------------------------------------------------
+    def _compile(self, program: Program, fetch_list, opt):
+        ref_tensors = list(program._ref_tensors)
+        buf_updates = list(program._buffer_updates)
+        buf_src_ids = [sid for (_, sid, _) in buf_updates]
+
+        def _apply_buffer_updates(buf_vals):
+            for (buf, _, fn), val in zip(buf_updates, buf_vals):
+                buf._data = fn(buf._data, val)
+
+        if opt is None:
+            @jax.jit
+            def pure(feed_arrays, ref_vals):
+                env = _replay(program, feed_arrays, ref_vals)
+                fetches = [_lookup_fetch(program, env, feed_arrays,
+                                         ref_vals, t) for t in fetch_list]
+                return fetches, [env[sid] for sid in buf_src_ids]
+
+            def run(feed_arrays):
+                ref_vals = [t._data for t in ref_tensors]
+                fetches, buf_vals = pure(feed_arrays, ref_vals)
+                _apply_buffer_updates(buf_vals)
+                return fetches
+
+            return run
+
+        # optimizer attached by minimize(): param slots get grads + updates
+        if opt._grad_clip is not None:
+            import warnings
+            warnings.warn(
+                "grad_clip is not yet applied on the static-graph path; "
+                "use the dygraph path or clip-free optimizers here")
+        loss_t = program._loss
+        params = [t for t in ref_tensors
+                  if not t.stop_gradient and
+                  any(t is p for p in opt._parameter_list)]
+        param_slots = [program._refs[id(p)] for p in params]
+
+        def loss_of(param_vals, feed_arrays, ref_vals):
+            full = list(ref_vals)
+            for s, v in zip(param_slots, param_vals):
+                full[s] = v
+            env = _replay(program, feed_arrays, full)
+            return env[id(loss_t)], env
+
+        @jax.jit
+        def pure(feed_arrays, ref_vals, param_vals, states, lr):
+            (loss, env), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals, feed_arrays, ref_vals)
+            new_params, new_states = [], []
+            for p_t, p, g, s in zip(params, param_vals, grads, states):
+                # same per-param contract as eager step(): regularizer
+                # penalty, then the pure update; _cur_param/_current_pid
+                # feed trace-time metadata lookups (Lamb exclude fn,
+                # AdamW apply_decay_param_fun)
+                opt._cur_param = p_t
+                opt._current_pid = id(p_t)
+                g = opt._apply_regularizer(p, g)
+                np_, ns = opt._update(p, g, s, lr)
+                new_params.append(np_)
+                new_states.append(ns)
+            fetches = [_lookup_fetch(program, env, feed_arrays, ref_vals, t)
+                       for t in fetch_list]
+            return fetches, new_params, new_states, \
+                [env[sid] for sid in buf_src_ids]
+
+        def run(feed_arrays):
+            ref_vals = [t._data for t in ref_tensors]
+            param_vals = [p._data for p in params]
+            states = [opt._state_for(p) for p in params]
+            lr = opt.get_lr()
+            fetches, new_params, new_states, buf_vals = pure(
+                feed_arrays, ref_vals, param_vals, states,
+                jnp.float32(lr))
+            opt._global_step += 1
+            for p, v, ns in zip(params, new_params, new_states):
+                p._data = v
+                opt._states[id(p)] = ns
+            _apply_buffer_updates(buf_vals)
+            return fetches
+
+        return run

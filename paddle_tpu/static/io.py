@@ -1,0 +1,101 @@
+"""save/load_inference_model via jax.export (serialized StableHLO).
+
+ref: python/paddle/static/io.py save_inference_model (the reference
+serializes a pruned ProgramDesc + params; the TPU-native artifact is a
+serialized StableHLO module with the parameters baked in as constants,
+loadable and runnable with no Python model code).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..core.tensor import Tensor
+from .executor import _lookup_fetch, _replay
+from .program import Program, current_program
+
+__all__ = ["save_inference_model", "load_inference_model"]
+
+_MODEL_SUFFIX = ".pdmodel"
+_META_SUFFIX = ".pdmeta.json"
+
+
+def save_inference_model(path_prefix: str, feed_vars: Sequence[Tensor],
+                         fetch_vars: Sequence[Tensor], executor=None,
+                         program: Program = None, **kwargs) -> None:
+    if program is None:
+        program = current_program()
+    if program is None:
+        from .program import default_main_program
+        program = default_main_program()
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    feed_names = [t._static_feed_name for t in feed_vars]
+    ref_vals = [t._data for t in program._ref_tensors]
+
+    def pure(*feed_arrays):
+        feeds = dict(zip(feed_names, feed_arrays))
+        env = _replay(program, feeds, ref_vals)
+        return tuple(_lookup_fetch(program, env, feeds, ref_vals, t)
+                     for t in fetch_vars)
+
+    # export with a symbolic batch dim where the placeholder declared
+    # None/-1 (recorded as size 1); fall back to the concrete trace shape
+    specs, symbolic = [], True
+    try:
+        batch = jax_export.symbolic_shape("batch")[0]
+        for t in feed_vars:
+            shape = list(t._data.shape)
+            if shape:
+                shape[0] = batch
+            specs.append(jax.ShapeDtypeStruct(tuple(shape), t._data.dtype))
+        exported = jax_export.export(jax.jit(pure))(*specs)
+    except Exception:
+        symbolic = False
+        specs = [jax.ShapeDtypeStruct(t._data.shape, t._data.dtype)
+                 for t in feed_vars]
+        exported = jax_export.export(jax.jit(pure))(*specs)
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + _MODEL_SUFFIX, "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + _META_SUFFIX, "w") as f:
+        json.dump({"feed_names": feed_names,
+                   "num_fetch": len(fetch_vars),
+                   "symbolic_batch": symbolic}, f)
+
+
+class _LoadedProgram:
+    """Stands in for the inference Program returned by
+    load_inference_model; Executor.run dispatches to it."""
+
+    def __init__(self, exported, feed_names, num_fetch):
+        self._exported_call = exported.call
+        self.feed_names = feed_names
+        self.num_fetch = num_fetch
+
+    def run(self, feed, fetch_list=None, return_numpy=True):
+        arrays = [jnp.asarray(np.asarray(feed[n])) for n in self.feed_names]
+        outs = self._exported_call(*arrays)
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] like the
+    reference; fetch_targets are positional indices here (the serialized
+    module has no variable names)."""
+    with open(path_prefix + _MODEL_SUFFIX, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + _META_SUFFIX) as f:
+        meta = json.load(f)
+    prog = _LoadedProgram(exported, meta["feed_names"], meta["num_fetch"])
+    return [prog, meta["feed_names"], list(range(meta["num_fetch"]))]

@@ -1,0 +1,82 @@
+"""static.nn — layer-creating ops for static programs.
+
+ref: python/paddle/static/nn/ (fc, embedding, conv2d, batch_norm...). Each
+call creates the underlying nn.Layer once, keyed by name on the recording
+Program so parameters persist across Executor.run calls.
+"""
+from __future__ import annotations
+
+from .. import nn as _nn
+from .program import current_program
+
+__all__ = ["fc", "embedding", "conv2d", "batch_norm"]
+
+
+def _layer(kind, name, factory):
+    prog = current_program()
+    if prog is None:
+        raise RuntimeError("static.nn ops require enable_static() or a "
+                           "program_guard")
+    key = name or f"{kind}_{len(prog._layers)}"
+    layer = prog._layers.get(key)
+    if layer is None:
+        layer = factory()
+        prog._layers[key] = layer
+    return layer
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+    in_f = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_f *= int(d)
+    layer = _layer("fc", name, lambda: _nn.Linear(in_f, size))
+    h = x
+    if len(x.shape) > num_flatten_dims + 1:
+        h = x.reshape(list(x.shape[:num_flatten_dims]) + [in_f])
+    out = layer(h)
+    if activation == "relu":
+        out = _nn.functional.relu(out)
+    elif activation == "tanh":
+        out = _nn.functional.tanh(out)
+    elif activation == "sigmoid":
+        out = _nn.functional.sigmoid(out)
+    elif activation:
+        raise ValueError(f"unsupported activation {activation!r}")
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, name=None):
+    layer = _layer("embedding", name,
+                   lambda: _nn.Embedding(size[0], size[1],
+                                         padding_idx=padding_idx))
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           groups=1, name=None, act=None):
+    in_ch = int(input.shape[1])
+    layer = _layer("conv2d", name,
+                   lambda: _nn.Conv2D(in_ch, num_filters, filter_size,
+                                      stride=stride, padding=padding,
+                                      groups=groups))
+    out = layer(input)
+    if act == "relu":
+        out = _nn.functional.relu(out)
+    elif act:
+        raise ValueError(f"unsupported act {act!r}")
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-05, name=None):
+    ch = int(input.shape[1])
+    layer = _layer("batch_norm", name, lambda: _nn.BatchNorm2D(
+        ch, momentum=momentum, epsilon=epsilon))
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act == "relu":
+        out = _nn.functional.relu(out)
+    elif act:
+        raise ValueError(f"unsupported act {act!r}")
+    return out

@@ -1,0 +1,215 @@
+"""Static-graph Program: a record/replay tape over the eager op stream.
+
+ref: python/paddle/base/framework.py Program / python/paddle/static. The
+reference builds a ProgramDesc of op protos and runs it on the
+StandaloneExecutor (SURVEY.md §3.3); the TPU-native equivalent records the
+apply_op stream while the user's Python runs once on placeholder data, then
+replays it as ONE pure jitted function per (feed-shape, fetch) signature —
+trace -> StableHLO -> XLA, the single execution path of this framework.
+
+Recorded argument kinds:
+  ("feed", name)   static.data placeholder — bound from exe.run(feed=...)
+  ("var", id)      output of an earlier recorded op
+  ("ref", slot)    any leaf Tensor (Parameter, buffer, constant) — read
+                   fresh from the live Tensor at run time, so optimizer
+                   updates and buffer mutations are visible across runs
+  ("raw", value)   non-Tensor python value, replayed verbatim
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import autograd as _autograd
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data"]
+
+
+class _OpRecord:
+    __slots__ = ("fn", "kwargs", "arg_specs", "out_ids", "name")
+
+    def __init__(self, fn, kwargs, arg_specs, out_ids, name):
+        self.fn = fn
+        self.kwargs = kwargs
+        self.arg_specs = arg_specs
+        self.out_ids = out_ids
+        self.name = name
+
+
+class Program:
+    """An op tape + the tensors it references. Populated by running user
+    code under program_guard (or after enable_static())."""
+
+    def __init__(self):
+        self.ops: List[_OpRecord] = []
+        self.feeds: Dict[str, Tensor] = {}
+        self._produced: Dict[int, Tensor] = {}  # id -> strong ref
+        self._refs: Dict[int, int] = {}         # tensor id -> slot
+        self._ref_tensors: List[Tensor] = []    # slot -> live Tensor
+        self.version = 0
+        # set by Optimizer.minimize under static mode
+        self._optimizer = None
+        self._loss = None
+        self._layers: Dict[str, Any] = {}       # static.nn layer registry
+        # (buffer_tensor, produced_tensor_id, pure update fn(old, val)):
+        # replayed buffer mutations (e.g. BN running stats) applied by the
+        # Executor after each run — see register_buffer_update
+        self._buffer_updates: List[tuple] = []
+
+    # -- recording ----------------------------------------------------------
+    def _ref_slot(self, t: Tensor) -> int:
+        slot = self._refs.get(id(t))
+        if slot is None:
+            slot = len(self._ref_tensors)
+            self._refs[id(t)] = slot
+            self._ref_tensors.append(t)
+        return slot
+
+    def _spec_for(self, a) -> tuple:
+        if isinstance(a, Tensor):
+            name = getattr(a, "_static_feed_name", None)
+            if name is not None:
+                return ("feed", name)
+            if id(a) in self._produced:
+                return ("var", id(a))
+            return ("ref", self._ref_slot(a))
+        return ("raw", a)
+
+    def _record(self, fn: Callable, args, kwargs, outs, name: str):
+        specs = tuple(self._spec_for(a) for a in args)
+        out_ids = []
+        for o in outs:
+            if isinstance(o, Tensor):
+                self._produced[id(o)] = o
+                out_ids.append(id(o))
+            else:
+                out_ids.append(None)
+        self.ops.append(_OpRecord(fn, dict(kwargs), specs, out_ids, name))
+        self.version += 1
+
+    def register_buffer_update(self, buffer: Tensor, src: Tensor, fn):
+        """Arrange for ``buffer._data = fn(buffer._data, value_of(src))``
+        after every Executor.run of this program. ``src`` must be an output
+        of a recorded op (e.g. the batch-mean output of batch_norm); ``fn``
+        must be pure/jittable. This is how eager in-place buffer mutations
+        (BN running stats) survive the record/replay split."""
+        self._buffer_updates.append((buffer, id(src), fn))
+        self.version += 1
+
+    # -- introspection ------------------------------------------------------
+    def parameters(self):
+        from ..core.tensor import Parameter
+        return [t for t in self._ref_tensors if isinstance(t, Parameter)]
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        return (f"<Program ops={len(self.ops)} feeds={list(self.feeds)} "
+                f"refs={len(self._ref_tensors)}>")
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.static_mode = False
+        self.guard_stack: List[Program] = []
+
+
+_state = _State()
+# default programs are process-wide (like the reference's globals)
+_defaults = {"main": Program(), "startup": Program()}
+# the recorder hook in core.autograd is process-global; it stays installed
+# while ANY thread has static mode / a program_guard active (refcounted),
+# and resolves the target program thread-locally — so one thread leaving
+# static mode cannot disable another thread's active recording
+_active_lock = threading.Lock()
+_active_count = 0
+
+
+def _static_mode() -> bool:
+    return _state.static_mode
+
+
+def _set_static_mode(on: bool):
+    if on == _state.static_mode:
+        return
+    _state.static_mode = on
+    _adjust_active(1 if on else -1)
+
+
+def current_program() -> Optional[Program]:
+    """The program recording in this thread right now, if any."""
+    if _state.guard_stack:
+        return _state.guard_stack[-1]
+    if _state.static_mode:
+        return _defaults["main"]
+    return None
+
+
+def _recorder(fn, args, kwargs, outs, name):
+    prog = current_program()
+    if prog is not None:
+        prog._record(fn, args, kwargs, outs, name)
+
+
+def _adjust_active(delta: int):
+    global _active_count
+    with _active_lock:
+        _active_count += delta
+        _autograd._op_recorder = _recorder if _active_count > 0 else None
+
+
+def default_main_program() -> Program:
+    return _defaults["main"]
+
+
+def default_startup_program() -> Program:
+    return _defaults["startup"]
+
+
+def _reset_default_programs():
+    _defaults["main"] = Program()
+    _defaults["startup"] = Program()
+
+
+class program_guard:
+    """Record ops into `main_program` (ref: static.program_guard)."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _state.guard_stack.append(self.main)
+        _adjust_active(1)
+        return self.main
+
+    def __exit__(self, *exc):
+        _state.guard_stack.pop()
+        _adjust_active(-1)
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Feed placeholder (ref: static.data). Records into the current
+    program; None/-1 dims stand in as 1 during recording and are re-traced
+    to the fed shape at exe.run time."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    prog = current_program()
+    if prog is None:
+        raise RuntimeError(
+            "static.data requires enable_static() or a program_guard")
+    concrete = tuple(1 if (d is None or (isinstance(d, int) and d < 0))
+                     else int(d) for d in shape)
+    from ..core.dtype import convert_dtype
+    t = Tensor(jnp.zeros(concrete, convert_dtype(dtype)))
+    t.stop_gradient = True
+    t._static_feed_name = name
+    t.name = name
+    prog.feeds[name] = t
+    return t
