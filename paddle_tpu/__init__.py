@@ -77,6 +77,7 @@ from .framework.io import save, load  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 
 from . import static  # noqa: F401
+from . import geometric  # noqa: F401
 
 
 def disable_static(place=None):

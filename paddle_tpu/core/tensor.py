@@ -25,7 +25,7 @@ class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
                  "_retain_grads", "_hooks", "_hook_counter", "name",
                  "trainable", "__weakref__", "_dist_attr",
-                 "_static_feed_name")
+                 "_static_feed_name", "_static_rng")
 
     def __init__(self, data, stop_gradient: bool = True, node=None,
                  out_index: int = 0, name: Optional[str] = None):

@@ -9,3 +9,10 @@ from .distributions import (  # noqa: F401
     Bernoulli, Categorical, Distribution, Exponential, Gumbel, Laplace,
     LogNormal, Normal, Uniform, kl_divergence, register_kl,
 )
+from .extra import (  # noqa: F401
+    AbsTransform, AffineTransform, Beta, Binomial, Cauchy, ChainTransform,
+    Chi2, Dirichlet, ExpTransform, Gamma, Geometric, Independent,
+    Multinomial, MultivariateNormal, Poisson, PowerTransform,
+    SigmoidTransform, StudentT, TanhTransform, Transform,
+    TransformedDistribution,
+)

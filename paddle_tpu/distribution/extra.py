@@ -1,0 +1,695 @@
+"""Extended distribution zoo.
+
+ref: python/paddle/distribution/{beta,gamma,chi2,dirichlet,geometric,
+poisson,binomial,multinomial,student_t,cauchy,multivariate_normal,
+independent,transform,transformed_distribution}.py — same API surface,
+implemented over jax.random (gamma/dirichlet samplers carry implicit
+reparameterization gradients, so rsample is differentiable where the
+reference's is).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.special import betaln, digamma, gammaln
+
+from ..core import random as random_mod
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from .distributions import (Distribution, _shape, _t, kl_divergence,
+                            register_kl)
+
+__all__ = [
+    "Beta", "Gamma", "Chi2", "Dirichlet", "Geometric", "Poisson",
+    "Binomial", "Multinomial", "StudentT", "Cauchy", "MultivariateNormal",
+    "Independent", "TransformedDistribution", "Transform",
+    "AffineTransform", "ExpTransform", "SigmoidTransform", "TanhTransform",
+    "AbsTransform", "PowerTransform", "ChainTransform",
+]
+
+
+class Gamma(Distribution):
+    """ref: gamma.py Gamma(concentration, rate)."""
+
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(jnp.broadcast_shapes(
+            self.concentration._data.shape, self.rate._data.shape))
+
+    @property
+    def mean(self):
+        return apply_op(lambda a, r: jnp.broadcast_to(a / r,
+                                                      self.batch_shape),
+                        self.concentration, self.rate, op_name="gamma_mean")
+
+    @property
+    def variance(self):
+        return apply_op(lambda a, r: jnp.broadcast_to(a / r ** 2,
+                                                      self.batch_shape),
+                        self.concentration, self.rate, op_name="gamma_var")
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+
+        def f(a, r):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, shp))
+            return g / r
+        return apply_op(f, self.concentration, self.rate,
+                        op_name="gamma_rsample")
+
+    def log_prob(self, value):
+        def f(v, a, r):
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                    - gammaln(a))
+        return apply_op(f, _t(value), self.concentration, self.rate,
+                        op_name="gamma_log_prob")
+
+    def entropy(self):
+        def f(a, r):
+            out = a - jnp.log(r) + gammaln(a) + (1 - a) * digamma(a)
+            return jnp.broadcast_to(out, self.batch_shape)
+        return apply_op(f, self.concentration, self.rate,
+                        op_name="gamma_entropy")
+
+
+class Chi2(Gamma):
+    """ref: chi2.py Chi2(df) == Gamma(df/2, 1/2)."""
+
+    def __init__(self, df):
+        self.df = _t(df)
+        super().__init__(apply_op(lambda d: d / 2, self.df,
+                                  op_name="chi2_df"), _t(0.5))
+
+
+class Beta(Distribution):
+    """ref: beta.py Beta(alpha, beta); sampled as Ga/(Ga+Gb)."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha._data.shape,
+                                              self.beta._data.shape))
+
+    @property
+    def mean(self):
+        return apply_op(lambda a, b: jnp.broadcast_to(a / (a + b),
+                                                      self.batch_shape),
+                        self.alpha, self.beta, op_name="beta_mean")
+
+    @property
+    def variance(self):
+        def f(a, b):
+            t = a + b
+            return jnp.broadcast_to(a * b / (t * t * (t + 1)),
+                                    self.batch_shape)
+        return apply_op(f, self.alpha, self.beta, op_name="beta_var")
+
+    def rsample(self, shape=()):
+        k1, k2 = random_mod.next_key(), random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+
+        def f(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, shp))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, shp))
+            return ga / (ga + gb)
+        return apply_op(f, self.alpha, self.beta, op_name="beta_rsample")
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - betaln(a, b))
+        return apply_op(f, _t(value), self.alpha, self.beta,
+                        op_name="beta_log_prob")
+
+    def entropy(self):
+        def f(a, b):
+            t = a + b
+            out = (betaln(a, b) - (a - 1) * digamma(a)
+                   - (b - 1) * digamma(b) + (t - 2) * digamma(t))
+            return jnp.broadcast_to(out, self.batch_shape)
+        return apply_op(f, self.alpha, self.beta, op_name="beta_entropy")
+
+
+class Dirichlet(Distribution):
+    """ref: dirichlet.py Dirichlet(concentration)."""
+
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        shape = self.concentration._data.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return apply_op(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                        self.concentration, op_name="dirichlet_mean")
+
+    @property
+    def variance(self):
+        def f(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+        return apply_op(f, self.concentration, op_name="dirichlet_var")
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape + self.event_shape
+
+        def f(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, shp))
+            return g / jnp.sum(g, -1, keepdims=True)
+        return apply_op(f, self.concentration, op_name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        def f(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+        return apply_op(f, _t(value), self.concentration,
+                        op_name="dirichlet_log_prob")
+
+    def entropy(self):
+        def f(c):
+            c0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            return (jnp.sum(gammaln(c), -1) - gammaln(c0)
+                    + (c0 - k) * digamma(c0)
+                    - jnp.sum((c - 1) * digamma(c), -1))
+        return apply_op(f, self.concentration, op_name="dirichlet_entropy")
+
+
+class Geometric(Distribution):
+    """ref: geometric.py Geometric(probs): failures before first success,
+    pmf (1-p)^k p, support k in {0, 1, ...}."""
+
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(self.probs._data.shape)
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: (1 - p) / p, self.probs,
+                        op_name="geometric_mean")
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: (1 - p) / p ** 2, self.probs,
+                        op_name="geometric_var")
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(key, shp, minval=1e-7, maxval=1.0)
+
+        def f(p):
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        return apply_op(f, self.probs, op_name="geometric_sample").detach()
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, p):
+            return v * jnp.log1p(-p) + jnp.log(p)
+        return apply_op(f, _t(value), self.probs,
+                        op_name="geometric_log_prob")
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return apply_op(f, self.probs, op_name="geometric_entropy")
+
+
+class Poisson(Distribution):
+    """ref: poisson.py Poisson(rate)."""
+
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(self.rate._data.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+
+        def f(r):
+            return jax.random.poisson(key, jnp.broadcast_to(r, shp)
+                                      ).astype(jnp.float32)
+        return apply_op(f, self.rate, op_name="poisson_sample").detach()
+
+    def log_prob(self, value):
+        def f(v, r):
+            return v * jnp.log(r) - r - gammaln(v + 1)
+        return apply_op(f, _t(value), self.rate, op_name="poisson_log_prob")
+
+    def entropy(self):
+        """Series approximation (matches the reference's approach for
+        moderate rates)."""
+        def f(r):
+            return r * (1 - jnp.log(r)) + 0.5 * jnp.log(
+                2 * math.pi * jnp.e * r)
+        return apply_op(f, self.rate, op_name="poisson_entropy")
+
+
+class Binomial(Distribution):
+    """ref: binomial.py Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(jnp.broadcast_shapes(
+            self.total_count._data.shape, self.probs._data.shape))
+
+    @property
+    def mean(self):
+        return apply_op(lambda n, p: n * p, self.total_count, self.probs,
+                        op_name="binomial_mean")
+
+    @property
+    def variance(self):
+        return apply_op(lambda n, p: n * p * (1 - p), self.total_count,
+                        self.probs, op_name="binomial_var")
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+
+        def f(n, p):
+            return jax.random.binomial(key, jnp.broadcast_to(n, shp),
+                                       jnp.broadcast_to(p, shp)
+                                       ).astype(jnp.float32)
+        return apply_op(f, self.total_count, self.probs,
+                        op_name="binomial_sample").detach()
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            log_comb = (gammaln(n + 1) - gammaln(v + 1)
+                        - gammaln(n - v + 1))
+            return log_comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+        return apply_op(f, _t(value), self.total_count, self.probs,
+                        op_name="binomial_log_prob")
+
+
+class Multinomial(Distribution):
+    """ref: multinomial.py Multinomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shape = self.probs._data.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        n_cat = self.probs._data.shape[-1]
+        shp = _shape(shape) + self.batch_shape
+
+        def f(p):
+            logits = jnp.log(jnp.broadcast_to(p, shp + (n_cat,)))
+            draws = jax.random.categorical(
+                key, logits[..., None, :].repeat(self.total_count, -2))
+            return jax.nn.one_hot(draws, n_cat).sum(-2)
+        return apply_op(f, self.probs, op_name="multinomial_sample"
+                        ).detach()
+
+    def log_prob(self, value):
+        def f(v, p):
+            return (gammaln(jnp.asarray(self.total_count + 1.0))
+                    - jnp.sum(gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(p), -1))
+        return apply_op(f, _t(value), self.probs,
+                        op_name="multinomial_log_prob")
+
+
+class StudentT(Distribution):
+    """ref: student_t.py StudentT(df, loc, scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df._data.shape, self.loc._data.shape,
+            self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda d, l: jnp.where(d > 1, jnp.broadcast_to(
+                l, self.batch_shape), jnp.nan),
+            self.df, self.loc, op_name="studentt_mean")
+
+    @property
+    def variance(self):
+        def f(d, s):
+            v = jnp.where(d > 2, s ** 2 * d / (d - 2), jnp.inf)
+            return jnp.broadcast_to(jnp.where(d > 1, v, jnp.nan),
+                                    self.batch_shape)
+        return apply_op(f, self.df, self.scale, op_name="studentt_var")
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+
+        def f(d, l, s):
+            t = jax.random.t(key, jnp.broadcast_to(d, shp), shape=shp)
+            return l + s * t
+        return apply_op(f, self.df, self.loc, self.scale,
+                        op_name="studentt_rsample")
+
+    def log_prob(self, value):
+        def f(v, d, l, s):
+            z = (v - l) / s
+            return (gammaln((d + 1) / 2) - gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                    - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+        return apply_op(f, _t(value), self.df, self.loc, self.scale,
+                        op_name="studentt_log_prob")
+
+
+class Cauchy(Distribution):
+    """ref: cauchy.py Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+
+        def f(l, s):
+            return l + s * jax.random.cauchy(key, shp)
+        return apply_op(f, self.loc, self.scale, op_name="cauchy_rsample")
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return -jnp.log(math.pi * s * (1 + z ** 2))
+        return apply_op(f, _t(value), self.loc, self.scale,
+                        op_name="cauchy_log_prob")
+
+    def entropy(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(jnp.log(4 * math.pi * s),
+                                       self.batch_shape),
+            self.scale, op_name="cauchy_entropy")
+
+
+class MultivariateNormal(Distribution):
+    """ref: multivariate_normal.py MultivariateNormal(loc,
+    covariance_matrix=...)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = _t(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError(
+                "pass exactly one of covariance_matrix / scale_tril")
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+        else:
+            cov = _t(covariance_matrix)
+            self.scale_tril = apply_op(jnp.linalg.cholesky, cov,
+                                       op_name="mvn_chol")
+        d = self.loc._data.shape[-1]
+        super().__init__(self.loc._data.shape[:-1], (d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(key, shp)
+
+        def f(l, st):
+            return l + jnp.einsum("...ij,...j->...i", st, eps)
+        return apply_op(f, self.loc, self.scale_tril, op_name="mvn_rsample")
+
+    def log_prob(self, value):
+        def f(v, l, st):
+            d = v.shape[-1]
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(
+                jnp.broadcast_to(st, diff.shape[:-1] + st.shape[-2:]),
+                diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(sol ** 2, -1)
+            logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(st, axis1=-2,
+                                                      axis2=-1)), -1)
+            return -0.5 * (maha + d * math.log(2 * math.pi) + logdet)
+        return apply_op(f, _t(value), self.loc, self.scale_tril,
+                        op_name="mvn_log_prob")
+
+    def entropy(self):
+        def f(st):
+            d = st.shape[-1]
+            logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(st, axis1=-2,
+                                                      axis2=-1)), -1)
+            out = 0.5 * (d * (1 + math.log(2 * math.pi)) + logdet)
+            return jnp.broadcast_to(out, self.batch_shape)
+        return apply_op(f, self.scale_tril, op_name="mvn_entropy")
+
+
+class Independent(Distribution):
+    """ref: independent.py — reinterpret batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        b = base.batch_shape
+        super().__init__(b[:len(b) - self.rank],
+                         b[len(b) - self.rank:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self.rank, 0))
+        return apply_op(lambda x: jnp.sum(x, axes), lp,
+                        op_name="independent_log_prob")
+
+    def entropy(self):
+        e = self.base.entropy()
+        axes = tuple(range(-self.rank, 0))
+        return apply_op(lambda x: jnp.sum(x, axes), e,
+                        op_name="independent_entropy")
+
+
+# --------------------------- transforms -----------------------------------
+
+class Transform:
+    """ref: transform.py Transform ABC (forward / inverse /
+    forward_log_det_jacobian)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return apply_op(lambda a: -a,
+                        self.forward_log_det_jacobian(self.inverse(y)),
+                        op_name="ildj")
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return apply_op(lambda v, l, s: l + s * v, _t(x), self.loc,
+                        self.scale, op_name="affine_fwd")
+
+    def inverse(self, y):
+        return apply_op(lambda v, l, s: (v - l) / s, _t(y), self.loc,
+                        self.scale, op_name="affine_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(
+            lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)), v.shape),
+            _t(x), self.scale, op_name="affine_ldj")
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return apply_op(jnp.exp, _t(x), op_name="exp_fwd")
+
+    def inverse(self, y):
+        return apply_op(jnp.log, _t(y), op_name="exp_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)  # d/dx exp(x) = exp(x); log of that is x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return apply_op(lambda v, p: jnp.power(v, p), _t(x), self.power,
+                        op_name="power_fwd")
+
+    def inverse(self, y):
+        return apply_op(lambda v, p: jnp.power(v, 1.0 / p), _t(y),
+                        self.power, op_name="power_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(
+            lambda v, p: jnp.log(jnp.abs(p * jnp.power(v, p - 1))),
+            _t(x), self.power, op_name="power_ldj")
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return apply_op(jax.nn.sigmoid, _t(x), op_name="sigmoid_fwd")
+
+    def inverse(self, y):
+        return apply_op(lambda v: jnp.log(v) - jnp.log1p(-v), _t(y),
+                        op_name="sigmoid_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(
+            lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v), _t(x),
+            op_name="sigmoid_ldj")
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return apply_op(jnp.tanh, _t(x), op_name="tanh_fwd")
+
+    def inverse(self, y):
+        return apply_op(jnp.arctanh, _t(y), op_name="tanh_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(
+            lambda v: 2.0 * (math.log(2.0) - v - jax.nn.softplus(-2.0 * v)),
+            _t(x), op_name="tanh_ldj")
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return apply_op(jnp.abs, _t(x), op_name="abs_fwd")
+
+    def inverse(self, y):
+        return _t(y)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else total + ldj
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """ref: transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        ildj = apply_op(lambda a: -a,
+                        self.transform.forward_log_det_jacobian(x),
+                        op_name="neg")
+        return self.base.log_prob(x) + ildj
+
+
+# ------------------------------ KL pairs -----------------------------------
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p: Gamma, q: Gamma):
+    def f(pa, pr, qa, qr):
+        return ((pa - qa) * digamma(pa) - gammaln(pa) + gammaln(qa)
+                + qa * (jnp.log(pr) - jnp.log(qr)) + pa * (qr - pr) / pr)
+    return apply_op(f, p.concentration, p.rate, q.concentration, q.rate,
+                    op_name="kl_gamma")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p: Beta, q: Beta):
+    def f(pa, pb, qa, qb):
+        pt = pa + pb
+        return (betaln(qa, qb) - betaln(pa, pb)
+                + (pa - qa) * digamma(pa) + (pb - qb) * digamma(pb)
+                + (qa - pa + qb - pb) * digamma(pt))
+    return apply_op(f, p.alpha, p.beta, q.alpha, q.beta, op_name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p: Dirichlet, q: Dirichlet):
+    def f(pc, qc):
+        p0 = jnp.sum(pc, -1)
+        return (gammaln(p0) - jnp.sum(gammaln(pc), -1)
+                - gammaln(jnp.sum(qc, -1)) + jnp.sum(gammaln(qc), -1)
+                + jnp.sum((pc - qc) * (digamma(pc)
+                                       - digamma(p0[..., None])), -1))
+    return apply_op(f, p.concentration, q.concentration,
+                    op_name="kl_dirichlet")
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p: Poisson, q: Poisson):
+    def f(pr, qr):
+        return pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr
+    return apply_op(f, p.rate, q.rate, op_name="kl_poisson")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p: Geometric, q: Geometric):
+    def f(pp, qp):
+        return ((1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp))
+                + jnp.log(pp) - jnp.log(qp))
+    return apply_op(f, p.probs, q.probs, op_name="kl_geometric")

@@ -1,0 +1,131 @@
+"""paddle.geometric equivalent — graph message passing primitives.
+
+ref: python/paddle/geometric/ (segment_sum/mean/max/min in
+math/segment.py, send_u_recv / send_ue_recv message passing in
+message_passing/send_recv.py, reindex_graph in reindex.py). TPU-native:
+jax.ops.segment_* (one-hot scatter-add lowers onto the MXU for large
+segment counts; XLA picks the strategy).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "reindex_graph",
+]
+
+
+def _num_segments(segment_ids, n):
+    if n is not None:
+        return int(n)
+    ids = segment_ids._data if isinstance(segment_ids, Tensor) else \
+        jnp.asarray(segment_ids)
+    return int(jax.device_get(ids.max())) + 1 if ids.size else 0
+
+
+def segment_sum(data, segment_ids, name=None, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+    return apply_op(
+        lambda d, i: jax.ops.segment_sum(d, i, num_segments=n),
+        data, segment_ids, op_name="segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+
+    def f(d, i):
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), i,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return s / jnp.maximum(cnt, 1).reshape(shape)
+    return apply_op(f, data, segment_ids, op_name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+
+    def f(d, i):
+        out = jax.ops.segment_max(d, i, num_segments=n)
+        # paddle returns 0 for empty segments (not -inf)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],)), i,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return jnp.where(cnt.reshape(shape) > 0, out, 0).astype(d.dtype)
+    return apply_op(f, data, segment_ids, op_name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+
+    def f(d, i):
+        out = jax.ops.segment_min(d, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],)), i,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return jnp.where(cnt.reshape(shape) > 0, out, 0).astype(d.dtype)
+    return apply_op(f, data, segment_ids, op_name="segment_min")
+
+
+_REDUCERS = {"sum": segment_sum, "mean": segment_mean, "max": segment_max,
+             "min": segment_min, "add": segment_sum}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] then segment-reduce onto dst
+    (ref: message_passing/send_recv.py send_u_recv)."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    n = int(out_size) if out_size is not None else xd.shape[0]
+    gathered = apply_op(lambda a, s: jnp.take(a, s, axis=0), x, src_index,
+                        op_name="gather_src")
+    return _REDUCERS[reduce_op](gathered, dst_index, num_segments=n)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine x[src] with edge features y, then reduce onto dst
+    (ref: send_recv.py send_ue_recv)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    n = int(out_size) if out_size is not None else xd.shape[0]
+    msg = apply_op(
+        lambda a, e, s: ops[message_op](jnp.take(a, s, axis=0), e),
+        x, y, src_index, op_name="message")
+    return _REDUCERS[reduce_op](msg, dst_index, num_segments=n)
+
+
+def reindex_graph(x, neighbors, count, name=None):
+    """Compact global node ids to local ids (ref: reindex.py
+    reindex_graph). Host-side (ragged, data-dependent sizes — not a
+    compiled op in the reference either)."""
+    import numpy as np
+
+    xv = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    nv = np.asarray(neighbors.numpy() if isinstance(neighbors, Tensor)
+                    else neighbors)
+    cv = np.asarray(count.numpy() if isinstance(count, Tensor) else count)
+    order = {int(v): i for i, v in enumerate(xv)}
+    nodes = list(xv)
+    for v in nv:
+        if int(v) not in order:
+            order[int(v)] = len(nodes)
+            nodes.append(v)
+    reindex_src = np.array([order[int(v)] for v in nv], np.int64)
+    reindex_dst = np.repeat(np.arange(len(cv), dtype=np.int64), cv)
+    out_nodes = np.asarray(nodes, dtype=xv.dtype)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))

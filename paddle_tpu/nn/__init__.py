@@ -26,7 +26,9 @@ from .layers_activation import (  # noqa: F401
 from .layers_loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
     BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
-    TripletMarginLoss, HingeEmbeddingLoss, CTCLoss,
+    TripletMarginLoss, HingeEmbeddingLoss, CTCLoss, SoftMarginLoss,
+    MultiLabelSoftMarginLoss, MultiMarginLoss, PoissonNLLLoss,
+    GaussianNLLLoss,
 )
 from .transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerEncoder,

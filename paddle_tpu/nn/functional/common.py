@@ -12,6 +12,15 @@ from ...core.dtype import convert_dtype
 from ...core.tensor import Tensor
 
 
+def _rng_key_tensor() -> Tensor:
+    """A fresh PRNG key wrapped as a marked Tensor arg: eager ops consume
+    the concrete key; static recording turns the marker into an ("rng", i)
+    slot that the Executor refills with a fresh key on every run."""
+    t = Tensor(random_mod.next_key())
+    t._static_rng = True
+    return t
+
+
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with paddle's [in, out] weight layout
     (ref: python/paddle/nn/functional/common.py linear)."""
@@ -32,9 +41,11 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
                         op_name="dropout")
     if p == 1.0:
         return apply_op(lambda a: jnp.zeros_like(a), x, op_name="dropout")
-    key = random_mod.next_key()
+    # the key rides as a marked arg (not a closure capture) so static
+    # replay can substitute a fresh key every Executor.run
+    key_t = _rng_key_tensor()
 
-    def f(a):
+    def f(a, key):
         shape = list(a.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
@@ -43,7 +54,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
-    return apply_op(f, x, op_name="dropout")
+    return apply_op(f, x, key_t, op_name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -59,18 +70,18 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x
-    key = random_mod.next_key()
+    key_t = _rng_key_tensor()
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
 
-    def f(a):
+    def f(a, key):
         keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
         q = 1.0 - p
         a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
         b_coef = -a_coef * alpha_p * p
         return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
-    return apply_op(f, x, op_name="alpha_dropout")
+    return apply_op(f, x, key_t, op_name="alpha_dropout")
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):

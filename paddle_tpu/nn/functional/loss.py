@@ -272,3 +272,71 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(loss, reduction)
     return apply_op(f, log_probs, labels, input_lengths, label_lengths,
                     op_name="ctc_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """ref: loss.py soft_margin_loss: log(1 + exp(-label * input)),
+    computed as softplus(-label*input) for overflow stability."""
+    return apply_op(
+        lambda a, b: _reduce(jax.nn.softplus(-b * a), reduction),
+        input, label, op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """ref: loss.py multi_label_soft_margin_loss (mean over classes of
+    BCE-with-logits terms)."""
+    def f(a, b, *w):
+        term = (b * jax.nn.log_sigmoid(a)
+                + (1 - b) * jax.nn.log_sigmoid(-a))
+        if w:
+            term = term * w[0]
+        return _reduce(-term.mean(-1), reduction)
+    args = [weight] if weight is not None else []
+    return apply_op(f, input, label, *args,
+                    op_name="multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """ref: loss.py multi_margin_loss (multi-class hinge)."""
+    def f(a, lbl, *w):
+        n, c = a.shape
+        correct = jnp.take_along_axis(a, lbl[:, None], 1)
+        m = jnp.maximum(0.0, margin - correct + a)
+        if p != 1:
+            m = m ** p
+        if w:
+            m = m * jnp.take(w[0], lbl)[:, None]
+        mask = 1.0 - jax.nn.one_hot(lbl, c, dtype=a.dtype)
+        return _reduce((m * mask).sum(-1) / c, reduction)
+    args = [weight] if weight is not None else []
+    return apply_op(f, input, label, *args, op_name="multi_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """ref: loss.py poisson_nll_loss."""
+    def f(a, b):
+        if log_input:
+            v = jnp.exp(a) - b * a
+        else:
+            v = a - b * jnp.log(a + epsilon)
+        if full:
+            stirling = (b * jnp.log(b) - b
+                        + 0.5 * jnp.log(2 * jnp.pi * b))
+            v = v + jnp.where(b > 1, stirling, 0.0)
+        return _reduce(v, reduction)
+    return apply_op(f, input, label, op_name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """ref: loss.py gaussian_nll_loss."""
+    def f(a, b, var):
+        var = jnp.maximum(var, epsilon)
+        v = 0.5 * (jnp.log(var) + (a - b) ** 2 / var)
+        if full:
+            v = v + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi))
+        return _reduce(v, reduction)
+    return apply_op(f, input, label, variance, op_name="gaussian_nll_loss")

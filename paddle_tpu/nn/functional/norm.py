@@ -86,20 +86,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                          if i != (channel_axis % a.ndim))
             m = jnp.mean(a32, axis=axes)
             v = jnp.var(a32, axis=axes)
-            return _normalize(a, m, v, wb), m, v
+            # unbiased correction uses the traced shape, so static replays
+            # at a different batch size get the right n
+            n = a.size // a.shape[channel_axis % a.ndim]
+            v_unbiased = v * (n / max(n - 1, 1))
+            return _normalize(a, m, v, wb), m, v_unbiased
 
         out, bm, bv = apply_op(f_train, x, *args, op_name="batch_norm")
-
-        xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-        n = xd.size // xd.shape[channel_axis % xd.ndim]
-        bias_corr = n / max(n - 1, 1)
 
         def _upd_mean(old, m):
             return momentum * old + (1 - momentum) * m.astype(old.dtype)
 
         def _upd_var(old, v):
-            return momentum * old + (1 - momentum) * (
-                v * bias_corr).astype(old.dtype)
+            return momentum * old + (1 - momentum) * v.astype(old.dtype)
 
         from ...static.program import current_program
         prog = current_program()

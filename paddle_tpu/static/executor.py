@@ -35,7 +35,7 @@ def global_scope():
 
 
 def _replay(program: Program, feed_vals: Dict[str, jax.Array],
-            ref_vals: Sequence[jax.Array]):
+            ref_vals: Sequence[jax.Array], rng_vals: Sequence = ()):
     """Pure replay of the tape. Returns env mapping tensor-id -> value."""
     env: Dict[int, jax.Array] = {}
 
@@ -47,6 +47,8 @@ def _replay(program: Program, feed_vals: Dict[str, jax.Array],
             return env[v]
         if kind == "ref":
             return ref_vals[v]
+        if kind == "rng":
+            return rng_vals[v]
         return v
 
     for op in program.ops:
@@ -80,9 +82,13 @@ class Executor:
     """ref: static.Executor. `place` is accepted for API parity; execution
     always targets the default JAX backend."""
 
+    _CACHE_MAX = 64  # LRU bound: cached closures pin their Program (and
+    # its parameters), so an unbounded cache would leak retired programs
+
     def __init__(self, place=None):
+        from collections import OrderedDict
         self.place = place
-        self._cache: Dict[tuple, object] = {}
+        self._cache: "OrderedDict[tuple, object]" = OrderedDict()
 
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list: Optional[Sequence[Tensor]] = None,
@@ -108,6 +114,10 @@ class Executor:
         if compiled is None:
             compiled = self._compile(program, fetch_list, opt)
             self._cache[key] = compiled
+            if len(self._cache) > self._CACHE_MAX:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
         outs = compiled(feed_arrays)
         if return_numpy:
             outs = [np.asarray(o) for o in outs]
@@ -123,17 +133,24 @@ class Executor:
             for (buf, _, fn), val in zip(buf_updates, buf_vals):
                 buf._data = fn(buf._data, val)
 
+        n_rng = program._rng_count
+
+        def _fresh_keys():
+            from ..core import random as random_mod
+            return [random_mod.next_key() for _ in range(n_rng)]
+
         if opt is None:
             @jax.jit
-            def pure(feed_arrays, ref_vals):
-                env = _replay(program, feed_arrays, ref_vals)
+            def pure(feed_arrays, ref_vals, rng_vals):
+                env = _replay(program, feed_arrays, ref_vals, rng_vals)
                 fetches = [_lookup_fetch(program, env, feed_arrays,
                                          ref_vals, t) for t in fetch_list]
                 return fetches, [env[sid] for sid in buf_src_ids]
 
             def run(feed_arrays):
                 ref_vals = [t._data for t in ref_tensors]
-                fetches, buf_vals = pure(feed_arrays, ref_vals)
+                fetches, buf_vals = pure(feed_arrays, ref_vals,
+                                         _fresh_keys())
                 _apply_buffer_updates(buf_vals)
                 return fetches
 
@@ -151,17 +168,18 @@ class Executor:
                   any(t is p for p in opt._parameter_list)]
         param_slots = [program._refs[id(p)] for p in params]
 
-        def loss_of(param_vals, feed_arrays, ref_vals):
+        def loss_of(param_vals, feed_arrays, ref_vals, rng_vals):
             full = list(ref_vals)
             for s, v in zip(param_slots, param_vals):
                 full[s] = v
-            env = _replay(program, feed_arrays, full)
+            env = _replay(program, feed_arrays, full, rng_vals)
             return env[id(loss_t)], env
 
         @jax.jit
-        def pure(feed_arrays, ref_vals, param_vals, states, lr):
+        def pure(feed_arrays, ref_vals, param_vals, states, lr, rng_vals):
             (loss, env), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(param_vals, feed_arrays, ref_vals)
+                loss_of, has_aux=True)(param_vals, feed_arrays, ref_vals,
+                                       rng_vals)
             new_params, new_states = [], []
             for p_t, p, g, s in zip(params, param_vals, grads, states):
                 # same per-param contract as eager step(): regularizer
@@ -186,7 +204,7 @@ class Executor:
             lr = opt.get_lr()
             fetches, new_params, new_states, buf_vals = pure(
                 feed_arrays, ref_vals, param_vals, states,
-                jnp.float32(lr))
+                jnp.float32(lr), _fresh_keys())
             opt._global_step += 1
             for p, v, ns in zip(params, new_params, new_states):
                 p._data = v

@@ -39,9 +39,14 @@ def save_inference_model(path_prefix: str, feed_vars: Sequence[Tensor],
     feed_names = [t._static_feed_name for t in feed_vars]
     ref_vals = [t._data for t in program._ref_tensors]
 
+    from ..core import random as random_mod
+    # inference export: stochastic slots (dropout keys) get fixed values —
+    # export eval-mode programs for deterministic serving
+    rng_vals = [random_mod.next_key() for _ in range(program._rng_count)]
+
     def pure(*feed_arrays):
         feeds = dict(zip(feed_names, feed_arrays))
-        env = _replay(program, feeds, ref_vals)
+        env = _replay(program, feeds, ref_vals, rng_vals)
         return tuple(_lookup_fetch(program, env, feeds, ref_vals, t)
                      for t in fetch_vars)
 

@@ -32,7 +32,10 @@ def fc(x, size, num_flatten_dims=1, activation=None, name=None):
     layer = _layer("fc", name, lambda: _nn.Linear(in_f, size))
     h = x
     if len(x.shape) > num_flatten_dims + 1:
-        h = x.reshape(list(x.shape[:num_flatten_dims]) + [in_f])
+        # -1 on the leading (batch) dim: the recorded placeholder batch is
+        # 1, but replay must re-trace to the fed batch size
+        h = x.reshape([-1] + [int(d) for d in
+                              x.shape[1:num_flatten_dims]] + [in_f])
     out = layer(h)
     if activation == "relu":
         out = _nn.functional.relu(out)

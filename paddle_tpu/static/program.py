@@ -57,6 +57,10 @@ class Program:
         # replayed buffer mutations (e.g. BN running stats) applied by the
         # Executor after each run — see register_buffer_update
         self._buffer_updates: List[tuple] = []
+        # count of ("rng", i) slots: PRNG keys passed through stochastic
+        # ops (dropout masks...), refreshed with fresh keys on every
+        # Executor.run so replays don't reuse the record-time randomness
+        self._rng_count = 0
 
     # -- recording ----------------------------------------------------------
     def _ref_slot(self, t: Tensor) -> int:
@@ -72,6 +76,9 @@ class Program:
             name = getattr(a, "_static_feed_name", None)
             if name is not None:
                 return ("feed", name)
+            if getattr(a, "_static_rng", False):
+                self._rng_count += 1
+                return ("rng", self._rng_count - 1)
             if id(a) in self._produced:
                 return ("var", id(a))
             return ("ref", self._ref_slot(a))

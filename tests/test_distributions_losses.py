@@ -1,0 +1,215 @@
+"""Extended distribution zoo + loss tests against torch references
+(ref: python/paddle/distribution/, nn/functional/loss.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+td = torch.distributions
+
+
+def _close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol,
+                               atol=atol)
+
+
+class TestDistributions:
+    def test_gamma_log_prob_entropy_mean(self):
+        a, r = np.array([2.0, 0.5], np.float32), np.array([1.5, 2.0],
+                                                          np.float32)
+        v = np.array([0.7, 1.3], np.float32)
+        ours = D.Gamma(a, r)
+        ref = td.Gamma(torch.tensor(a), torch.tensor(r))
+        _close(ours.log_prob(paddle.to_tensor(v)).numpy(),
+               ref.log_prob(torch.tensor(v)).numpy())
+        _close(ours.entropy().numpy(), ref.entropy().numpy())
+        _close(ours.mean.numpy(), ref.mean.numpy())
+        _close(ours.variance.numpy(), ref.variance.numpy())
+
+    def test_beta_log_prob_entropy(self):
+        a, b = np.array([2.0, 3.0], np.float32), np.array([1.5, 0.7],
+                                                          np.float32)
+        v = np.array([0.3, 0.8], np.float32)
+        ours = D.Beta(a, b)
+        ref = td.Beta(torch.tensor(a), torch.tensor(b))
+        _close(ours.log_prob(paddle.to_tensor(v)).numpy(),
+               ref.log_prob(torch.tensor(v)).numpy())
+        _close(ours.entropy().numpy(), ref.entropy().numpy())
+
+    def test_dirichlet_log_prob_entropy(self):
+        c = np.array([[2.0, 3.0, 0.5], [1.0, 1.0, 1.0]], np.float32)
+        v = np.array([[0.2, 0.5, 0.3], [0.1, 0.6, 0.3]], np.float32)
+        ours = D.Dirichlet(c)
+        ref = td.Dirichlet(torch.tensor(c))
+        _close(ours.log_prob(paddle.to_tensor(v)).numpy(),
+               ref.log_prob(torch.tensor(v)).numpy())
+        _close(ours.entropy().numpy(), ref.entropy().numpy())
+
+    def test_poisson_binomial_geometric_log_prob(self):
+        rate = np.array([2.0, 5.0], np.float32)
+        k = np.array([1.0, 4.0], np.float32)
+        _close(D.Poisson(rate).log_prob(paddle.to_tensor(k)).numpy(),
+               td.Poisson(torch.tensor(rate)).log_prob(
+                   torch.tensor(k)).numpy())
+        n = np.array([10.0, 10.0], np.float32)
+        p = np.array([0.3, 0.7], np.float32)
+        _close(D.Binomial(n, p).log_prob(paddle.to_tensor(k)).numpy(),
+               td.Binomial(torch.tensor(n), torch.tensor(p)).log_prob(
+                   torch.tensor(k)).numpy())
+        _close(D.Geometric(p).log_prob(paddle.to_tensor(k)).numpy(),
+               td.Geometric(torch.tensor(p)).log_prob(
+                   torch.tensor(k)).numpy())
+
+    def test_studentt_cauchy_log_prob(self):
+        df = np.array([3.0], np.float32)
+        v = np.array([0.5], np.float32)
+        _close(D.StudentT(df, 1.0, 2.0).log_prob(
+                   paddle.to_tensor(v)).numpy(),
+               td.StudentT(torch.tensor(df), 1.0, 2.0).log_prob(
+                   torch.tensor(v)).numpy())
+        _close(D.Cauchy(0.5, 1.5).log_prob(paddle.to_tensor(v)).numpy(),
+               td.Cauchy(0.5, 1.5).log_prob(torch.tensor(v)).numpy())
+
+    def test_mvn_log_prob_entropy(self):
+        loc = np.array([1.0, -1.0], np.float32)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        v = np.array([0.3, 0.2], np.float32)
+        ours = D.MultivariateNormal(loc, covariance_matrix=cov)
+        ref = td.MultivariateNormal(torch.tensor(loc), torch.tensor(cov))
+        _close(ours.log_prob(paddle.to_tensor(v)).numpy(),
+               ref.log_prob(torch.tensor(v)).numpy())
+        _close(ours.entropy().numpy(), ref.entropy().numpy())
+
+    def test_sampling_statistics(self):
+        paddle.seed(0)
+        g = D.Gamma(np.float32(3.0), np.float32(2.0)).sample([20000])
+        assert abs(float(g.numpy().mean()) - 1.5) < 0.05
+        b = D.Beta(np.float32(2.0), np.float32(2.0)).sample([20000])
+        assert abs(float(b.numpy().mean()) - 0.5) < 0.02
+        p = D.Poisson(np.float32(4.0)).sample([20000])
+        assert abs(float(p.numpy().mean()) - 4.0) < 0.1
+
+    def test_gamma_rsample_differentiable(self):
+        paddle.seed(0)
+        a = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        s = D.Gamma(a, np.float32(1.0)).rsample([256])
+        s.mean().backward()
+        assert a.grad is not None
+        # E[d sample/d alpha] ≈ d mean/d alpha = 1/rate = 1
+        assert 0.5 < float(a.grad.numpy()) < 1.5
+
+    def test_independent_reinterprets_batch(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,)
+        assert ind.event_shape == (4,)
+        v = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        lp = ind.log_prob(paddle.to_tensor(v))
+        assert lp.shape == [3]
+        ref = td.Independent(td.Normal(torch.zeros(3, 4),
+                                       torch.ones(3, 4)), 1)
+        _close(lp.numpy(), ref.log_prob(torch.tensor(v)).numpy())
+
+    def test_transformed_distribution_lognormal(self):
+        """Normal + ExpTransform == LogNormal."""
+        tdist = D.TransformedDistribution(
+            D.Normal(np.float32(0.3), np.float32(0.8)), D.ExpTransform())
+        v = np.array([0.5, 2.0], np.float32)
+        ref = td.LogNormal(torch.tensor(0.3), torch.tensor(0.8))
+        _close(tdist.log_prob(paddle.to_tensor(v)).numpy(),
+               ref.log_prob(torch.tensor(v)).numpy())
+
+    def test_affine_sigmoid_transforms_roundtrip(self):
+        x = np.array([-1.0, 0.5, 2.0], np.float32)
+        for t in [D.AffineTransform(1.0, 2.0), D.SigmoidTransform(),
+                  D.TanhTransform(), D.PowerTransform(2.0)]:
+            xin = np.abs(x) + 0.1 if isinstance(
+                t, D.PowerTransform) else x
+            y = t.forward(paddle.to_tensor(xin))
+            back = t.inverse(y)
+            _close(back.numpy(), xin, rtol=1e-4)
+
+    def test_kl_pairs(self):
+        a = D.Gamma(np.float32(2.0), np.float32(1.5))
+        b = D.Gamma(np.float32(3.0), np.float32(1.0))
+        ra = td.Gamma(torch.tensor(2.0), torch.tensor(1.5))
+        rb = td.Gamma(torch.tensor(3.0), torch.tensor(1.0))
+        _close(D.kl_divergence(a, b).numpy(),
+               td.kl_divergence(ra, rb).numpy())
+        a2, b2 = D.Beta(2.0, 3.0), D.Beta(1.0, 1.0)
+        ra2 = td.Beta(torch.tensor(2.0), torch.tensor(3.0))
+        rb2 = td.Beta(torch.tensor(1.0), torch.tensor(1.0))
+        _close(D.kl_divergence(a2, b2).numpy(),
+               td.kl_divergence(ra2, rb2).numpy())
+
+
+class TestNewLosses:
+    def setup_method(self):
+        rng = np.random.default_rng(0)
+        self.x = rng.normal(size=(6, 5)).astype(np.float32)
+        self.rng = rng
+
+    def test_soft_margin_loss(self):
+        y = np.sign(self.rng.normal(size=(6, 5))).astype(np.float32)
+        ours = F.soft_margin_loss(paddle.to_tensor(self.x),
+                                  paddle.to_tensor(y))
+        ref = torch.nn.functional.soft_margin_loss(
+            torch.tensor(self.x), torch.tensor(y))
+        _close(ours.numpy(), ref.numpy())
+
+    def test_multi_label_soft_margin(self):
+        y = (self.rng.uniform(size=(6, 5)) > 0.5).astype(np.float32)
+        ours = F.multi_label_soft_margin_loss(paddle.to_tensor(self.x),
+                                              paddle.to_tensor(y))
+        ref = torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(self.x), torch.tensor(y))
+        _close(ours.numpy(), ref.numpy())
+
+    def test_multi_margin(self):
+        lbl = self.rng.integers(0, 5, size=(6,)).astype(np.int64)
+        ours = F.multi_margin_loss(paddle.to_tensor(self.x),
+                                   paddle.to_tensor(lbl))
+        ref = torch.nn.functional.multi_margin_loss(
+            torch.tensor(self.x), torch.tensor(lbl))
+        _close(ours.numpy(), ref.numpy())
+
+    def test_poisson_nll(self):
+        y = self.rng.poisson(2.0, size=(6, 5)).astype(np.float32)
+        ours = F.poisson_nll_loss(paddle.to_tensor(self.x),
+                                  paddle.to_tensor(y))
+        ref = torch.nn.functional.poisson_nll_loss(
+            torch.tensor(self.x), torch.tensor(y))
+        _close(ours.numpy(), ref.numpy())
+        ours_full = F.poisson_nll_loss(paddle.to_tensor(np.abs(self.x)),
+                                       paddle.to_tensor(y),
+                                       log_input=False, full=True)
+        ref_full = torch.nn.functional.poisson_nll_loss(
+            torch.tensor(np.abs(self.x)), torch.tensor(y),
+            log_input=False, full=True)
+        _close(ours_full.numpy(), ref_full.numpy())
+
+    def test_gaussian_nll(self):
+        y = self.rng.normal(size=(6, 5)).astype(np.float32)
+        var = np.abs(self.rng.normal(size=(6, 5))).astype(np.float32) + 0.1
+        ours = F.gaussian_nll_loss(paddle.to_tensor(self.x),
+                                   paddle.to_tensor(y),
+                                   paddle.to_tensor(var))
+        ref = torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(self.x), torch.tensor(y), torch.tensor(var))
+        _close(ours.numpy(), ref.numpy())
+
+    def test_loss_layers_exist_and_reduce(self):
+        import paddle_tpu.nn as nn
+        y = np.sign(self.rng.normal(size=(6, 5))).astype(np.float32)
+        for layer in [nn.SoftMarginLoss(reduction="sum"),
+                      nn.SoftMarginLoss(reduction="none")]:
+            out = layer(paddle.to_tensor(self.x), paddle.to_tensor(y))
+            assert np.isfinite(out.numpy()).all()
+        lbl = self.rng.integers(0, 5, size=(6,)).astype(np.int64)
+        out = nn.MultiMarginLoss()(paddle.to_tensor(self.x),
+                                   paddle.to_tensor(lbl))
+        assert np.isfinite(float(out.item()))

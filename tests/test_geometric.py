@@ -1,0 +1,70 @@
+"""paddle.geometric tests (ref: python/paddle/geometric/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def test_segment_ops():
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.],
+                                      [7., 8.]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+    np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                               [[4., 6.], [12., 14.]])
+    np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                               [[2., 3.], [6., 7.]])
+    np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                               [[3., 4.], [7., 8.]])
+    np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                               [[1., 2.], [5., 6.]])
+
+
+def test_segment_empty_segment_zero():
+    data = paddle.to_tensor(np.array([[1., 1.]], np.float32))
+    ids = paddle.to_tensor(np.array([2], np.int64))
+    out = G.segment_max(data, ids, num_segments=4).numpy()
+    np.testing.assert_allclose(out[0], [0., 0.])
+    np.testing.assert_allclose(out[2], [1., 1.])
+
+
+def test_segment_sum_gradient():
+    data = paddle.to_tensor(np.ones((4, 2), np.float32),
+                            stop_gradient=False)
+    ids = paddle.to_tensor(np.array([0, 1, 0, 1], np.int64))
+    out = G.segment_sum(data, ids)
+    out.sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((4, 2)))
+
+
+def test_send_u_recv():
+    x = paddle.to_tensor(np.array([[0., 2., 3.], [1., 4., 5.],
+                                   [2., 6., 7.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int64))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    want = np.zeros((3, 3), np.float32)
+    for s, d in [(0, 1), (1, 2), (2, 1), (0, 0)]:
+        want[d] += x.numpy()[s]
+    np.testing.assert_allclose(out.numpy(), want)
+
+
+def test_send_ue_recv_mul_max():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32))
+    e = paddle.to_tensor(np.array([[2., 2.], [0.5, 0.5], [1., 1.]],
+                                  np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 0], np.int64))
+    dst = paddle.to_tensor(np.array([1, 0, 0], np.int64))
+    out = G.send_ue_recv(x, e, src, dst, message_op="mul",
+                         reduce_op="max")
+    # messages: [2,4]->1, [1.5,2]->0, [1,2]->0 ; max per dst
+    np.testing.assert_allclose(out.numpy(), [[1.5, 2.], [2., 4.]])
+
+
+def test_reindex_graph():
+    x = paddle.to_tensor(np.array([0, 5, 9], np.int64))
+    neighbors = paddle.to_tensor(np.array([5, 9, 7, 0], np.int64))
+    count = paddle.to_tensor(np.array([2, 1, 1], np.int64))
+    src, dst, nodes = G.reindex_graph(x, neighbors, count)
+    np.testing.assert_array_equal(nodes.numpy(), [0, 5, 9, 7])
+    np.testing.assert_array_equal(src.numpy(), [1, 2, 3, 0])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 2])

@@ -197,6 +197,55 @@ def test_static_bn_running_stats_update():
     assert np.all(mean2 > mean1) and np.all(mean1 > mean0)
 
 
+def test_static_dropout_fresh_mask_per_run():
+    """Dropout masks must differ across Executor.run calls (PRNG slots are
+    refreshed per run, not baked at record time)."""
+    import paddle_tpu.nn.functional as F
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 64], "float32")
+        y = F.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    arr = np.ones((2, 64), np.float32)
+    out1, = exe.run(prog, feed={"x": arr}, fetch_list=[y])
+    out2, = exe.run(prog, feed={"x": arr}, fetch_list=[y])
+    assert not np.array_equal(out1, out2), "dropout mask is frozen"
+    assert ((out1 == 0) | (np.isclose(out1, 2.0))).all()
+
+
+def test_static_bn_bias_correction_uses_fed_batch():
+    """Running-var update must use the fed batch's n/(n-1), not the
+    placeholder's."""
+    import paddle_tpu.nn as nn
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 2], "float32")
+        bn = nn.BatchNorm1D(2)
+        bn.train()
+        y = bn(x)
+    exe = static.Executor()
+    rng = np.random.default_rng(0)
+    arr = rng.normal(size=(64, 2)).astype(np.float32)
+    exe.run(prog, feed={"x": arr}, fetch_list=[y])
+    # paddle momentum 0.9: new_var = 0.9*1 + 0.1*unbiased_var
+    want = 0.9 + 0.1 * arr.var(0, ddof=1)
+    np.testing.assert_allclose(bn._variance.numpy(), want, rtol=1e-4)
+
+
+def test_static_fc_flattens_batch_polymorphic():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 2, 3], "float32")
+        h = static.nn.fc(x, 4)
+    exe = static.Executor()
+    for b in (1, 5):
+        out, = exe.run(prog, feed={"x": np.ones((b, 2, 3), np.float32)},
+                       fetch_list=[h])
+        assert out.shape == (b, 4)
+
+
 def test_fetch_feed_passthrough():
     prog = static.Program()
     with static.program_guard(prog):
