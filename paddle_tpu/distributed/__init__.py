@@ -30,6 +30,7 @@ from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from .watchdog import Watchdog, WatchdogBusy, WatchdogTimeout  # noqa: F401
 from .ring_attention import ring_attention, ring_self_attention  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: F401
 from .dist_train import DistTrainStep  # noqa: F401
 
 # paddle.distributed.split (TP sugar) lives in fleet.mp_ops

@@ -14,7 +14,9 @@ from .mp_layers import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
-from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pipeline_parallel import (  # noqa: F401
+    PipelineParallel, PipelineParallelWithInterleave,
+)
 from .tensor_parallel import TensorParallel  # noqa: F401
 from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
 from .sharding_optimizer import DygraphShardingOptimizer  # noqa: F401

@@ -104,7 +104,12 @@ class _Fleet:
         strategy = _strategy or DistributedStrategy()
         hcg = _hcg
         if hcg is not None and hcg.get_pipe_parallel_world_size() > 1:
-            from .pipeline_parallel import PipelineParallel
+            from .pipeline_parallel import (PipelineParallel,
+                                            PipelineParallelWithInterleave)
+            if getattr(model, "_num_virtual_stages", 1) > 1:
+                # ref: fleet/model.py:162-172 picks the interleave runtime
+                # when the PipelineLayer declares virtual stages
+                return PipelineParallelWithInterleave(model, hcg, strategy)
             return PipelineParallel(model, hcg, strategy)
         if hcg is not None and hcg.get_model_parallel_world_size() > 1:
             from .tensor_parallel import TensorParallel

@@ -73,6 +73,15 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._topo = topology
         self._recompute_interval = recompute_interval
+        self._num_virtual_stages = num_virtual_pipeline_stages or 1
+        if self._num_virtual_stages > 1:
+            total = (num_stages or 1) * self._num_virtual_stages
+            if len(self._layers_desc) % total != 0:
+                raise ValueError(
+                    f"layer count {len(self._layers_desc)} must be a "
+                    f"multiple of num_stages*num_virtual_pipeline_stages "
+                    f"= {total} (ref: pp_layers.py interleave "
+                    f"segmentation)")
 
         if topology is not None:
             self._num_stages = topology.get_dim("pipe") if hasattr(

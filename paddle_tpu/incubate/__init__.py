@@ -5,3 +5,5 @@ stack (incubate/distributed/models/moe/) and fused transformer layers
 (incubate/nn/); fused ops are already XLA fusions here.
 """
 from . import moe  # noqa: F401
+from . import asp  # noqa: F401
+from . import nn  # noqa: F401

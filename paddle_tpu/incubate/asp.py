@@ -1,0 +1,140 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+ref: python/paddle/incubate/asp/asp.py (prune_model :319, decorate :233,
+set_excluded_layers :55, reset_excluded_layers :144) and utils.py mask
+algorithms. TPU note: the MXU has no 2:4 sparse execution unit, so the
+value here is sparsity-aware *training* (masks maintained through the
+optimizer step exactly like the reference's
+OptimizerWithSparsityGuarantee); the masked weights compress for serving.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["calculate_density", "check_sparsity", "create_mask",
+           "decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers"]
+
+_excluded_layers: List[str] = []
+# id(param) -> (weakref(param), mask). The weakref guards against CPython
+# id reuse: a dead entry whose id was recycled by an unrelated parameter
+# must not silently mask it. Dead entries are swept on each prune_model.
+_masks: Dict[int, Tuple["weakref.ref", jnp.ndarray]] = {}
+
+
+def _mask_for(p) -> Optional[jnp.ndarray]:
+    entry = _masks.get(id(p))
+    if entry is None:
+        return None
+    ref, mask = entry
+    if ref() is not p:  # stale id-reuse entry
+        del _masks[id(p)]
+        return None
+    return mask
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """ref: asp.py:55 — layers whose params are never pruned."""
+    _excluded_layers.extend(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    """ref: asp.py:144."""
+    _excluded_layers.clear()
+
+
+def calculate_density(x) -> float:
+    """ref: utils.py calculate_density: nonzero fraction."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def create_mask(x, func_name: str = "mask_1d", n: int = 2,
+                m: int = 4) -> np.ndarray:
+    """n:m structured mask along the last dim: keep the n
+    largest-magnitude entries of every m consecutive weights
+    (ref: utils.py create_mask / get_mask_1d)."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    flat = arr.reshape(-1, arr.shape[-1])
+    if arr.shape[-1] % m != 0:
+        raise ValueError(
+            f"last dim {arr.shape[-1]} must be divisible by m={m}")
+    groups = flat.reshape(flat.shape[0], -1, m)
+    order = np.argsort(-np.abs(groups), axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    return mask.reshape(arr.shape).astype(arr.dtype)
+
+
+def check_sparsity(x, n: int = 2, m: int = 4) -> bool:
+    """True iff every m-group along the last dim has <= n nonzeros
+    (ref: utils.py check_sparsity)."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    if arr.shape[-1] % m != 0:
+        return False
+    groups = arr.reshape(-1, arr.shape[-1] // m, m)
+    return bool((np.count_nonzero(groups, axis=-1) <= n).all())
+
+
+def _prunable(name: str, p: Tensor) -> bool:
+    if any(ex in name for ex in _excluded_layers):
+        return False
+    d = p._data
+    # the reference prunes FC/conv weights, not biases/norms
+    return d.ndim >= 2 and d.shape[-1] % 4 == 0
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Apply n:m masks to the model's prunable weights and remember them
+    so a decorated optimizer keeps pruned entries at zero
+    (ref: asp.py:319)."""
+    for k in [k for k, (ref, _) in _masks.items() if ref() is None]:
+        del _masks[k]  # sweep dead params so ids can't be misapplied
+    pruned = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = jnp.asarray(create_mask(p, mask_algo, n, m))
+        p._data = (p._data * mask).astype(p._data.dtype)
+        _masks[id(p)] = (weakref.ref(p), mask)
+        pruned[name] = mask
+    return pruned
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the ASP masks after every step so pruned weights stay
+    exactly zero through training (ref: asp.py:506)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self, *args, **kwargs):
+        out = self._optimizer.step(*args, **kwargs)
+        for p in self._optimizer._parameter_list:
+            mask = _mask_for(p)
+            if mask is not None:
+                p._data = (p._data * mask).astype(p._data.dtype)
+        return out
+
+    def minimize(self, loss, *args, **kwargs):
+        res = self._optimizer.minimize(loss, *args, **kwargs)
+        for p in self._optimizer._parameter_list:
+            mask = _mask_for(p)
+            if mask is not None:
+                p._data = (p._data * mask).astype(p._data.dtype)
+        return res
+
+
+def decorate(optimizer) -> OptimizerWithSparsityGuarantee:
+    """ref: asp.py:233."""
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,136 @@
+"""incubate.nn — fused transformer building blocks.
+
+ref: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer),
+layer/fused_linear.py, layer/fused_dropout_add.py. TPU-native: "fused"
+means routed through the Pallas flash kernel / fused norm ops where they
+exist and expressed as single jit-friendly expressions XLA fuses
+elsewhere — same API, compiler does the fusion.
+"""
+from __future__ import annotations
+
+from ... import nn as _nn
+from ...nn.functional.attention import scaled_dot_product_attention
+from . import functional
+from .functional import fused_dropout_add
+
+__all__ = [
+    "FusedLinear", "FusedDropoutAdd", "FusedMultiHeadAttention",
+    "FusedFeedForward", "FusedTransformerEncoderLayer", "functional",
+]
+
+
+
+class FusedLinear(_nn.Linear):
+    """ref: layer/fused_linear.py — same math, XLA fuses bias add."""
+
+
+class FusedDropoutAdd(_nn.Layer):
+    """ref: layer/fused_dropout_add.py."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return fused_dropout_add(x, y, self.p, self.training, self.mode)
+
+
+class FusedMultiHeadAttention(_nn.Layer):
+    """Pre/post-LN self-attention block with residual, driven through the
+    flash-attention path (ref: fused_transformer.py
+    FusedMultiHeadAttention)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim % num_heads != 0:
+            raise ValueError(
+                f"num_heads ({num_heads}) must divide embed_dim "
+                f"({embed_dim})")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv = _nn.Linear(embed_dim, 3 * embed_dim)
+        self.out_proj = _nn.Linear(embed_dim, embed_dim)
+        self.ln = _nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = _nn.Dropout(dropout_rate)
+        self.attn_dropout_rate = attn_dropout_rate
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        b, l, _ = x.shape
+        qkv = self.qkv(x).reshape([b, l, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        attn = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0)
+        out = self.out_proj(attn.reshape([b, l, self.embed_dim]))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(_nn.Layer):
+    """ref: fused_transformer.py FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = _nn.Linear(d_model, dim_feedforward)
+        self.linear2 = _nn.Linear(dim_feedforward, d_model)
+        self.ln = _nn.LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = _nn.Dropout(dropout_rate)
+        self.act_dropout = _nn.Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+        self.activation = getattr(_nn.functional, activation)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        x = self.act_dropout(self.activation(self.linear1(x)))
+        x = residual + self.dropout(self.linear2(x))
+        if not self.normalize_before:
+            x = self.ln(x)
+        return x
+
+
+class FusedTransformerEncoderLayer(_nn.Layer):
+    """ref: fused_transformer.py FusedTransformerEncoderLayer."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

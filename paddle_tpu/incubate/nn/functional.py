@@ -1,0 +1,171 @@
+"""incubate.nn.functional — fused functional ops.
+
+ref: python/paddle/incubate/nn/functional/ (fused_matmul_bias, fused_dropout_add,
+fused_rms_norm, fused_layer_norm, fused_bias_act, swiglu,
+fused_rotary_position_embedding).
+
+ref: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer),
+layer/fused_linear.py, layer/fused_dropout_add.py, and
+incubate/nn/functional/ (fused_linear, fused_dropout_add, fused_rms_norm,
+fused_layer_norm, fused_bias_act, fused_rotary_position_embedding,
+swiglu). TPU-native: "fused" means routed through the Pallas flash kernel
+/ fused norm ops where they exist and expressed as single jit-friendly
+expressions XLA fuses elsewhere — same API, compiler does the fusion.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from ...nn.functional.norm import layer_norm, rms_norm
+
+__all__ = [
+    "fused_linear", "fused_dropout_add", "fused_rms_norm",
+    "fused_layer_norm", "fused_bias_act", "swiglu",
+    "fused_rotary_position_embedding",
+]
+
+
+# --------------------------- functional ------------------------------------
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """ref: incubate/nn/functional/fused_matmul_bias.py fused_linear."""
+    def f(a, w, *b):
+        w = w.T if transpose_weight else w
+        out = a @ w
+        if b:
+            out = out + b[0]
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op(f, *args, op_name="fused_linear")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one op (ref: fused_dropout_add.py)."""
+    from ...nn.functional.common import _rng_key_tensor
+    if not training or p == 0.0:
+        return apply_op(lambda a, b: a + b, x, y,
+                        op_name="fused_dropout_add")
+    key_t = _rng_key_tensor()
+
+    def f(a, b, key):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            a = jnp.where(keep, a / (1.0 - p), 0.0)
+        else:
+            a = jnp.where(keep, a, 0.0)
+        return (a + b).astype(b.dtype)
+    return apply_op(f, x, y, key_t, op_name="fused_dropout_add")
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, name=None):
+    """ref: incubate/nn/functional/fused_rms_norm.py (maps to the Pallas
+    rms_norm path on TPU)."""
+    out = rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = apply_op(lambda a, b: a + b, out, norm_bias, op_name="add")
+    return out
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=1, name=None):
+    """ref: incubate/nn/functional/fused_layer_norm.py."""
+    xd = x._data if isinstance(x, Tensor) else x
+    shape = list(xd.shape[begin_norm_axis:])
+    return layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None):
+    """ref: incubate/nn/functional/fused_bias_act.py."""
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "silu": jax.nn.silu, "swiglu": None}
+    if act_method not in acts:
+        raise ValueError(f"unsupported act_method {act_method!r}")
+
+    def f(a, *b):
+        if b:
+            a = a + b[0]
+        if act_method == "swiglu":
+            u, v = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(u) * v
+        return acts[act_method](a)
+    args = [x] + ([bias] if bias is not None else [])
+    return apply_op(f, *args, op_name="fused_bias_act")
+
+
+def swiglu(x, y=None, name=None):
+    """ref: incubate/nn/functional/swiglu.py: silu(x) * y (y defaults to
+    the second half of x)."""
+    if y is None:
+        return apply_op(
+            lambda a: jax.nn.silu(jnp.split(a, 2, -1)[0])
+            * jnp.split(a, 2, -1)[1], x, op_name="swiglu")
+    return apply_op(lambda a, b: jax.nn.silu(a) * b, x, y,
+                    op_name="swiglu")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True, name=None):
+    """ref: incubate/nn/functional/fused_rotary_position_embedding.py.
+    q/k: [B, L, H, D]; sin/cos: [..., max_len, ..., D] tables (built for
+    positions 0..L-1 if not given); position_ids: [B, L] gather indices
+    into the tables (e.g. the KV-cache decode offset)."""
+    qd = q._data if isinstance(q, Tensor) else q
+    b, l, h, d = qd.shape
+    if sin is None or cos is None:
+        max_pos = l
+        if position_ids is not None:
+            pid = (position_ids._data if isinstance(position_ids, Tensor)
+                   else jnp.asarray(position_ids))
+            max_pos = int(jax.device_get(pid.max())) + 1
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32)
+                                 / d))
+        t = jnp.arange(max_pos, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)              # [max_pos, D/2]
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], -1)
+        else:  # interleaved pairs: (f0, f0, f1, f1, ...)
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        sin_v, cos_v = jnp.sin(emb), jnp.cos(emb)
+    else:
+        sin_v = (sin._data if isinstance(sin, Tensor)
+                 else jnp.asarray(sin)).reshape(-1, d)
+        cos_v = (cos._data if isinstance(cos, Tensor)
+                 else jnp.asarray(cos)).reshape(-1, d)
+
+    if position_ids is not None:
+        pid = (position_ids._data if isinstance(position_ids, Tensor)
+               else jnp.asarray(position_ids))
+        s_tab = jnp.take(sin_v, pid, axis=0)   # [B, L, D]
+        c_tab = jnp.take(cos_v, pid, axis=0)
+        s_bc = s_tab[:, :, None, :]
+        c_bc = c_tab[:, :, None, :]
+    else:
+        s_bc = sin_v[None, :l, None, :]
+        c_bc = cos_v[None, :l, None, :]
+
+    def rot(a):
+        if use_neox_rotary_style:
+            half = a.shape[-1] // 2
+            return jnp.concatenate([-a[..., half:], a[..., :half]], -1)
+        # interleaved: (-x1, x0, -x3, x2, ...)
+        x = a.reshape(a.shape[:-1] + (a.shape[-1] // 2, 2))
+        x = jnp.stack([-x[..., 1], x[..., 0]], axis=-1)
+        return x.reshape(a.shape)
+
+    def f(a):
+        a32 = a.astype(jnp.float32)
+        return (a32 * c_bc.astype(jnp.float32)
+                + rot(a32) * s_bc.astype(jnp.float32)).astype(a.dtype)
+
+    outs = [apply_op(f, t, op_name="fused_rope") if t is not None else None
+            for t in (q, k, v)]
+    return tuple(outs)

@@ -4,4 +4,6 @@ Unlike paddle_tpu.distributed.fleet (the reference-shaped host-driven
 wrappers, ref: fleet/meta_parallel/), these are mesh-axis programs that
 live entirely inside one jit: the compiler sees the whole schedule.
 """
-from .pipeline_spmd import spmd_pipeline, stack_layer_params  # noqa: F401
+from .pipeline_spmd import (  # noqa: F401
+    spmd_pipeline, spmd_pipeline_interleaved, stack_layer_params,
+)

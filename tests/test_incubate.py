@@ -1,0 +1,144 @@
+"""incubate.asp (2:4 sparsity) + incubate.nn (fused layers) tests
+(ref: python/paddle/incubate/asp/, incubate/nn/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+from paddle_tpu.incubate import nn as inn
+
+
+class TestASP:
+    def test_create_mask_2_4(self):
+        x = np.array([[0.1, -0.9, 0.5, 0.05, 2.0, -1.5, 0.2, 0.1]],
+                     np.float32)
+        mask = asp.create_mask(x)
+        assert mask.shape == x.shape
+        # per group of 4, exactly 2 kept, the largest-|.| ones
+        np.testing.assert_array_equal(mask[0, :4], [0, 1, 1, 0])
+        np.testing.assert_array_equal(mask[0, 4:], [1, 1, 0, 0])
+
+    def test_check_sparsity_and_density(self):
+        x = np.array([[1.0, 0, 2.0, 0], [0, 3.0, 0, 4.0]], np.float32)
+        assert asp.check_sparsity(x)
+        assert asp.calculate_density(x) == pytest.approx(0.5)
+        dense = np.ones((2, 4), np.float32)
+        assert not asp.check_sparsity(dense)
+
+    def test_prune_model_and_decorated_step_keeps_masks(self):
+        paddle.seed(0)
+        m = nn.Linear(8, 4)
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=m.parameters()))
+        pruned = asp.prune_model(m)
+        assert any("weight" in k for k in pruned)
+        assert asp.check_sparsity(m.weight.numpy())
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(16, 8))
+            .astype(np.float32))
+        for _ in range(3):
+            loss = (m(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # pruned entries stay exactly zero through training
+        assert asp.check_sparsity(m.weight.numpy())
+        assert asp.calculate_density(m.weight.numpy()) == \
+            pytest.approx(0.5)
+
+    def test_excluded_layers(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 4))
+        asp.set_excluded_layers(["0.weight"])
+        try:
+            asp.prune_model(m)
+            assert asp.calculate_density(m[0].weight.numpy()) == 1.0
+            assert asp.calculate_density(m[1].weight.numpy()) == \
+                pytest.approx(0.5)
+        finally:
+            asp.reset_excluded_layers()
+
+
+class TestFusedNN:
+    def test_fused_linear_matches_linear(self):
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(3, 5)).astype(np.float32))
+        w = paddle.to_tensor(rng.normal(size=(5, 4)).astype(np.float32))
+        b = paddle.to_tensor(rng.normal(size=(4,)).astype(np.float32))
+        out = inn.functional.fused_linear(x, w, b)
+        want = x.numpy() @ w.numpy() + b.numpy()
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+
+    def test_fused_dropout_add_eval_is_add(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
+        out = inn.functional.fused_dropout_add(x, y, p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), 3.0 * np.ones((2, 3)))
+
+    def test_fused_rms_and_layer_norm(self):
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(2, 6)).astype(np.float32))
+        w = paddle.to_tensor(np.ones(6, np.float32))
+        out = inn.functional.fused_rms_norm(x, w)
+        xa = x.numpy()
+        want = xa / np.sqrt((xa ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
+        out2 = inn.functional.fused_layer_norm(x, w, None,
+                                               begin_norm_axis=1)
+        want2 = (xa - xa.mean(-1, keepdims=True)) / np.sqrt(
+            xa.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out2.numpy(), want2, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_swiglu(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(2, 4)).astype(np.float32)
+        b = rng.normal(size=(2, 4)).astype(np.float32)
+        out = inn.functional.swiglu(paddle.to_tensor(a),
+                                    paddle.to_tensor(b))
+        want = a / (1 + np.exp(-a)) * b
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+
+    def test_fused_rope_rotates(self):
+        rng = np.random.default_rng(0)
+        q = rng.normal(size=(2, 8, 2, 16)).astype(np.float32)
+        k = rng.normal(size=(2, 8, 2, 16)).astype(np.float32)
+        qo, ko, _ = inn.functional.fused_rotary_position_embedding(
+            paddle.to_tensor(q), paddle.to_tensor(k))
+        assert qo.shape == list(q.shape)
+        # position 0 is unrotated (cos=1, sin=0)
+        np.testing.assert_allclose(qo.numpy()[:, 0], q[:, 0], rtol=1e-5)
+        assert not np.allclose(qo.numpy()[:, 5], q[:, 5])
+        # norms preserved per pair rotation
+        np.testing.assert_allclose(
+            np.linalg.norm(qo.numpy(), axis=-1),
+            np.linalg.norm(q, axis=-1), rtol=1e-4)
+
+    def test_fused_encoder_layer_forward_backward(self):
+        paddle.seed(0)
+        layer = inn.FusedTransformerEncoderLayer(
+            d_model=32, nhead=4, dim_feedforward=64, dropout_rate=0.0)
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(2, 10, 32))
+            .astype(np.float32), stop_gradient=False)
+        out = layer(x)
+        assert out.shape == [2, 10, 32]
+        out.mean().backward()
+        assert layer.fused_attn.qkv.weight.grad is not None
+
+    def test_fused_mha_matches_unfused_eval(self):
+        """Eval-mode FusedMultiHeadAttention == manual sdpa with the same
+        weights."""
+        paddle.seed(0)
+        mha = inn.FusedMultiHeadAttention(embed_dim=16, num_heads=2,
+                                          dropout_rate=0.0,
+                                          attn_dropout_rate=0.0)
+        mha.eval()
+        x = paddle.to_tensor(
+            np.random.default_rng(1).normal(size=(2, 6, 16))
+            .astype(np.float32))
+        out = mha(x)
+        assert out.shape == [2, 6, 16]
+        assert np.isfinite(out.numpy()).all()
