@@ -62,23 +62,35 @@ def main():
     model.bfloat16()
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
 
+    # multi_precision=False stores Adam moments in the param dtype (bf16),
+    # the reference's own default for AdamW — halves optimizer-state HBM
+    # traffic (+14% step time on v5e). bf16 keeps fp32's exponent range,
+    # so the moments lose mantissa only, not range.
     opt = paddle.optimizer.AdamW(learning_rate=3e-4,
-                                 parameters=model.parameters())
+                                 parameters=model.parameters(),
+                                 multi_precision=False)
     crit = LlamaPretrainingCriterion()
     step = DistTrainStep(model, lambda lg, lb: crit(lg, lb), opt)
 
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    import jax.numpy as jnp
+    # device-resident feed: per-step host->device uploads would serialize
+    # on the tunnel RTT and measure the link, not the chip
+    ids = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
     with jax.default_matmul_precision("bfloat16"):
-        # compile + warmup; per-step host sync (float(loss)) because the
-        # remote-device tunnel's async completion signals are unreliable —
-        # a value transfer is the only trustworthy barrier
+        # compile + warmup with a full host sync (float(loss): a value
+        # transfer is the only trustworthy barrier over the tunnel)
         float(step(ids, ids))
         float(step(ids, ids))
+        # timed region: steps chain on-device (donated buffers); ONE final
+        # loss fetch closes the timing — per-step fetches would add a
+        # ~100 ms tunnel round-trip to every step
         t0 = time.perf_counter()
         for _ in range(steps):
-            loss = float(step(ids, ids))
+            loss = step(ids, ids)
+        loss = float(loss)
         dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt

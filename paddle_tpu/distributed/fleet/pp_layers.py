@@ -74,14 +74,6 @@ class PipelineLayer(Layer):
         self._topo = topology
         self._recompute_interval = recompute_interval
         self._num_virtual_stages = num_virtual_pipeline_stages or 1
-        if self._num_virtual_stages > 1:
-            total = (num_stages or 1) * self._num_virtual_stages
-            if len(self._layers_desc) % total != 0:
-                raise ValueError(
-                    f"layer count {len(self._layers_desc)} must be a "
-                    f"multiple of num_stages*num_virtual_pipeline_stages "
-                    f"= {total} (ref: pp_layers.py interleave "
-                    f"segmentation)")
 
         if topology is not None:
             self._num_stages = topology.get_dim("pipe") if hasattr(
@@ -98,6 +90,16 @@ class PipelineLayer(Layer):
             self._stage_id = hcg.get_stage_id()
 
         n = len(self._layers_desc)
+        if self._num_virtual_stages > 1:
+            # validate against the RESOLVED stage count (topology/hcg may
+            # have overridden the constructor arg above)
+            total = self._num_stages * self._num_virtual_stages
+            if n % total != 0:
+                raise ValueError(
+                    f"layer count {n} must be a multiple of "
+                    f"num_stages*num_virtual_pipeline_stages = "
+                    f"{self._num_stages}*{self._num_virtual_stages} "
+                    f"(ref: pp_layers.py interleave segmentation)")
         self.segment_parts = _uniform_partition(n, self._num_stages)
         self._start = self.segment_parts[self._stage_id]
         self._end = self.segment_parts[self._stage_id + 1]

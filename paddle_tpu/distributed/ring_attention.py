@@ -84,10 +84,8 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = True,
     # full-manual shard_map: map the other mesh axes onto their
     # conventional dims (data axes -> batch, model axes -> heads) so dp/tp
     # shardings ride through instead of being all-gathered per device
-    others = [a for a in jmesh.axis_names if a != axis]
-    batch_axes = tuple(a for a in others
-                       if a in ("dp", "fsdp", "data", "sharding"))
-    head_axes = tuple(a for a in others if a in ("mp", "tp", "model"))
+    from ._mesh_axes import classify_axes
+    batch_axes, head_axes = classify_axes(jmesh, axis)
     spec = P(batch_axes or None, axis, head_axes or None, None)
     fn = jax.shard_map(
         functools.partial(_ring_attn_local, axis=axis, scale=s,

@@ -55,10 +55,8 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp",
     jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
     sizes = dict(zip(jmesh.axis_names, jmesh.devices.shape))
     n = sizes[axis]
-    others = [a for a in jmesh.axis_names if a != axis]
-    batch_axes = tuple(a for a in others
-                       if a in ("dp", "fsdp", "data", "sharding"))
-    head_axes = tuple(a for a in others if a in ("mp", "tp", "model"))
+    from ._mesh_axes import classify_axes
+    batch_axes, head_axes = classify_axes(jmesh, axis)
     mp = 1
     for a in head_axes:
         mp *= sizes[a]

@@ -55,11 +55,21 @@ def calculate_density(x) -> float:
     return float(np.count_nonzero(arr)) / max(arr.size, 1)
 
 
+_MASK_ALGOS = ("mask_1d",)
+
+
 def create_mask(x, func_name: str = "mask_1d", n: int = 2,
                 m: int = 4) -> np.ndarray:
     """n:m structured mask along the last dim: keep the n
     largest-magnitude entries of every m consecutive weights
-    (ref: utils.py create_mask / get_mask_1d)."""
+    (ref: utils.py create_mask / get_mask_1d). The reference's 2-D
+    algorithms (mask_2d_greedy/best) are not implemented — fail loudly
+    rather than silently downgrade."""
+    if func_name not in _MASK_ALGOS:
+        raise NotImplementedError(
+            f"mask algorithm {func_name!r} not supported (available: "
+            f"{_MASK_ALGOS}); the reference's 2-D algorithms are a "
+            f"documented gap")
     arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
     flat = arr.reshape(-1, arr.shape[-1])
     if arr.shape[-1] % m != 0:
@@ -82,8 +92,17 @@ def check_sparsity(x, n: int = 2, m: int = 4) -> bool:
     return bool((np.count_nonzero(groups, axis=-1) <= n).all())
 
 
+def _excluded(name: str) -> bool:
+    """Exact name or dotted-prefix match (substring matching would make
+    '0.weight' also exclude '10.weight')."""
+    for ex in _excluded_layers:
+        if name == ex or name.startswith(ex + "."):
+            return True
+    return False
+
+
 def _prunable(name: str, p: Tensor) -> bool:
-    if any(ex in name for ex in _excluded_layers):
+    if _excluded(name):
         return False
     d = p._data
     # the reference prunes FC/conv weights, not biases/norms
@@ -92,9 +111,9 @@ def _prunable(name: str, p: Tensor) -> bool:
 
 def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
                 with_mask: bool = True):
-    """Apply n:m masks to the model's prunable weights and remember them
-    so a decorated optimizer keeps pruned entries at zero
-    (ref: asp.py:319)."""
+    """Apply n:m masks to the model's prunable weights; with_mask=True
+    (default) also remembers them so a decorated optimizer keeps pruned
+    entries at zero (ref: asp.py:319)."""
     for k in [k for k, (ref, _) in _masks.items() if ref() is None]:
         del _masks[k]  # sweep dead params so ids can't be misapplied
     pruned = {}
@@ -103,7 +122,8 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
             continue
         mask = jnp.asarray(create_mask(p, mask_algo, n, m))
         p._data = (p._data * mask).astype(p._data.dtype)
-        _masks[id(p)] = (weakref.ref(p), mask)
+        if with_mask:
+            _masks[id(p)] = (weakref.ref(p), mask)
         pruned[name] = mask
     return pruned
 

@@ -50,6 +50,11 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
     """dropout(x) + y in one op (ref: fused_dropout_add.py)."""
     from ...nn.functional.common import _rng_key_tensor
     if not training or p == 0.0:
+        if not training and mode == "downscale_in_infer" and p > 0.0:
+            # raw masks at train time -> scale by keep prob at inference
+            # (same contract as nn.functional.dropout, common.py)
+            return apply_op(lambda a, b: (a * (1.0 - p) + b).astype(b.dtype),
+                            x, y, op_name="fused_dropout_add")
         return apply_op(lambda a, b: a + b, x, y,
                         op_name="fused_dropout_add")
     key_t = _rng_key_tensor()
@@ -120,37 +125,37 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     into the tables (e.g. the KV-cache decode offset)."""
     qd = q._data if isinstance(q, Tensor) else q
     b, l, h, d = qd.shape
+    inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+
     if sin is None or cos is None:
-        max_pos = l
         if position_ids is not None:
+            # compute angles straight from the (possibly traced) ids — no
+            # data-dependent table size, safe under jit
             pid = (position_ids._data if isinstance(position_ids, Tensor)
-                   else jnp.asarray(position_ids))
-            max_pos = int(jax.device_get(pid.max())) + 1
-        inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32)
-                                 / d))
-        t = jnp.arange(max_pos, dtype=jnp.float32)
-        freqs = jnp.outer(t, inv)              # [max_pos, D/2]
+                   else jnp.asarray(position_ids)).astype(jnp.float32)
+            freqs = pid[..., None] * inv       # [B, L, D/2]
+        else:
+            freqs = (jnp.arange(l, dtype=jnp.float32)[None, :, None]
+                     * inv)                    # [1, L, D/2]
         if use_neox_rotary_style:
             emb = jnp.concatenate([freqs, freqs], -1)
         else:  # interleaved pairs: (f0, f0, f1, f1, ...)
             emb = jnp.repeat(freqs, 2, axis=-1)
-        sin_v, cos_v = jnp.sin(emb), jnp.cos(emb)
+        s_bc = jnp.sin(emb)[:, :, None, :]
+        c_bc = jnp.cos(emb)[:, :, None, :]
     else:
         sin_v = (sin._data if isinstance(sin, Tensor)
                  else jnp.asarray(sin)).reshape(-1, d)
         cos_v = (cos._data if isinstance(cos, Tensor)
                  else jnp.asarray(cos)).reshape(-1, d)
-
-    if position_ids is not None:
-        pid = (position_ids._data if isinstance(position_ids, Tensor)
-               else jnp.asarray(position_ids))
-        s_tab = jnp.take(sin_v, pid, axis=0)   # [B, L, D]
-        c_tab = jnp.take(cos_v, pid, axis=0)
-        s_bc = s_tab[:, :, None, :]
-        c_bc = c_tab[:, :, None, :]
-    else:
-        s_bc = sin_v[None, :l, None, :]
-        c_bc = cos_v[None, :l, None, :]
+        if position_ids is not None:
+            pid = (position_ids._data if isinstance(position_ids, Tensor)
+                   else jnp.asarray(position_ids))
+            s_bc = jnp.take(sin_v, pid, axis=0)[:, :, None, :]
+            c_bc = jnp.take(cos_v, pid, axis=0)[:, :, None, :]
+        else:
+            s_bc = sin_v[None, :l, None, :]
+            c_bc = cos_v[None, :l, None, :]
 
     def rot(a):
         if use_neox_rotary_style:

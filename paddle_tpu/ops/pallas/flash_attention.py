@@ -265,9 +265,14 @@ def _tiles_ok(seq_len, d, block_q, block_k) -> bool:
 
 
 def _pick_block(seq_len: int) -> int:
-    """256 tiles measured ~15% faster end-to-end than 128 on v5e; fall
-    back to 128 when the sequence doesn't tile at 256."""
-    return 256 if seq_len % 256 == 0 else 128
+    """Measured on v5e (1.17B Llama, seq 2048, whole train step):
+    512 tiles ~7% faster than 256, 256 ~15% faster than 128; 1024
+    exceeds VMEM. Fall back down the ladder when the sequence doesn't
+    tile."""
+    for blk in (512, 256):
+        if seq_len % blk == 0:
+            return blk
+    return 128
 
 
 def _use_pallas(l, d) -> bool:
