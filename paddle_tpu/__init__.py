@@ -74,7 +74,8 @@ from . import inference  # noqa: F401
 # in the native extension, whose first import compiles C++; users import
 # it explicitly (matching `import paddle.profiler` usage).
 from .framework.io import save, load  # noqa: F401
-from .hapi.model import Model, summary  # noqa: F401
+from .hapi.model import Model, flops, summary  # noqa: F401
+from . import callbacks  # noqa: F401
 
 from . import static  # noqa: F401
 from . import geometric  # noqa: F401

@@ -1,0 +1,297 @@
+"""hapi callbacks. ref: python/paddle/hapi/callbacks.py (Callback,
+ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL,
+History via the config dict)."""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "VisualDL", "History", "CallbackList",
+           "config_callbacks"]
+
+
+class Callback:
+    """ref: callbacks.py Callback — every hook is a no-op by default."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a: self._call(name, *a)
+        raise AttributeError(name)
+
+
+class History(Callback):
+    """Collects per-epoch logs; installed automatically by fit
+    (mirrors the reference's history bookkeeping)."""
+
+    def on_train_begin(self, logs=None):
+        self.history = {}
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            self.history.setdefault(k, []).append(v)
+
+
+class ProgBarLogger(Callback):
+    """ref: callbacks.py ProgBarLogger — prints per-epoch metrics; the
+    terminal progressbar degrades to line logging."""
+
+    def __init__(self, log_freq: int = 1, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        if self.verbose:
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose > 1 and step % self.log_freq == 0:
+            items = " - ".join(f"{k}: {_fmt(v)}"
+                               for k, v in (logs or {}).items())
+            print(f"step {step}: {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = " - ".join(f"{k}: {_fmt(v)}"
+                               for k, v in (logs or {}).items())
+            print(f"epoch {epoch + 1} done in "
+                  f"{time.time() - self._t0:.1f}s - {items}")
+
+
+def _fmt(v):
+    try:
+        arr = np.asarray(v, dtype=np.float64)
+        if arr.size == 1:
+            return f"{float(arr):.4f}"
+        return np.array2string(arr, precision=4)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+class ModelCheckpoint(Callback):
+    """ref: callbacks.py ModelCheckpoint — saves every save_freq epochs
+    and at train end."""
+
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """ref: callbacks.py LRScheduler — steps the optimizer's LRScheduler
+    per epoch (or per batch when by_step)."""
+
+    def __init__(self, by_step: bool = False, by_epoch: bool = True):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    """ref: callbacks.py EarlyStopping — monitors an eval metric, stops
+    after `patience` non-improving evals, optionally restores best
+    weights."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "auto",
+                 patience: int = 0, verbose: int = 1, min_delta: float = 0,
+                 baseline=None, save_best_model: bool = True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.stopped_epoch = 0
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.best = (self.baseline if self.baseline is not None
+                     else (np.inf if self.mode == "min" else -np.inf))
+        self.best_weights = None
+        self._epoch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def _improved(self, cur):
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        if self.monitor not in logs:
+            return
+        cur = float(np.asarray(logs[self.monitor]).reshape(-1)[0])
+        if self._improved(cur):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and self.model is not None:
+                self.best_weights = {
+                    k: np.asarray(v.numpy())
+                    for k, v in self.model.network.state_dict().items()}
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+                self.stopped_epoch = self._epoch
+                if self.verbose:
+                    print(f"early stopping: {self.monitor} did not "
+                          f"improve past {self.best:.5f} for "
+                          f"{self.patience} evals")
+                if self.best_weights is not None:
+                    self.model.network.set_state_dict(self.best_weights)
+
+
+class VisualDL(Callback):
+    """Scalar logger. The reference streams to the VisualDL service; with
+    zero egress here, scalars append to a JSONL file under log_dir (same
+    tag/step/value triples a VisualDL writer would record). Records
+    buffer in memory and flush on epoch/eval end + train end, keeping the
+    per-batch hot path free of filesystem round-trips."""
+
+    def __init__(self, log_dir: str = "vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+        self._buf = []
+
+    def _record(self, tag, value, step):
+        try:
+            self._buf.append({"tag": tag, "step": step,
+                              "value": float(np.asarray(value)
+                                             .reshape(-1)[0])})
+        except (TypeError, ValueError):
+            pass
+
+    def _flush(self):
+        if not self._buf:
+            return
+        import json
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            for rec in self._buf:
+                f.write(json.dumps(rec) + "\n")
+        self._buf.clear()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            self._record(f"train/{k}", v, self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._flush()
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            self._record(f"eval/{k}", v, self._step)
+        self._flush()
+
+    def on_train_end(self, logs=None):
+        self._flush()
+
+
+def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
+                     verbose=2, save_freq=1, save_dir=None, metrics=None,
+                     log_freq=1, mode="train"):
+    """ref: callbacks.py config_callbacks — assembles the default set."""
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks.append(ModelCheckpoint(save_freq, save_dir))
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks.append(LRScheduler())
+    history = next((c for c in cbks if isinstance(c, History)), None)
+    if history is None:
+        history = History()
+        cbks.append(history)
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
+                    "metrics": metrics or []})
+    return lst, history

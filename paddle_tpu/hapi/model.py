@@ -1,7 +1,330 @@
-class Model:  # fleshed out in hapi milestone
+"""hapi high-level Model API.
+
+ref: python/paddle/hapi/model.py (Model.prepare, fit :1472, evaluate,
+predict, save/load, train_batch/eval_batch) plus model_summary.py
+(summary) and dynamic_flops.py (flops). TPU-native: fit's inner step is
+the same eager-over-compiled-ops path train_batch uses, so the whole
+surface stays jit-friendly.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "summary", "flops"]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(np.asarray(x)))
+
+
+def _as_batches(data, batch_size, shuffle):
+    """Accepts DataLoader / Dataset / (x, y) arrays; yields (ins, labels)
+    pairs."""
+    from ..io import DataLoader, Dataset
+
+    if isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size or 1,
+                          shuffle=shuffle)
+    if isinstance(data, (tuple, list)) and len(data) == 2:
+        x, y = data
+        n = len(x)
+        bs = batch_size or n
+
+        def gen():
+            order = (np.random.permutation(n) if shuffle
+                     else np.arange(n))
+            for i in range(0, n, bs):
+                sel = order[i:i + bs]
+                yield (x[sel], y[sel])
+        return gen()
+    raise TypeError(f"unsupported data type {type(data)!r} — pass a "
+                    f"DataLoader, Dataset, or (inputs, labels) pair")
+
+
+class Model:
+    """ref: hapi/model.py Model — high-level train/eval/predict over a
+    Layer."""
+
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List = []
+        self.stop_training = False
+
+    # -- configuration -------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = metrics or []
+        self._metrics = list(ms) if isinstance(ms, (list, tuple)) else [ms]
+        return self
+
+    # -- single-batch ops ----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        """ref: model.py train_batch — one fwd/bwd(/step) on a batch."""
+        self.network.train()
+        ins = inputs if isinstance(inputs, (tuple, list)) else [inputs]
+        ins = [_to_tensor(i) for i in ins]
+        out = self.network(*ins)
+        loss = out
+        if self._loss is not None:
+            lbl = labels if isinstance(labels, (tuple, list)) else [labels]
+            lbl = [_to_tensor(v) for v in lbl if v is not None]
+            loss = self._loss(out, *lbl)
+        if loss._data.ndim > 0:
+            loss = loss.mean()
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (tuple, list)) else [inputs]
+        ins = [_to_tensor(i) for i in ins]
+        out = self.network(*ins)
+        outs = {}
+        if self._loss is not None and labels is not None:
+            lbl = labels if isinstance(labels, (tuple, list)) else [labels]
+            lbl = [_to_tensor(v) for v in lbl if v is not None]
+            loss = self._loss(out, *lbl)
+            if loss._data.ndim > 0:
+                loss = loss.mean()
+            outs["loss"] = float(loss.item())
+        if labels is not None:
+            for m in self._metrics:
+                lbl0 = labels[0] if isinstance(labels, (tuple, list)) \
+                    else labels
+                corr = m.compute(out, _to_tensor(lbl0))
+                m.update(corr)
+        return outs
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (tuple, list)) else [inputs]
+        ins = [_to_tensor(i) for i in ins]
+        out = self.network(*ins)
+        return out.numpy() if isinstance(out, Tensor) else out
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1,
+            epochs=1, eval_freq=1, log_freq=10, save_dir=None,
+            save_freq=1, verbose=2, drop_last=False, shuffle=True,
+            num_workers=0, callbacks=None):
+        """ref: model.py fit :1472."""
+        cbks, history = config_callbacks(
+            callbacks, model=self, epochs=epochs, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir, log_freq=log_freq,
+            metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        logs = {}
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            losses = []
+            for step, (ins, lbl) in enumerate(
+                    _as_batches(train_data, batch_size, shuffle)):
+                cbks.on_train_batch_begin(step)
+                loss = self.train_batch(ins, lbl)
+                losses.append(loss[0])
+                cbks.on_train_batch_end(step, {"loss": loss[0]})
+            logs = {"loss": float(np.mean(losses)) if losses else None}
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data,
+                                          batch_size=batch_size,
+                                          verbose=0, _callbacks=cbks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return history.history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _callbacks=None):
+        cbks = _callbacks
+        if cbks is None:
+            cbks, _ = config_callbacks(callbacks, model=self,
+                                       verbose=verbose)
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []
+        for step, (ins, lbl) in enumerate(
+                _as_batches(eval_data, batch_size, False)):
+            cbks.on_eval_batch_begin(step)
+            outs = self.eval_batch(ins, lbl)
+            if "loss" in outs:
+                losses.append(outs["loss"])
+            cbks.on_eval_batch_end(step, outs)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            nm = m.name()
+            logs[nm[0] if isinstance(nm, (list, tuple)) else nm] = \
+                m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        outs = []
+        for batch in _as_batches(test_data, batch_size, False):
+            ins = batch[0] if isinstance(batch, (tuple, list)) and \
+                len(batch) == 2 else batch
+            outs.append(self.predict_batch(ins))
+        if stack_outputs and outs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        """ref: model.py save — parameters (+ optimizer state when
+        training=True) via the framework pickle format."""
+        from ..framework.io import save as _save
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+        sd = _load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    # -- introspection -------------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
 
 
-def summary(net, input_size=None, dtypes=None):
-    raise NotImplementedError
+# --------------------------- summary / flops --------------------------------
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """ref: hapi/model_summary.py summary — per-layer table of output
+    shapes and own-parameter counts; returns
+    {'total_params', 'trainable_params'}."""
+    import jax.numpy as jnp
+
+    rows = []
+    hooks = []
+
+    def _own_params(layer):
+        return sum(int(np.prod(p.shape))
+                   for p in layer._parameters.values() if p is not None)
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                else outputs
+            rows.append((name, layer.__class__.__name__,
+                         list(getattr(out, "shape", [])),
+                         _own_params(layer)))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+
+    was_training = net.training
+    net.eval()  # the probe forward must not touch BN stats / dropout
+    try:
+        if input is not None:
+            net(input)
+        else:
+            if input_size is None:
+                raise ValueError("summary needs input_size or input")
+            sizes = input_size if isinstance(input_size, list) \
+                else [input_size]
+            dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+                [dtypes or "float32"] * len(sizes)
+            xs = [Tensor(jnp.zeros(
+                [d if isinstance(d, int) and d > 0 else 1 for d in s], dt))
+                for s, dt in zip(sizes, dts)]
+            net(*xs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    lines = [f"{'Layer':<36}{'Type':<24}{'Output Shape':<22}"
+             f"{'Params':>10}", "-" * 92]
+    for nm, ty, shape, np_ in rows:
+        lines.append(f"{nm:<36}{ty:<24}{str(shape):<22}{np_:>10}")
+    lines += ["-" * 92, f"Total params: {total}",
+              f"Trainable params: {trainable}"]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """ref: hapi/dynamic_flops.py flops — multiply-add count for common
+    layer types via forward hooks."""
+    import jax.numpy as jnp
+
+    from .. import nn
+
+    total = {"n": 0}
+    hooks = []
+
+    def count_for(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+        oshape = list(getattr(out, "shape", []))
+        n = 0
+        if custom_ops and type(layer) in custom_ops:
+            n = custom_ops[type(layer)](layer, inputs, outputs)
+        elif isinstance(layer, nn.Linear):
+            n = int(np.prod(oshape)) * int(layer.weight.shape[0])
+        elif layer.__class__.__name__.startswith("Conv"):
+            w = layer.weight
+            n = int(np.prod(oshape)) * int(np.prod(w.shape[1:]))
+        elif "Norm" in layer.__class__.__name__:
+            n = int(np.prod(oshape)) * 2
+        elif "Pool" in layer.__class__.__name__:
+            n = int(np.prod(oshape))
+        total["n"] += n
+
+    for _, sub in net.named_sublayers(include_self=True):
+        hooks.append(sub.register_forward_post_hook(count_for))
+
+    sizes = input_size if isinstance(input_size[0], (list, tuple)) \
+        else [input_size]
+    xs = [Tensor(jnp.zeros([d if isinstance(d, int) and d > 0 else 1
+                            for d in s], "float32")) for s in sizes]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*xs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"FLOPs (multiply-adds): {total['n']}")
+    return total["n"]

@@ -113,18 +113,39 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
+        """Aggregated host-span statistics table (ref:
+        profiler/profiler_statistic.py op summary: calls, total, avg,
+        max, min, ratio)."""
         if _lib is None:
             return "native tracer unavailable"
         data = json.loads(_lib.tracer_dump())
         agg = {}
+        grand = 0.0
         for e in data.get("traceEvents", []):
-            rec = agg.setdefault(e["name"], [0, 0.0])
+            dur = float(e.get("dur", 0.0))
+            rec = agg.setdefault(e["name"], [0, 0.0, 0.0, float("inf")])
             rec[0] += 1
-            rec[1] += e.get("dur", 0.0)
-        lines = [f"{'name':<40} {'calls':>8} {'total_ms':>12}"]
-        for name, (calls, total) in sorted(agg.items(),
-                                           key=lambda kv: -kv[1][1]):
-            lines.append(f"{name:<40} {calls:>8} {total / 1e3:>12.3f}")
+            rec[1] += dur
+            rec[2] = max(rec[2], dur)
+            rec[3] = min(rec[3], dur)
+            grand += dur
+        units = {"ms": 1e3, "us": 1.0, "s": 1e6}
+        if time_unit not in units:
+            raise ValueError(
+                f"time_unit must be one of {sorted(units)}, "
+                f"got {time_unit!r}")
+        unit = units[time_unit]
+        u = time_unit
+        lines = [f"{'name':<36} {'calls':>7} {f'total_{u}':>11} "
+                 f"{f'avg_{u}':>10} {f'max_{u}':>10} {f'min_{u}':>10} "
+                 f"{'ratio':>7}"]
+        for name, (calls, total, mx, mn) in sorted(
+                agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(
+                f"{name:<36} {calls:>7} {total / unit:>11.3f} "
+                f"{total / calls / unit:>10.3f} {mx / unit:>10.3f} "
+                f"{mn / unit:>10.3f} "
+                f"{(total / grand if grand else 0.0):>6.1%}")
         return "\n".join(lines)
 
 

@@ -1,0 +1,146 @@
+"""hapi Model.fit/evaluate/predict + callbacks + summary/flops tests
+(ref: python/paddle/hapi/model.py, callbacks.py, dynamic_flops.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model, flops, summary
+from paddle_tpu.hapi.callbacks import (Callback, EarlyStopping,
+                                       ModelCheckpoint, VisualDL)
+from paddle_tpu.metric import Accuracy
+
+
+def _toy_data(n=64, din=4, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, din)).astype(np.float32)
+    W = rng.normal(size=(din, classes)).astype(np.float32)
+    y = (X @ W).argmax(-1).astype(np.int64)
+    return X, y
+
+
+def _model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 32), nn.ReLU(), nn.Linear(32, 3))
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    return m
+
+
+def test_fit_decreases_loss_and_returns_history():
+    X, y = _toy_data()
+    m = _model()
+    hist = m.fit((X, y), batch_size=16, epochs=8, verbose=0)
+    assert "loss" in hist
+    assert hist["loss"][-1] < hist["loss"][0] * 0.5
+
+
+def test_fit_with_eval_and_accuracy():
+    X, y = _toy_data()
+    m = _model()
+    hist = m.fit((X, y), eval_data=(X, y), batch_size=16, epochs=6,
+                 verbose=0)
+    assert "eval_acc" in hist
+    assert hist["eval_acc"][-1] > 0.8
+
+
+def test_evaluate_and_predict():
+    X, y = _toy_data()
+    m = _model()
+    m.fit((X, y), batch_size=16, epochs=6, verbose=0)
+    logs = m.evaluate((X, y), batch_size=16, verbose=0)
+    assert logs["acc"] > 0.8 and "loss" in logs
+    preds = m.predict((X, y), batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 3)
+
+
+def test_save_load_roundtrip(tmp_path):
+    X, y = _toy_data()
+    m = _model()
+    m.fit((X, y), batch_size=16, epochs=2, verbose=0)
+    path = str(tmp_path / "ckpt" / "model")
+    m.save(path)
+    m2 = _model()
+    m2.load(path)
+    np.testing.assert_allclose(
+        m2.network[0].weight.numpy(), m.network[0].weight.numpy())
+
+
+def test_model_checkpoint_callback(tmp_path):
+    import os
+    X, y = _toy_data()
+    m = _model()
+    m.fit((X, y), batch_size=32, epochs=2, verbose=0,
+          callbacks=[ModelCheckpoint(save_freq=1,
+                                     save_dir=str(tmp_path))])
+    assert os.path.exists(str(tmp_path / "0.pdparams"))
+    assert os.path.exists(str(tmp_path / "final.pdparams"))
+
+
+def test_early_stopping_stops():
+    X, y = _toy_data()
+    m = _model()
+    es = EarlyStopping(monitor="loss", patience=1, verbose=0,
+                       min_delta=10.0)  # impossible improvement bar
+    hist = m.fit((X, y), eval_data=(X, y), batch_size=16, epochs=20,
+                 verbose=0, callbacks=[es])
+    assert len(hist["loss"]) < 20, "early stopping never fired"
+
+
+def test_custom_callback_hooks_fire():
+    X, y = _toy_data()
+    seen = []
+
+    class Probe(Callback):
+        def on_epoch_begin(self, epoch, logs=None):
+            seen.append(("begin", epoch))
+
+        def on_epoch_end(self, epoch, logs=None):
+            seen.append(("end", epoch, sorted((logs or {}).keys())))
+
+    m = _model()
+    m.fit((X, y), batch_size=32, epochs=2, verbose=0,
+          callbacks=[Probe()])
+    assert ("begin", 0) in seen and ("begin", 1) in seen
+    assert any(e[0] == "end" and "loss" in e[2] for e in seen)
+
+
+def test_visualdl_writes_scalars(tmp_path):
+    import json
+    X, y = _toy_data()
+    m = _model()
+    m.fit((X, y), batch_size=32, epochs=1, verbose=0,
+          callbacks=[VisualDL(log_dir=str(tmp_path))])
+    lines = (tmp_path / "scalars.jsonl").read_text().splitlines()
+    recs = [json.loads(l) for l in lines]
+    assert any(r["tag"] == "train/loss" for r in recs)
+
+
+def test_summary_counts_params(capsys):
+    net = nn.Sequential(nn.Linear(4, 32), nn.ReLU(), nn.Linear(32, 3))
+    info = summary(net, (1, 4))
+    want = 4 * 32 + 32 + 32 * 3 + 3
+    assert info["total_params"] == want
+    assert info["trainable_params"] == want
+    out = capsys.readouterr().out
+    assert "Linear" in out and str(want) in out
+
+
+def test_flops_linear_and_conv():
+    net = nn.Linear(4, 8)
+    n = flops(net, (2, 4))
+    assert n == 2 * 8 * 4  # out elems * in features
+    conv = nn.Conv2D(3, 16, 3, padding=1)
+    n2 = flops(conv, (1, 3, 8, 8))
+    assert n2 == 16 * 8 * 8 * 3 * 9  # out elems * (I/g * k*k)
+
+
+def test_dataset_input_path():
+    from paddle_tpu.io import TensorDataset
+    X, y = _toy_data(n=32)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+    m = _model()
+    hist = m.fit(ds, batch_size=8, epochs=2, verbose=0, shuffle=False)
+    assert len(hist["loss"]) == 2
