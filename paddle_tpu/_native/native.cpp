@@ -445,9 +445,173 @@ class TCPStoreClient {
 }  // namespace
 
 // ---------------------------------------------------------------------------
+// Op registry + executable cache (kernel dispatch)
+//
+// ref: paddle/phi/core/kernel_factory.h:58 KernelKey / :240 KernelFactory —
+// the reference keys kernels by (name, backend, layout, dtype) in a global
+// C++ factory. TPU mapping: the "kernel" for an op signature is a compiled
+// XLA executable; the registry stores per-op descriptors (arity, vjp,
+// SPMD rule name) populated from the YAML op table, and the cache maps
+// (op, signature) -> the jitted callable with hit/miss stats.
+// ---------------------------------------------------------------------------
+struct OpDesc {
+  int nin = 0;    // required tensor-ish inputs
+  int nargs = 1;  // total positional parameters
+  bool has_vjp = true;
+  std::string spmd_rule;
+};
+
+class OpRegistry {
+ public:
+  static OpRegistry& Instance() {
+    static OpRegistry r;
+    return r;
+  }
+  void Register(const std::string& name, const OpDesc& d) {
+    std::lock_guard<std::mutex> g(mu_);
+    ops_[name] = d;
+  }
+  bool Lookup(const std::string& name, OpDesc* out) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = ops_.find(name);
+    if (it == ops_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+  std::vector<std::string> Names() const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<std::string> out;
+    out.reserve(ops_.size());
+    for (auto& kv : ops_) out.push_back(kv.first);
+    return out;
+  }
+  size_t Count() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return ops_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::unordered_map<std::string, OpDesc> ops_;
+};
+
+// Holds PyObject* callables; all entry points run with the GIL held (they
+// are CPython binding calls), so refcount ops are safe.
+class ExecCache {
+ public:
+  static ExecCache& Instance() {
+    static ExecCache c;
+    return c;
+  }
+  PyObject* Get(const std::string& key) {  // returns NEW ref or nullptr
+    auto it = cache_.find(key);
+    if (it == cache_.end()) {
+      ++misses_;
+      return nullptr;
+    }
+    ++hits_;
+    Py_INCREF(it->second);
+    return it->second;
+  }
+  void Put(const std::string& key, PyObject* obj) {
+    auto it = cache_.find(key);
+    if (it != cache_.end()) {
+      Py_DECREF(it->second);
+      cache_.erase(it);
+    } else if (cache_.size() >= kMaxEntries) {
+      // bounded cache: entries pin their callables (and anything those
+      // close over, e.g. model weights), so evict rather than grow
+      auto victim = cache_.begin();
+      Py_DECREF(victim->second);
+      cache_.erase(victim);
+    }
+    Py_INCREF(obj);
+    cache_[key] = obj;
+  }
+  void Clear() {
+    for (auto& kv : cache_) Py_DECREF(kv.second);
+    cache_.clear();
+    hits_ = misses_ = 0;
+  }
+  size_t size() const { return cache_.size(); }
+  long long hits() const { return hits_; }
+  long long misses() const { return misses_; }
+
+ private:
+  static constexpr size_t kMaxEntries = 16;
+  std::unordered_map<std::string, PyObject*> cache_;
+  long long hits_ = 0;
+  long long misses_ = 0;
+};
+
+// ---------------------------------------------------------------------------
 // Python bindings (CPython C API)
 // ---------------------------------------------------------------------------
 extern "C" {
+
+static PyObject* py_op_register(PyObject*, PyObject* args) {
+  const char *name, *spmd = "";
+  int nin, nargs, has_vjp;
+  if (!PyArg_ParseTuple(args, "siip|s", &name, &nin, &nargs, &has_vjp,
+                        &spmd))
+    return nullptr;
+  OpDesc d;
+  d.nin = nin;
+  d.nargs = nargs;
+  d.has_vjp = has_vjp != 0;
+  d.spmd_rule = spmd;
+  OpRegistry::Instance().Register(name, d);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_op_lookup(PyObject*, PyObject* args) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  OpDesc d;
+  if (!OpRegistry::Instance().Lookup(name, &d)) Py_RETURN_NONE;
+  return Py_BuildValue("{s:i,s:i,s:O,s:s}", "nin", d.nin, "nargs",
+                       d.nargs,
+                       "has_vjp", d.has_vjp ? Py_True : Py_False,
+                       "spmd_rule", d.spmd_rule.c_str());
+}
+
+static PyObject* py_op_names(PyObject*, PyObject*) {
+  auto names = OpRegistry::Instance().Names();
+  PyObject* list = PyList_New(names.size());
+  for (size_t i = 0; i < names.size(); ++i)
+    PyList_SET_ITEM(list, i, PyUnicode_FromString(names[i].c_str()));
+  return list;
+}
+
+static PyObject* py_op_count(PyObject*, PyObject*) {
+  return PyLong_FromSize_t(OpRegistry::Instance().Count());
+}
+
+static PyObject* py_exec_cache_get(PyObject*, PyObject* args) {
+  const char* key;
+  if (!PyArg_ParseTuple(args, "s", &key)) return nullptr;
+  PyObject* obj = ExecCache::Instance().Get(key);
+  if (obj == nullptr) Py_RETURN_NONE;
+  return obj;
+}
+
+static PyObject* py_exec_cache_put(PyObject*, PyObject* args) {
+  const char* key;
+  PyObject* obj;
+  if (!PyArg_ParseTuple(args, "sO", &key, &obj)) return nullptr;
+  ExecCache::Instance().Put(key, obj);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_exec_cache_stats(PyObject*, PyObject*) {
+  auto& c = ExecCache::Instance();
+  return Py_BuildValue("(LLn)", c.hits(), c.misses(), (Py_ssize_t)c.size());
+}
+
+static PyObject* py_exec_cache_clear(PyObject*, PyObject*) {
+  ExecCache::Instance().Clear();
+  Py_RETURN_NONE;
+}
 
 static PyObject* py_flag_define(PyObject*, PyObject* args) {
   const char *name, *def, *help = "";
@@ -682,6 +846,18 @@ static PyMethodDef Methods[] = {
     {"store_set", py_store_set, METH_VARARGS, "set key"},
     {"store_get", py_store_get, METH_VARARGS, "get key (optionally wait)"},
     {"store_add", py_store_add, METH_VARARGS, "atomic add"},
+    {"op_register", py_op_register, METH_VARARGS, "register op descriptor"},
+    {"op_lookup", py_op_lookup, METH_VARARGS, "lookup op descriptor"},
+    {"op_names", py_op_names, METH_NOARGS, "registered op names"},
+    {"op_count", py_op_count, METH_NOARGS, "registered op count"},
+    {"exec_cache_get", py_exec_cache_get, METH_VARARGS,
+     "executable cache lookup"},
+    {"exec_cache_put", py_exec_cache_put, METH_VARARGS,
+     "executable cache insert"},
+    {"exec_cache_stats", py_exec_cache_stats, METH_NOARGS,
+     "(hits, misses, size)"},
+    {"exec_cache_clear", py_exec_cache_clear, METH_NOARGS,
+     "clear executable cache"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,

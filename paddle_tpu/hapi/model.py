@@ -26,7 +26,7 @@ def _to_tensor(x):
     return Tensor(jnp.asarray(np.asarray(x)))
 
 
-def _as_batches(data, batch_size, shuffle):
+def _as_batches(data, batch_size, shuffle, drop_last=False):
     """Accepts DataLoader / Dataset / (x, y) arrays; yields (ins, labels)
     pairs."""
     from ..io import DataLoader, Dataset
@@ -35,7 +35,7 @@ def _as_batches(data, batch_size, shuffle):
         return data
     if isinstance(data, Dataset):
         return DataLoader(data, batch_size=batch_size or 1,
-                          shuffle=shuffle)
+                          shuffle=shuffle, drop_last=drop_last)
     if isinstance(data, (tuple, list)) and len(data) == 2:
         x, y = data
         n = len(x)
@@ -44,7 +44,8 @@ def _as_batches(data, batch_size, shuffle):
         def gen():
             order = (np.random.permutation(n) if shuffle
                      else np.arange(n))
-            for i in range(0, n, bs):
+            stop = (n - n % bs) if drop_last else n
+            for i in range(0, stop, bs):
                 sel = order[i:i + bs]
                 yield (x[sel], y[sel])
         return gen()
@@ -139,7 +140,8 @@ class Model:
             cbks.on_epoch_begin(epoch)
             losses = []
             for step, (ins, lbl) in enumerate(
-                    _as_batches(train_data, batch_size, shuffle)):
+                    _as_batches(train_data, batch_size, shuffle,
+                                drop_last)):
                 cbks.on_train_batch_begin(step)
                 loss = self.train_batch(ins, lbl)
                 losses.append(loss[0])
@@ -255,8 +257,9 @@ def summary(net, input_size=None, dtypes=None, input=None):
         else:
             if input_size is None:
                 raise ValueError("summary needs input_size or input")
-            sizes = input_size if isinstance(input_size, list) \
-                else [input_size]
+            sizes = (input_size
+                     if isinstance(input_size[0], (list, tuple))
+                     else [input_size])
             dts = dtypes if isinstance(dtypes, (list, tuple)) else \
                 [dtypes or "float32"] * len(sizes)
             xs = [Tensor(jnp.zeros(

@@ -12,6 +12,7 @@ reconstruct without the training script.
 from __future__ import annotations
 
 import importlib
+import os
 import inspect
 from typing import Optional, Sequence
 
@@ -119,6 +120,7 @@ class Predictor:
     named inputs in, named outputs out, internal exec state reused)."""
 
     def __init__(self, model_or_config):
+        self._cache_key_base = None
         if isinstance(model_or_config, Config):
             cfg = model_or_config
             if cfg.model_path is None:
@@ -128,6 +130,16 @@ class Predictor:
             model = load_inference_model(cfg.model_path)
             if cfg._bf16:
                 model.bfloat16()
+            # artifact-backed predictors share compiled executables
+            # process-wide through the native ExecCache (KernelFactory
+            # analog): a second Predictor on the same path skips compile.
+            # mtime+size in the key invalidate on artifact overwrite (the
+            # replaced cache entry drops the old model's closure).
+            art = cfg.model_path + ".pdmodel"
+            st = os.stat(art)
+            self._cache_key_base = \
+                f"predictor|{os.path.abspath(cfg.model_path)}" \
+                f"|{st.st_mtime_ns}|{st.st_size}|bf16={cfg._bf16}"
         else:
             model = model_or_config
         self.model = model
@@ -151,7 +163,22 @@ class Predictor:
                     l.training = t
             return out
 
-        self._jitted = jax.jit(fwd)  # shape/dtype-keyed compile cache
+        if self._cache_key_base is not None:
+            from ._native import lib as _nlib
+            if _nlib is not None:
+                cached = _nlib.exec_cache_get(self._cache_key_base)
+                if cached is not None:
+                    # reuse the jitted callable (its XLA compile cache
+                    # comes with it) but bind THIS instance's params
+                    self._jitted = cached
+                else:
+                    self._jitted = jax.jit(fwd)
+                    _nlib.exec_cache_put(self._cache_key_base,
+                                         self._jitted)
+            else:
+                self._jitted = jax.jit(fwd)
+        else:
+            self._jitted = jax.jit(fwd)  # shape/dtype-keyed compile cache
 
     def run(self, *inputs):
         """numpy/Tensor/jax-array inputs -> list of numpy outputs."""

@@ -151,3 +151,7 @@ def _patch_operators():
 
 _patch_methods()
 _patch_operators()
+
+# populate the native OpRegistry from the declarative op table
+from . import op_registry  # noqa: F401,E402
+from .op_registry import get_op_info, list_ops, num_ops  # noqa: F401,E402

@@ -1,0 +1,85 @@
+"""Op-table loader: ops.yaml -> native C++ OpRegistry (+ Python mirror).
+
+ref: the reference's build-time codegen consumes paddle/phi/ops/yaml/
+ops.yaml to generate its C++ API/grad-nodes/bindings (SURVEY §2.1 codegen
+suite row). Here the same single-source table populates the native
+OpRegistry (kernel-dispatch metadata: arity, vjp, SPMD rule) at import —
+kernels are traced XLA programs, so there is no C++ kernel body to
+generate, only descriptors to serve dispatch and introspection.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+__all__ = ["get_op_info", "list_ops", "num_ops", "OP_TABLE"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+OP_TABLE: Dict[str, dict] = {}
+
+
+def _load_yaml() -> list:
+    path = os.path.join(_HERE, "ops.yaml")
+    with open(path) as f:
+        text = f.read()
+    try:
+        import yaml
+        return yaml.safe_load(text)["ops"]
+    except ImportError:  # minimal fallback parser for our flat schema
+        ops, cur = [], None
+        for line in text.splitlines():
+            s = line.strip()
+            if s.startswith("#") or not s:
+                continue
+            if s.startswith("- name:"):
+                cur = {"name": s.split(":", 1)[1].strip()}
+                ops.append(cur)
+            elif cur is not None and ":" in s and not s.startswith("ops"):
+                k, v = s.split(":", 1)
+                v = v.strip()
+                cur[k.strip()] = (v == "true" if v in ("true", "false")
+                                  else int(v) if v.isdigit() else v)
+        return ops
+
+
+def _register_all():
+    from .._native import lib
+    for entry in _load_yaml():
+        name = entry["name"]
+        info = {
+            "module": entry.get("module", ""),
+            "nin": int(entry.get("nin", 1)),
+            "nargs": int(entry.get("nargs", 1)),
+            "has_vjp": bool(entry.get("vjp", True)),
+            "spmd_rule": entry.get("spmd", ""),
+        }
+        OP_TABLE[name] = info
+        if lib is not None:
+            lib.op_register(name, info["nin"], info["nargs"],
+                            info["has_vjp"], info["spmd_rule"])
+
+
+def get_op_info(name: str) -> Optional[dict]:
+    """Descriptor for a registered op; prefers the native registry
+    (KernelFactory analog), falling back to the Python mirror."""
+    from .._native import lib
+    mirror = OP_TABLE.get(name)
+    if lib is not None:
+        d = lib.op_lookup(name)
+        if d is not None:
+            # one shape regardless of backend: native descriptor merged
+            # over the Python mirror (which carries e.g. 'module')
+            return {**(mirror or {}), **d}
+    return mirror
+
+
+def list_ops():
+    return sorted(OP_TABLE)
+
+
+def num_ops() -> int:
+    return len(OP_TABLE)
+
+
+_register_all()

@@ -131,3 +131,66 @@ class TestTCPStore:
         assert worker.get_nowait("empty_key") == b""
         assert worker.get_nowait("never_set_key") is None
         master.shutdown()
+
+
+class TestOpRegistry:
+    """Native op registry + executable cache (ref: phi KernelFactory,
+    kernel_factory.h:58,240; populated from ops/ops.yaml)."""
+
+    def test_yaml_table_registered(self):
+        from paddle_tpu.ops import get_op_info, list_ops, num_ops
+        assert num_ops() > 250
+        info = get_op_info("matmul")
+        assert info["nin"] == 2 and info["has_vjp"]
+        assert info["spmd_rule"] == "matmul"
+        assert "softmax" in list_ops()
+        assert get_op_info("not_an_op") is None
+
+    def test_native_and_python_mirror_agree(self):
+        from paddle_tpu._native import lib
+        from paddle_tpu.ops.op_registry import OP_TABLE
+        if lib is None:
+            pytest.skip("native lib unavailable")
+        assert lib.op_count() == len(OP_TABLE)
+        d = lib.op_lookup("flash_attention")
+        assert d["spmd_rule"] == "flash_attention"
+
+    def test_exec_cache_roundtrip_and_stats(self):
+        from paddle_tpu._native import lib
+        if lib is None:
+            pytest.skip("native lib unavailable")
+        lib.exec_cache_clear()
+        fn = lambda x: x * 2
+        assert lib.exec_cache_get("k1") is None
+        lib.exec_cache_put("k1", fn)
+        assert lib.exec_cache_get("k1") is fn
+        hits, misses, size = lib.exec_cache_stats()
+        assert (hits, misses, size) == (1, 1, 1)
+        # replacing the entry must not leak or crash (refcount handling)
+        lib.exec_cache_put("k1", lambda x: x)
+        assert lib.exec_cache_get("k1") is not fn
+        lib.exec_cache_clear()
+        assert lib.exec_cache_stats() == (0, 0, 0)
+
+
+class TestPredictorExecCacheSharing:
+    def test_same_artifact_shares_jitted_callable(self, tmp_path):
+        import numpy as np
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        from paddle_tpu._native import lib
+        if lib is None:
+            pytest.skip("native lib unavailable")
+
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models import LeNet
+        paddle.seed(0)
+
+        m = LeNet()
+        path = str(tmp_path / "m")
+        inference.save_inference_model(path, m)
+        p1 = inference.Predictor(inference.Config(path))
+        p2 = inference.Predictor(inference.Config(path))
+        assert p1._jitted is p2._jitted, "exec cache did not share"
+        x = np.ones((1, 1, 28, 28), np.float32)
+        np.testing.assert_allclose(p1.run(x)[0], p2.run(x)[0])
