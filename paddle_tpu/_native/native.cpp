@@ -514,24 +514,44 @@ class ExecCache {
     return it->second;
   }
   void Put(const std::string& key, PyObject* obj) {
+    // DECREF can run arbitrary Python (tp_dealloc / weakref callbacks)
+    // that may reenter the cache — detach entries from the map BEFORE
+    // any DECREF so no live iterator spans Python execution.
+    std::vector<PyObject*> dead;
     auto it = cache_.find(key);
     if (it != cache_.end()) {
-      Py_DECREF(it->second);
+      dead.push_back(it->second);
       cache_.erase(it);
     } else if (cache_.size() >= kMaxEntries) {
       // bounded cache: entries pin their callables (and anything those
       // close over, e.g. model weights), so evict rather than grow
       auto victim = cache_.begin();
-      Py_DECREF(victim->second);
+      dead.push_back(victim->second);
       cache_.erase(victim);
     }
     Py_INCREF(obj);
     cache_[key] = obj;
+    for (PyObject* p : dead) Py_DECREF(p);
+  }
+  void EvictPrefix(const std::string& prefix) {
+    std::vector<PyObject*> dead;
+    for (auto it = cache_.begin(); it != cache_.end();) {
+      if (it->first.compare(0, prefix.size(), prefix) == 0) {
+        dead.push_back(it->second);
+        it = cache_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    for (PyObject* p : dead) Py_DECREF(p);
   }
   void Clear() {
-    for (auto& kv : cache_) Py_DECREF(kv.second);
+    std::vector<PyObject*> dead;
+    dead.reserve(cache_.size());
+    for (auto& kv : cache_) dead.push_back(kv.second);
     cache_.clear();
     hits_ = misses_ = 0;
+    for (PyObject* p : dead) Py_DECREF(p);
   }
   size_t size() const { return cache_.size(); }
   long long hits() const { return hits_; }
@@ -610,6 +630,13 @@ static PyObject* py_exec_cache_stats(PyObject*, PyObject*) {
 
 static PyObject* py_exec_cache_clear(PyObject*, PyObject*) {
   ExecCache::Instance().Clear();
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_exec_cache_evict_prefix(PyObject*, PyObject* args) {
+  const char* prefix;
+  if (!PyArg_ParseTuple(args, "s", &prefix)) return nullptr;
+  ExecCache::Instance().EvictPrefix(prefix);
   Py_RETURN_NONE;
 }
 
@@ -858,6 +885,8 @@ static PyMethodDef Methods[] = {
      "(hits, misses, size)"},
     {"exec_cache_clear", py_exec_cache_clear, METH_NOARGS,
      "clear executable cache"},
+    {"exec_cache_evict_prefix", py_exec_cache_evict_prefix, METH_VARARGS,
+     "drop all cache entries whose key starts with prefix"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,

@@ -163,22 +163,20 @@ class Predictor:
                     l.training = t
             return out
 
-        if self._cache_key_base is not None:
-            from ._native import lib as _nlib
-            if _nlib is not None:
-                cached = _nlib.exec_cache_get(self._cache_key_base)
-                if cached is not None:
-                    # reuse the jitted callable (its XLA compile cache
-                    # comes with it) but bind THIS instance's params
-                    self._jitted = cached
-                else:
-                    self._jitted = jax.jit(fwd)
-                    _nlib.exec_cache_put(self._cache_key_base,
-                                         self._jitted)
-            else:
-                self._jitted = jax.jit(fwd)
-        else:
-            self._jitted = jax.jit(fwd)  # shape/dtype-keyed compile cache
+        from ._native import lib as _nlib
+        use_cache = self._cache_key_base is not None and _nlib is not None
+        cached = (_nlib.exec_cache_get(self._cache_key_base)
+                  if use_cache else None)
+        # (re)compile or reuse the jitted callable — its XLA compile cache
+        # comes with it; params/buffers bind per run() call
+        self._jitted = cached if cached is not None else jax.jit(fwd)
+        if use_cache and cached is None:
+            # evict entries for older versions of this artifact first —
+            # their keys (old mtime/size) would otherwise pin the old
+            # model's weights until cap eviction
+            prefix = self._cache_key_base.rsplit("|", 3)[0] + "|"
+            _nlib.exec_cache_evict_prefix(prefix)
+            _nlib.exec_cache_put(self._cache_key_base, self._jitted)
 
     def run(self, *inputs):
         """numpy/Tensor/jax-array inputs -> list of numpy outputs."""

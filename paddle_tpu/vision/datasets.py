@@ -12,7 +12,8 @@ import numpy as np
 
 from ..io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012"]
 
 
 class _SyntheticImageDataset(Dataset):
@@ -66,3 +67,39 @@ class Cifar10(_SyntheticImageDataset):
 
 class Cifar100(Cifar10):
     NUM_CLASSES = 100
+
+
+class Flowers(_SyntheticImageDataset):
+    """ref: vision/datasets/flowers.py (102-category Oxford flowers)."""
+    IMAGE_SHAPE = (3, 96, 96)
+    NUM_CLASSES = 102
+    NUM_SAMPLES = 512
+
+
+class VOC2012(Dataset):
+    """ref: vision/datasets/voc2012.py — segmentation pairs (image,
+    label-mask). Synthetic shapes: [3, H, W] uint8 image, [H, W] int64
+    mask over 21 classes (20 + background)."""
+    NUM_CLASSES = 21
+
+    def __init__(self, mode="train", transform=None, backend=None,
+                 data_file=None, download=True):
+        self.mode = mode
+        self.transform = transform
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 128 if mode == "train" else 32
+        self.images = rng.integers(0, 256, size=(n, 3, 64, 64),
+                                   dtype=np.uint8)
+        self.masks = rng.integers(0, self.NUM_CLASSES, size=(n, 64, 64),
+                                  dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)
