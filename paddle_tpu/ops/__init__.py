@@ -14,8 +14,9 @@ from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
+from .extra_math import *  # noqa: F401,F403
 
-from . import creation, linalg, manipulation, math as math_ops
+from . import creation, extra_math, linalg, manipulation, math as math_ops
 
 
 def cast(x, dtype):

@@ -1,0 +1,668 @@
+"""Long-tail tensor ops completing the reference's top-level surface.
+
+ref: python/paddle/__init__.py __all__ and python/paddle/tensor/
+{math,manipulation,creation,linalg}.py — thin differentiable wrappers
+over jnp (XLA fuses them); grouped here to keep the core op modules
+focused on the hot surface.
+"""
+from __future__ import annotations
+
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+
+__all__ = [
+    "addmm", "add_n", "as_complex", "as_real", "block_diag",
+    "broadcast_shape", "bucketize", "cartesian_prod", "cdist",
+    "column_stack", "combinations", "complex", "copysign",
+    "cumulative_trapezoid", "deg2rad", "diag_embed", "diagflat",
+    "diagonal_scatter", "dsplit", "dstack", "frexp", "gammainc",
+    "gammaincc", "gammaln", "gcd", "heaviside", "histogram",
+    "histogram_bin_edges", "histogramdd", "hsplit", "hstack", "i0", "i0e",
+    "i1", "i1e", "index_fill", "is_complex", "is_empty",
+    "is_floating_point", "is_integer", "is_tensor", "isin", "isneginf",
+    "isposinf", "isreal", "lcm", "ldexp", "log_normal", "logcumsumexp",
+    "logit", "logspace", "masked_scatter", "multigammaln", "multiplex",
+    "nan_to_num", "nanmedian", "nanquantile", "nextafter", "pdist",
+    "poisson", "polar", "polygamma", "quantile", "rad2deg", "randint_like",
+    "reduce_as", "renorm", "reverse", "row_stack", "select_scatter",
+    "sgn", "signbit", "sinc", "slice_scatter", "standard_gamma",
+    "standard_normal", "take", "tensor_split", "trapezoid",
+    "tril_indices", "triu_indices", "unflatten", "unique_consecutive",
+    "unstack", "vander", "view_as", "vsplit", "vstack",
+    "bitwise_left_shift", "bitwise_right_shift",
+]
+
+
+def _d(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _op(f, *args, name):
+    return apply_op(f, *args, op_name=name)
+
+
+# --------------------------- predicates / info ------------------------------
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(_d(x).dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_d(x).dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_d(x).dtype, jnp.floating)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_d(x).size == 0))
+
+
+def isreal(x, name=None):
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            return jnp.imag(a) == 0
+        return jnp.ones(a.shape, bool)
+    return _op(f, x, name="isreal")
+
+
+def isposinf(x, name=None):
+    return _op(lambda a: jnp.isposinf(a), x, name="isposinf")
+
+
+def isneginf(x, name=None):
+    return _op(lambda a: jnp.isneginf(a), x, name="isneginf")
+
+
+def signbit(x, name=None):
+    return _op(jnp.signbit, x, name="signbit")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return _op(lambda a, b: jnp.isin(a, b, invert=invert), x, test_x,
+               name="isin")
+
+
+# ------------------------------- math ---------------------------------------
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _op(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+               name="addmm")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    return _op(lambda *xs: sum(xs[1:], xs[0]), *inputs, name="add_n")
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1 - eps)
+        return jnp.log(a) - jnp.log1p(-a)
+    return _op(f, x, name="logit")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+    return _op(f, x, name="logcumsumexp")
+
+
+def sinc(x, name=None):
+    return _op(jnp.sinc, x, name="sinc")
+
+
+def heaviside(x, y, name=None):
+    return _op(jnp.heaviside, x, y, name="heaviside")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                        neginf=neginf), x,
+               name="nan_to_num")
+
+
+def sgn(x, name=None):
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / mag)
+        return jnp.sign(a)
+    return _op(f, x, name="sgn")
+
+
+def copysign(x, y, name=None):
+    return _op(jnp.copysign, x, y, name="copysign")
+
+
+def nextafter(x, y, name=None):
+    return _op(jnp.nextafter, x, y, name="nextafter")
+
+
+def frexp(x, name=None):
+    return _op(lambda a: tuple(jnp.frexp(a)), x, name="frexp")
+
+
+def ldexp(x, y, name=None):
+    return _op(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y,
+               name="ldexp")
+
+
+def rad2deg(x, name=None):
+    return _op(jnp.rad2deg, x, name="rad2deg")
+
+
+def deg2rad(x, name=None):
+    return _op(jnp.deg2rad, x, name="deg2rad")
+
+
+def gcd(x, y, name=None):
+    return _op(jnp.gcd, x, y, name="gcd")
+
+
+def lcm(x, y, name=None):
+    return _op(jnp.lcm, x, y, name="lcm")
+
+
+def gammaln(x, name=None):
+    return _op(jax.scipy.special.gammaln, x, name="gammaln")
+
+
+def gammainc(x, y, name=None):
+    return _op(jax.scipy.special.gammainc, x, y, name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return _op(jax.scipy.special.gammaincc, x, y, name="gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    def f(a):
+        c = 0.25 * p * (p - 1) * _pymath.log(_pymath.pi)
+        j = jnp.arange(p, dtype=jnp.float32)
+        return c + jnp.sum(
+            jax.scipy.special.gammaln(a[..., None] - 0.5 * j), -1)
+    return _op(f, x, name="multigammaln")
+
+
+def polygamma(x, n, name=None):
+    if n == 0:
+        return _op(jax.scipy.special.digamma, x, name="polygamma")
+    return _op(lambda a: jax.scipy.special.polygamma(n, a), x,
+               name="polygamma")
+
+
+def i0(x, name=None):
+    return _op(jax.scipy.special.i0, x, name="i0")
+
+
+def i0e(x, name=None):
+    return _op(jax.scipy.special.i0e, x, name="i0e")
+
+
+def i1(x, name=None):
+    return _op(jax.scipy.special.i1, x, name="i1")
+
+
+def i1e(x, name=None):
+    return _op(jax.scipy.special.i1e, x, name="i1e")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return _op(lambda a, b: jnp.trapezoid(a, b, axis=axis), y, x,
+                   name="trapezoid")
+    return _op(lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis), y,
+               name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(a, *maybe_x):
+        a = jnp.moveaxis(a, axis, -1)
+        if maybe_x:
+            xs = jnp.moveaxis(maybe_x[0], axis, -1)
+            widths = jnp.diff(xs)
+        else:
+            widths = (dx or 1.0)
+        areas = (a[..., 1:] + a[..., :-1]) / 2 * widths
+        return jnp.moveaxis(jnp.cumsum(areas, -1), -1, axis)
+    args = [y] + ([x] if x is not None else [])
+    return _op(f, *args, name="cumulative_trapezoid")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    return _op(lambda a: jnp.quantile(a, jnp.asarray(q), axis=axis,
+                                      keepdims=keepdim,
+                                      method=interpolation), x,
+               name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return _op(lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=axis,
+                                         keepdims=keepdim,
+                                         method=interpolation), x,
+               name="nanquantile")
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _op(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x,
+               name="nanmedian")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims,
+                        keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7),
+                           1.0)
+        return a * factor
+    return _op(f, x, name="renorm")
+
+
+def reduce_as(x, target, name=None):
+    def f(a, t):
+        extra = a.ndim - t.ndim
+        axes = tuple(range(extra)) + tuple(
+            i + extra for i in range(t.ndim)
+            if t.shape[i] == 1 and a.shape[i + extra] != 1)
+        out = jnp.sum(a, axis=axes, keepdims=False)
+        return out.reshape(t.shape)
+    return _op(f, x, target, name="reduce_as")
+
+
+# ----------------------- complex-number helpers ------------------------------
+
+def complex(real, imag, name=None):
+    return _op(jax.lax.complex, real, imag, name="complex")
+
+
+def as_complex(x, name=None):
+    return _op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+               name="as_complex")
+
+
+def as_real(x, name=None):
+    return _op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), x,
+               name="as_real")
+
+
+def polar(abs, angle, name=None):
+    return _op(lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                            r * jnp.sin(t)),
+               abs, angle, name="polar")
+
+
+# --------------------------- random ------------------------------------------
+
+def standard_normal(shape, dtype="float32", name=None):
+    key = random_mod.next_key()
+    return Tensor(jax.random.normal(key, tuple(shape),
+                                    jnp.dtype(dtype)))
+
+
+def standard_gamma(x, name=None):
+    key = random_mod.next_key()
+    return _op(lambda a: jax.random.gamma(key, a), x,
+               name="standard_gamma")
+
+
+def poisson(x, name=None):
+    key = random_mod.next_key()
+    return _op(lambda a: jax.random.poisson(key, a).astype(a.dtype), x,
+               name="poisson")
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype="float32", name=None):
+    key = random_mod.next_key()
+    return Tensor(jnp.exp(mean + std * jax.random.normal(
+        key, tuple(shape or ()), jnp.dtype(dtype))))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    xd = _d(x)
+    return Tensor(jax.random.randint(
+        key, xd.shape, low, high).astype(jnp.dtype(dtype) if dtype
+                                         else xd.dtype))
+
+
+# ------------------------- shape / stacking ----------------------------------
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def hstack(x, name=None):
+    return _op(lambda *xs: jnp.hstack(xs), *x, name="hstack")
+
+
+def vstack(x, name=None):
+    return _op(lambda *xs: jnp.vstack(xs), *x, name="vstack")
+
+
+def dstack(x, name=None):
+    return _op(lambda *xs: jnp.dstack(xs), *x, name="dstack")
+
+
+def column_stack(x, name=None):
+    return _op(lambda *xs: jnp.column_stack(xs), *x, name="column_stack")
+
+
+row_stack = vstack
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=axis)) \
+            if isinstance(num_or_indices, int) else \
+            tuple(jnp.split(a, list(num_or_indices), axis=axis))
+    return list(_op(f, x, name="tensor_split"))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if _d(x).ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or _d(x).shape[axis]
+    def f(a):
+        return tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n))
+    return list(_op(f, x, name="unstack"))
+
+
+def unflatten(x, axis, shape, name=None):
+    def f(a):
+        new = list(a.shape[:axis % a.ndim]) + list(shape) + \
+            list(a.shape[axis % a.ndim + 1:])
+        return a.reshape(new)
+    return _op(f, x, name="unflatten")
+
+
+def view_as(x, other, name=None):
+    return _op(lambda a, b: a.reshape(b.shape), x, other, name="view_as")
+
+
+def reverse(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return _op(lambda a: jnp.flip(a, axis=tuple(axes)), x, name="reverse")
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, name=None):
+    xv = np.asarray(jax.device_get(_d(x)))
+    flat = xv.reshape(-1) if axis is None else xv
+    keep = np.ones(len(flat), bool)
+    keep[1:] = flat[1:] != flat[:-1]
+    out = flat[keep]
+    results = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(flat)))
+        results.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+# ----------------------- construction helpers --------------------------------
+
+def block_diag(inputs, name=None):
+    return _op(lambda *xs: jax.scipy.linalg.block_diag(*xs), *inputs,
+               name="block_diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return _op(lambda a: jnp.diagflat(a, k=offset), x, name="diagflat")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        # place the two new axes at dim1/dim2
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+    return _op(f, input, name="diag_embed")
+
+
+def logspace(start, stop, num, base=10.0, dtype="float32", name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=jnp.dtype(dtype)))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return _op(lambda a: jnp.vander(a, N=n, increasing=increasing), x,
+               name="vander")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def cartesian_prod(x, name=None):
+    if len(x) == 1:  # ref: tensor/math.py cartesian_prod
+        return x[0] if isinstance(x[0], Tensor) else Tensor(_d(x[0]))
+
+    def f(*xs):
+        grids = jnp.meshgrid(*xs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], -1)
+    return _op(f, *x, name="cartesian_prod")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    n = _d(x).shape[0]
+    combo = itertools.combinations_with_replacement if with_replacement \
+        else itertools.combinations
+    idx = np.asarray(list(combo(range(n), r)), np.int64)
+    if idx.size == 0:
+        idx = idx.reshape(0, r)
+    return _op(lambda a: a[jnp.asarray(idx)], x, name="combinations")
+
+
+# ------------------------- scatter-style updates -----------------------------
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return a.at[tuple(idx)].set(v)
+    return _op(f, x, value, name="slice_scatter")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v)
+    return _op(f, x, values, name="select_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, v):
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        a2 = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        out = a2.at[..., r, c].set(v)
+        return jnp.moveaxis(out, (-2, -1), (axis1, axis2))
+    return _op(f, x, y, name="diagonal_scatter")
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, i):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].set(value)
+    return _op(f, x, index, name="index_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    xv = np.asarray(jax.device_get(_d(x))).copy()
+    mv = np.asarray(jax.device_get(_d(mask)))
+    vv = np.asarray(jax.device_get(_d(value))).reshape(-1)
+    mv = np.broadcast_to(mv, xv.shape)
+    n = int(mv.sum())
+    xv[mv] = vv[:n]
+    return Tensor(jnp.asarray(xv))
+
+
+def multiplex(inputs, index, name=None):
+    def f(i, *xs):
+        stacked = jnp.stack(xs)                      # [K, B, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[i.reshape(-1), rows]
+    return _op(f, index, *inputs, name="multiplex")
+
+
+def take(x, index, mode="raise", name=None):
+    if mode == "raise":
+        # eager bounds check on the concrete indices preserves the
+        # reference's error contract (indices under jit can't raise)
+        iv = np.asarray(jax.device_get(_d(index)))
+        n = _d(x).size
+        if iv.size and (iv.min() < -n or iv.max() >= n):
+            raise ValueError(
+                f"take index out of range for tensor of {n} elements")
+        jmode = "clip"
+    else:
+        jmode = {"clip": "clip", "wrap": "wrap"}[mode]
+    return _op(lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1),
+                                     mode=jmode).reshape(i.shape),
+               x, index, name="take")
+
+
+# ----------------------------- histograms ------------------------------------
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    xv = np.asarray(jax.device_get(_d(input))).reshape(-1)
+    lo, hi = (min, max) if (min != 0 or max != 0) else \
+        (float(xv.min()) if xv.size else 0.0,
+         float(xv.max()) if xv.size else 1.0)
+    wv = np.asarray(jax.device_get(_d(weight))).reshape(-1) \
+        if weight is not None else None
+    h, _ = np.histogram(xv, bins=bins, range=(lo, hi), weights=wv,
+                        density=density)
+    return Tensor(jnp.asarray(h if density or weight is not None
+                              else h.astype(np.int64)))
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    xv = np.asarray(jax.device_get(_d(input))).reshape(-1)
+    rng = (min, max) if (min != 0 or max != 0) else None
+    return Tensor(jnp.asarray(
+        np.histogram_bin_edges(xv, bins=bins, range=rng)
+        .astype(np.float32)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    xv = np.asarray(jax.device_get(_d(x)))
+    wv = np.asarray(jax.device_get(_d(weights))) \
+        if weights is not None else None
+    h, edges = np.histogramdd(xv, bins=bins, range=ranges,
+                              density=density, weights=wv)
+    return (Tensor(jnp.asarray(h.astype(np.float32))),
+            [Tensor(jnp.asarray(e.astype(np.float32))) for e in edges])
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    def f(a, s):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(s, a, side=side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return _op(f, x, sorted_sequence, name="bucketize")
+
+
+# ------------------------------ distances ------------------------------------
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+    return _op(f, x, y, name="cdist")
+
+
+def pdist(x, p=2.0, name=None):
+    n = _d(x).shape[0]
+    r, c = np.triu_indices(n, 1)
+    def f(a):
+        diff = a[jnp.asarray(r)] - a[jnp.asarray(c)]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+    return _op(f, x, name="pdist")
+
+
+# ------------------------------ bit ops --------------------------------------
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return _op(jnp.left_shift, x, y, name="bitwise_left_shift")
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    if is_arithmetic:
+        return _op(jnp.right_shift, x, y, name="bitwise_right_shift")
+
+    def f(a, b):
+        # logical shift: reinterpret in the unsigned dtype of the SAME
+        # width (uint32 for everything would sign-extend int8/16 and
+        # truncate int64)
+        ud = jnp.dtype(f"uint{a.dtype.itemsize * 8}")
+        ua = a.astype(ud)
+        return jax.lax.shift_right_logical(
+            ua, b.astype(ud)).astype(a.dtype)
+    return _op(f, x, y, name="bitwise_right_shift")

@@ -1,0 +1,150 @@
+"""Inplace op variants (`op_`) and remaining top-level API odds and ends.
+
+ref: python/paddle/tensor/math.py et al. define `op_` siblings that write
+the result into the input tensor. Tensors here wrap immutable jax.Arrays,
+so "inplace" = compute functionally, then swap the wrapper's buffer — the
+same user-visible contract (the reference's inplace ops likewise break
+gradient history unless whitelisted).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__: List[str] = []  # populated by _install()
+
+# top-level functional name -> inplace method/function name
+_INPLACE_UNARY = [
+    "abs", "acos", "asin", "atan", "atanh", "ceil", "cos", "cosh", "erf",
+    "exp", "expm1", "floor", "lgamma", "log", "log10", "log1p", "log2",
+    "neg", "reciprocal", "round", "rsqrt", "sigmoid", "sin", "sinh",
+    "sqrt", "square", "tan", "tanh", "trunc", "digamma", "frac", "i0",
+    "sinc", "logit",
+]
+_INPLACE_BINARY = [
+    "add", "subtract", "multiply", "divide", "remainder", "mod",
+    "floor_divide", "floor_mod", "pow", "maximum", "minimum",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "less_than", "less_equal", "greater_than", "greater_equal", "equal",
+    "hypot", "copysign", "ldexp", "gcd", "lcm",
+    "bitwise_left_shift", "bitwise_right_shift",
+]
+_INPLACE_OTHER = [
+    "clip", "scale", "cumsum", "cumprod", "flatten", "squeeze",
+    "unsqueeze", "transpose", "tril", "triu", "cast", "lerp",
+    "index_add", "index_put", "index_fill", "masked_fill",
+    "masked_scatter", "scatter", "nan_to_num", "renorm", "polygamma",
+    "gammainc", "gammaincc", "gammaln", "multigammaln", "t",
+]
+
+
+def _functional(name):
+    from .. import ops as _ops
+    return getattr(_ops, name, None)
+
+
+def _make_inplace(fname):
+    fn = _functional(fname)
+    if fn is None:
+        return None
+
+    def inplace(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._data = out._data if isinstance(out, Tensor) else out
+        return self
+
+    inplace.__name__ = fname + "_"
+    inplace.__doc__ = (f"Inplace variant of paddle.{fname} "
+                       f"(ref: tensor/*.py {fname}_)")
+    return inplace
+
+
+def _install():
+    import paddle_tpu as _p
+
+    installed = []
+    for fname in _INPLACE_UNARY + _INPLACE_BINARY + _INPLACE_OTHER:
+        if hasattr(Tensor, fname + "_"):
+            installed.append(fname + "_")
+            continue
+        method = _make_inplace(fname)
+        if method is None:
+            continue
+        setattr(Tensor, fname + "_", method)
+
+        # top-level paddle.op_(x, ...) form mirrors the method
+        def _toplevel(x, *args, _m=fname + "_", **kwargs):
+            return getattr(x, _m)(*args, **kwargs)
+        _toplevel.__name__ = fname + "_"
+        setattr(_p, fname + "_", _toplevel)
+        installed.append(fname + "_")
+
+    # random inplace fills (ref: tensor/random.py)
+    from ..core import random as random_mod
+    import jax
+
+    def normal_(self, mean=0.0, std=1.0):
+        key = random_mod.next_key()
+        self._data = (mean + std * jax.random.normal(
+            key, self._data.shape)).astype(self._data.dtype)
+        return self
+
+    def bernoulli_(self, p=0.5):
+        key = random_mod.next_key()
+        self._data = jax.random.bernoulli(
+            key, p, self._data.shape).astype(self._data.dtype)
+        return self
+
+    def cauchy_(self, loc=0, scale=1):
+        key = random_mod.next_key()
+        self._data = (loc + scale * jax.random.cauchy(
+            key, self._data.shape)).astype(self._data.dtype)
+        return self
+
+    def geometric_(self, probs):
+        # continuous form, matching the reference's
+        # uniform_().log_().divide_(log1p(-probs)) chain
+        # (ref: tensor/creation.py:3225)
+        key = random_mod.next_key()
+        u = jax.random.uniform(key, self._data.shape, minval=1e-7,
+                               maxval=1.0)
+        self._data = (jnp.log(u) / jnp.log1p(-probs)) \
+            .astype(self._data.dtype)
+        return self
+
+    def log_normal_(self, mean=1.0, std=2.0):
+        key = random_mod.next_key()
+        self._data = jnp.exp(mean + std * jax.random.normal(
+            key, self._data.shape)).astype(self._data.dtype)
+        return self
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):
+        key = random_mod.next_key()
+        self._data = jax.random.uniform(
+            key, self._data.shape, minval=min,
+            maxval=max).astype(self._data.dtype)
+        return self
+
+    def exponential_(self, lam=1.0):
+        key = random_mod.next_key()
+        self._data = (jax.random.exponential(key, self._data.shape)
+                      / lam).astype(self._data.dtype)
+        return self
+
+    for fn in (normal_, bernoulli_, cauchy_, geometric_, log_normal_,
+               uniform_, exponential_):
+        setattr(Tensor, fn.__name__, fn)
+        installed.append(fn.__name__)
+
+    if not hasattr(Tensor, "tolist"):
+        Tensor.tolist = lambda self: np.asarray(self.numpy()).tolist()
+
+    __all__.extend(installed)
+
+
+_install()

@@ -1,0 +1,187 @@
+"""Numeric tests for the long-tail op surface + inplace variants
+(ref: python/paddle/__init__.py __all__ parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestExtraMath:
+    def test_addmm(self):
+        i = np.ones((2, 2), np.float32)
+        a = np.array([[1., 2.], [3., 4.]], np.float32)
+        b = np.eye(2, dtype=np.float32)
+        out = paddle.addmm(_t(i), _t(a), _t(b), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(out.numpy(), 0.5 * i + 2.0 * a)
+
+    def test_logit_logcumsumexp(self):
+        x = np.array([0.2, 0.5, 0.8], np.float32)
+        np.testing.assert_allclose(paddle.logit(_t(x)).numpy(),
+                                   np.log(x / (1 - x)), rtol=1e-5)
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        want = np.log(np.cumsum(np.exp(y)))
+        np.testing.assert_allclose(paddle.logcumsumexp(_t(y)).numpy(),
+                                   want, rtol=1e-5)
+
+    def test_special_functions(self):
+        x = np.array([0.5, 1.5, 3.0], np.float32)
+        from scipy import special as sp
+        np.testing.assert_allclose(paddle.gammaln(_t(x)).numpy(),
+                                   sp.gammaln(x), rtol=1e-4)
+        np.testing.assert_allclose(paddle.i0(_t(x)).numpy(), sp.i0(x),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.multigammaln(_t(x + 2), 2).numpy(),
+            sp.multigammaln(x + 2, 2), rtol=1e-4)
+
+    def test_number_theory_and_angles(self):
+        a = np.array([12, 18], np.int32)
+        b = np.array([8, 27], np.int32)
+        np.testing.assert_array_equal(paddle.gcd(_t(a), _t(b)).numpy(),
+                                      np.gcd(a, b))
+        np.testing.assert_array_equal(paddle.lcm(_t(a), _t(b)).numpy(),
+                                      np.lcm(a, b))
+        d = np.array([0.0, 90.0, 180.0], np.float32)
+        np.testing.assert_allclose(paddle.deg2rad(_t(d)).numpy(),
+                                   np.deg2rad(d), rtol=1e-6)
+
+    def test_nan_to_num_heaviside_sgn(self):
+        x = np.array([np.nan, np.inf, -np.inf, 2.0], np.float32)
+        out = paddle.nan_to_num(_t(x), nan=0.0, posinf=9.0, neginf=-9.0)
+        np.testing.assert_allclose(out.numpy(), [0.0, 9.0, -9.0, 2.0])
+        h = paddle.heaviside(_t(np.array([-1.0, 0.0, 2.0], np.float32)),
+                             _t(np.array([0.5], np.float32)))
+        np.testing.assert_allclose(h.numpy(), [0.0, 0.5, 1.0])
+        np.testing.assert_allclose(
+            paddle.sgn(_t(np.array([-3.0, 0.0, 5.0], np.float32))).numpy(),
+            [-1.0, 0.0, 1.0])
+
+    def test_quantile_and_histogram(self):
+        x = np.arange(10, dtype=np.float32)
+        np.testing.assert_allclose(
+            paddle.quantile(_t(x), 0.5).numpy(), np.quantile(x, 0.5))
+        h = paddle.histogram(_t(x), bins=5, min=0, max=10)
+        np.testing.assert_array_equal(h.numpy(), [2, 2, 2, 2, 2])
+        hh, edges = paddle.histogramdd(_t(x[:, None]), bins=2)
+        assert hh.numpy().sum() == 10
+
+    def test_cdist_pdist(self):
+        a = np.array([[0.0, 0.0], [3.0, 4.0]], np.float32)
+        b = np.array([[0.0, 0.0]], np.float32)
+        np.testing.assert_allclose(paddle.cdist(_t(a), _t(b)).numpy(),
+                                   [[0.0], [5.0]], atol=1e-4)
+        np.testing.assert_allclose(paddle.pdist(_t(a)).numpy(), [5.0],
+                                   atol=1e-4)
+
+    def test_stacking_and_splits(self):
+        a, b = np.ones((2, 3), np.float32), np.zeros((2, 3), np.float32)
+        assert paddle.hstack([_t(a), _t(b)]).shape == [2, 6]
+        assert paddle.vstack([_t(a), _t(b)]).shape == [4, 3]
+        assert paddle.dstack([_t(a), _t(b)]).shape == [2, 3, 2]
+        parts = paddle.tensor_split(_t(np.arange(9)), 3)
+        assert [p_.shape for p_ in parts] == [[3], [3], [3]]
+        outs = paddle.unstack(_t(a), axis=0)
+        assert len(outs) == 2 and outs[0].shape == [3]
+
+    def test_construction(self):
+        bd = paddle.block_diag([_t(np.ones((2, 2), np.float32)),
+                                _t(np.full((1, 1), 3.0, np.float32))])
+        assert bd.shape == [3, 3] and bd.numpy()[2, 2] == 3.0
+        v = paddle.vander(_t(np.array([1.0, 2.0, 3.0], np.float32)), 3)
+        np.testing.assert_allclose(v.numpy()[:, -1], [1, 1, 1])
+        de = paddle.diag_embed(_t(np.array([[1.0, 2.0]], np.float32)))
+        assert de.shape == [1, 2, 2]
+        np.testing.assert_allclose(de.numpy()[0],
+                                   np.diag([1.0, 2.0]))
+        ti = paddle.tril_indices(3, 3, 0)
+        assert ti.shape == [2, 6]
+
+    def test_scatter_family(self):
+        x = np.zeros((3, 4), np.float32)
+        out = paddle.slice_scatter(_t(x),
+                                   _t(np.ones((3, 2), np.float32)),
+                                   axes=[1], starts=[1], ends=[3],
+                                   strides=[1])
+        np.testing.assert_allclose(out.numpy()[:, 1:3], 1.0)
+        out2 = paddle.select_scatter(_t(x),
+                                     _t(np.full((4,), 7.0, np.float32)),
+                                     axis=0, index=1)
+        np.testing.assert_allclose(out2.numpy()[1], 7.0)
+        out3 = paddle.index_fill(_t(x), _t(np.array([0, 2])), 0, 5.0)
+        np.testing.assert_allclose(out3.numpy()[[0, 2]], 5.0)
+
+    def test_isin_bucketize_take(self):
+        x = np.array([1, 3, 5], np.int64)
+        out = paddle.isin(_t(x), _t(np.array([3, 5], np.int64)))
+        np.testing.assert_array_equal(out.numpy(), [False, True, True])
+        edges = np.array([2.0, 4.0], np.float32)
+        b = paddle.bucketize(_t(np.array([1.0, 3.0, 9.0], np.float32)),
+                             _t(edges))
+        np.testing.assert_array_equal(b.numpy(), [0, 1, 2])
+        t = paddle.take(_t(np.arange(6).reshape(2, 3)),
+                        _t(np.array([[0, 5]])))
+        np.testing.assert_array_equal(t.numpy(), [[0, 5]])
+
+    def test_complex_helpers(self):
+        r = np.array([1.0, 0.0], np.float32)
+        i = np.array([0.0, 1.0], np.float32)
+        c = paddle.complex(_t(r), _t(i))
+        assert paddle.is_complex(c)
+        back = paddle.as_real(c)
+        np.testing.assert_allclose(back.numpy(), np.stack([r, i], -1))
+        pol = paddle.polar(_t(np.array([1.0], np.float32)),
+                           _t(np.array([np.pi / 2], np.float32)))
+        np.testing.assert_allclose(pol.numpy().imag, [1.0], atol=1e-6)
+
+    def test_unique_consecutive(self):
+        x = np.array([1, 1, 2, 2, 2, 3, 1], np.int64)
+        out, inv, counts = paddle.unique_consecutive(
+            _t(x), return_inverse=True, return_counts=True)
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3, 1])
+        np.testing.assert_array_equal(counts.numpy(), [2, 3, 1, 1])
+
+    def test_grad_flows_through_extra_ops(self):
+        x = paddle.to_tensor(np.array([0.3, 0.6], np.float32),
+                             stop_gradient=False)
+        out = paddle.logit(x).sum()
+        out.backward()
+        want = 1 / (np.array([0.3, 0.6]) * (1 - np.array([0.3, 0.6])))
+        np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-4)
+
+
+class TestInplaceVariants:
+    def test_unary_inplace(self):
+        t = _t(np.array([1.0, 4.0, 9.0], np.float32))
+        r = t.sqrt_()
+        assert r is t
+        np.testing.assert_allclose(t.numpy(), [1.0, 2.0, 3.0])
+
+    def test_binary_inplace_and_toplevel(self):
+        t = _t(np.array([2.0, 3.0], np.float32))
+        t.add_(_t(np.array([1.0, 1.0], np.float32)))
+        np.testing.assert_allclose(t.numpy(), [3.0, 4.0])
+        paddle.multiply_(t, _t(np.array([2.0, 2.0], np.float32)))
+        np.testing.assert_allclose(t.numpy(), [6.0, 8.0])
+
+    def test_random_inplace(self):
+        paddle.seed(0)
+        t = _t(np.zeros((128,), np.float32))
+        t.normal_(mean=5.0, std=0.1)
+        assert abs(float(t.numpy().mean()) - 5.0) < 0.1
+        t2 = _t(np.zeros((64,), np.float32))
+        t2.uniform_(0.0, 1.0)
+        assert 0.0 <= t2.numpy().min() and t2.numpy().max() <= 1.0
+
+    def test_misc_top_level(self):
+        assert paddle.iinfo("int8").max == 127
+        assert paddle.finfo("bfloat16").bits == 16
+        p_ = paddle.create_parameter([3, 3])
+        assert not p_.stop_gradient
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        assert paddle.binomial(_t(np.array([10.0], np.float32)),
+                               _t(np.array([0.5], np.float32))
+                               ).numpy()[0] <= 10
