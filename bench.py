@@ -1,9 +1,13 @@
 """Headline benchmark: Llama causal-LM training tokens/sec/chip.
 
-Runs a ~375M-param Llama (Llama-2 geometry scaled to one v5e chip's HBM)
-in bf16 with fp32 AdamW state through the compiled whole-train-step path
+Runs a ~1.17B-param Llama (Llama-2 geometry scaled to one v5e chip's HBM)
+in bf16 with bf16 AdamW state through the compiled whole-train-step path
 (paddle_tpu.distributed.dist_train.DistTrainStep: fwd + bwd + optimizer in
 one XLA executable, attention on the Pallas flash kernel).
+
+MFU uses the standard 6*N_params FLOPs/token estimate, which EXCLUDES
+attention score FLOPs (~12*L*h*s extra per token) — the reported MFU is
+therefore conservative by a few percent at seq 2048.
 
 vs_baseline: the reference publishes no numbers (BASELINE.md); the agreed
 bar is "A100+NCCL MFU" for Llama-class training, for which well-tuned

@@ -317,17 +317,27 @@ class TCPStoreServer {
         cv_.notify_all();
         uint8_t ack = 1;
         if (!SendAll(fd, &ack, 1)) break;
-      } else if (op == 'G' || op == 'W') {  // get / wait-get
+      } else if (op == 'G' || op == 'W' || op == 'T') {
+        // get / wait-get / take (wait-get-delete, atomic — backs the p2p
+        // channel transport so consumed messages don't accumulate)
         std::unique_lock<std::mutex> lk(mu_);
-        if (op == 'W')
+        if (op == 'W' || op == 'T')
           cv_.wait(lk, [&] { return kv_.count(key) || !running_; });
         uint8_t found = kv_.count(key) ? 1 : 0;
         std::string val = found ? kv_[key] : std::string();
+        if (op == 'T' && found) kv_.erase(key);
         lk.unlock();
         uint32_t vlen = static_cast<uint32_t>(val.size());
         if (!SendAll(fd, &found, 1)) break;
         if (!SendAll(fd, &vlen, 4)) break;
         if (vlen && !SendAll(fd, val.data(), vlen)) break;
+      } else if (op == 'D') {  // delete key (fire-and-ack)
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          kv_.erase(key);
+        }
+        uint8_t ack = 1;
+        if (!SendAll(fd, &ack, 1)) break;
       } else if (op == 'A') {  // add (atomic counter), value = i64 delta
         int64_t delta;
         uint32_t vlen;
@@ -406,11 +416,12 @@ class TCPStoreClient {
   }
 
   // returns false on transport error; *found distinguishes a missing key
-  // from a key holding an empty value
-  bool Get(const std::string& key, bool wait, std::string* out,
+  // from a key holding an empty value. mode: 'G' get, 'W' wait-get,
+  // 'T' take (wait-get-delete, atomic)
+  bool Get(const std::string& key, char mode, std::string* out,
            bool* found) {
     std::lock_guard<std::mutex> g(mu_);
-    uint8_t op = wait ? 'W' : 'G';
+    uint8_t op = static_cast<uint8_t>(mode);
     uint32_t klen = key.size();
     if (!SendAll(fd_, &op, 1) || !SendAll(fd_, &klen, 4) ||
         !SendAll(fd_, key.data(), klen))
@@ -422,6 +433,17 @@ class TCPStoreClient {
     if (!RecvAll(fd_, &vlen, 4)) return false;
     out->assign(vlen, '\0');
     return vlen == 0 || RecvAll(fd_, &(*out)[0], vlen);
+  }
+
+  bool Delete(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = 'D';
+    uint32_t klen = key.size();
+    if (!SendAll(fd_, &op, 1) || !SendAll(fd_, &klen, 4) ||
+        !SendAll(fd_, key.data(), klen))
+      return false;
+    uint8_t ack;
+    return RecvAll(fd_, &ack, 1) && ack == 1;
   }
 
   bool Add(const std::string& key, int64_t delta, int64_t* result) {
@@ -810,7 +832,7 @@ static PyObject* py_store_get(PyObject*, PyObject* args) {
   if (!c) return nullptr;
   std::string out;
   bool ok, found = false;
-  Py_BEGIN_ALLOW_THREADS ok = c->Get(key, wait != 0, &out, &found);
+  Py_BEGIN_ALLOW_THREADS ok = c->Get(key, wait ? 'W' : 'G', &out, &found);
   Py_END_ALLOW_THREADS
   if (!ok) {
     PyErr_SetString(PyExc_ConnectionError, "TCPStore get failed");
@@ -818,6 +840,41 @@ static PyObject* py_store_get(PyObject*, PyObject* args) {
   }
   if (!found) Py_RETURN_NONE;
   return PyBytes_FromStringAndSize(out.data(), out.size());
+}
+
+static PyObject* py_store_take(PyObject*, PyObject* args) {
+  // wait-get-delete (atomic): the channel primitive for eager p2p
+  PyObject* cap;
+  const char* key;
+  if (!PyArg_ParseTuple(args, "Os", &cap, &key)) return nullptr;
+  auto* c = GetClient(cap);
+  if (!c) return nullptr;
+  std::string out;
+  bool ok, found = false;
+  Py_BEGIN_ALLOW_THREADS ok = c->Get(key, 'T', &out, &found);
+  Py_END_ALLOW_THREADS
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "TCPStore take failed");
+    return nullptr;
+  }
+  if (!found) Py_RETURN_NONE;
+  return PyBytes_FromStringAndSize(out.data(), out.size());
+}
+
+static PyObject* py_store_delete(PyObject*, PyObject* args) {
+  PyObject* cap;
+  const char* key;
+  if (!PyArg_ParseTuple(args, "Os", &cap, &key)) return nullptr;
+  auto* c = GetClient(cap);
+  if (!c) return nullptr;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS ok = c->Delete(key);
+  Py_END_ALLOW_THREADS
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "TCPStore delete failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
 }
 
 static PyObject* py_store_add(PyObject*, PyObject* args) {
@@ -872,6 +929,9 @@ static PyMethodDef Methods[] = {
      "connect TCPStore client"},
     {"store_set", py_store_set, METH_VARARGS, "set key"},
     {"store_get", py_store_get, METH_VARARGS, "get key (optionally wait)"},
+    {"store_take", py_store_take, METH_VARARGS,
+     "wait-get-delete a key (atomic take)"},
+    {"store_delete", py_store_delete, METH_VARARGS, "delete key"},
     {"store_add", py_store_add, METH_VARARGS, "atomic add"},
     {"op_register", py_op_register, METH_VARARGS, "register op descriptor"},
     {"op_lookup", py_op_lookup, METH_VARARGS, "lookup op descriptor"},

@@ -23,7 +23,7 @@ import numpy as np
 
 __all__ = [
     "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
-    "GradNode", "apply_op", "backward", "grad",
+    "GradNode", "apply_op", "backward", "grad", "flush_nan_checks",
 ]
 
 
@@ -89,13 +89,23 @@ class GradNode:
     ref-analog: paddle/fluid/eager/grad_node_info.h GradNodeBase + Edge.
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "name", "__weakref__")
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "name", "fn", "datas",
+                 "kwargs", "diff_idx", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_avals, name):
+    def __init__(self, vjp_fn, inputs, out_avals, name, fn=None, datas=None,
+                 kwargs=None, diff_idx=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # tuple of differentiable input Tensors
         self.out_avals = out_avals    # ShapeDtypeStruct per output
         self.name = name
+        # Retained for create_graph=True: re-running the op's forward under
+        # the tape makes the backward step differentiable w.r.t. primals too
+        # (the vjp closure alone only captures the linear cotangent part).
+        # ref-analog: eager/backward.cc:439 general_grad (grad-of-grad).
+        self.fn = fn
+        self.datas = datas            # full positional arg list (raw arrays)
+        self.kwargs = kwargs
+        self.diff_idx = diff_idx
 
     def __repr__(self):
         return f"GradNode({self.name})"
@@ -111,6 +121,29 @@ def _is_diff_dtype(x) -> bool:
     return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
 
 
+# Pending device-side NaN flags: (op_name, out_index, 0-d bool jax.Array).
+# Computing `any(~isfinite)` is an async device op; only the *fetch* blocks.
+# Batching the fetch every FLAGS_check_nan_inf_stride ops turns N host
+# round-trips into one (critical over a ~100ms-RTT tunnel) while keeping
+# exact (op, output) attribution on failure.
+_nan_pending: List[Tuple[str, int, Any]] = []
+
+
+def flush_nan_checks() -> None:
+    """Fetch all pending NaN flags in one host sync; raise naming the first
+    offending op. Called on stride overflow and at backward() boundaries."""
+    global _nan_pending
+    if not _nan_pending:
+        return
+    pending, _nan_pending = _nan_pending, []
+    flags = np.asarray(jnp.stack([f for _, _, f in pending]))  # one fetch
+    if flags.any():
+        name, i, _ = pending[int(np.argmax(flags))]
+        raise FloatingPointError(
+            f"Operator {name} output {i} contains NaN or Inf "
+            f"(FLAGS_check_nan_inf is set)")
+
+
 def _maybe_check_nan_inf(name: str, outs) -> None:
     """FLAGS_check_nan_inf per-op scan (ref: eager/nan_inf_utils.h:38 —
     CheckTensorHasNanOrInf after each ad_func). Only active in eager mode
@@ -119,14 +152,21 @@ def _maybe_check_nan_inf(name: str, outs) -> None:
     from .flags import flag_value
     if not flag_value("check_nan_inf"):
         return
+    stride = max(int(flag_value("check_nan_inf_stride") or 1), 1)
     for i, o in enumerate(outs):
         if isinstance(o, jax.core.Tracer):
             return  # inside jit trace, skip (dygraph-only check)
         if isinstance(o, jax.Array) and jnp.issubdtype(o.dtype, jnp.inexact):
-            if bool(jnp.any(~jnp.isfinite(o))):
-                raise FloatingPointError(
-                    f"Operator {name} output {i} contains NaN or Inf "
-                    f"(FLAGS_check_nan_inf is set)")
+            flag = jnp.any(~jnp.isfinite(o))  # device op, no host sync
+            if stride <= 1:
+                if bool(flag):
+                    raise FloatingPointError(
+                        f"Operator {name} output {i} contains NaN or Inf "
+                        f"(FLAGS_check_nan_inf is set)")
+            else:
+                _nan_pending.append((name, i, flag))
+    if len(_nan_pending) >= stride:
+        flush_nan_checks()
 
 
 # When paddle_tpu.static is recording (enable_static / program_guard), this
@@ -187,7 +227,8 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     _maybe_check_nan_inf(name, outs)
 
     out_avals = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs)
-    node = GradNode(vjp_fn, tuple(args[i] for i in diff_idx), out_avals, name)
+    node = GradNode(vjp_fn, tuple(args[i] for i in diff_idx), out_avals, name,
+                    fn=fn, datas=datas, kwargs=kwargs, diff_idx=diff_idx)
 
     wrapped = tuple(
         Tensor(o, stop_gradient=False, node=node, out_index=k)
@@ -233,14 +274,53 @@ def _topo_order(root_node: GradNode) -> List[GradNode]:
     return order
 
 
+def _node_backward_taped(node: GradNode, ct_tensors):
+    """Run one node's backward step *through the tape* so the produced grads
+    are themselves differentiable (w.r.t. both the node's primal inputs and
+    the incoming cotangents). Used by create_graph=True.
+    ref-analog: eager/backward.cc:439 general_grad."""
+    nprim = len(node.diff_idx)
+
+    def node_grad_fn(*flat):
+        primals, cts = flat[:nprim], flat[nprim:]
+
+        def f(*ps):
+            call = list(node.datas)
+            for i, p in zip(node.diff_idx, ps):
+                call[i] = p
+            res = node.fn(*call, **node.kwargs)
+            return tuple(res) if isinstance(res, (tuple, list)) else (res,)
+
+        _, vjp = jax.vjp(f, *primals)
+        return tuple(vjp(tuple(cts)))
+
+    out = apply_op(node_grad_fn, *node.inputs, *ct_tensors,
+                   op_name=node.name + "_grad")
+    return out if isinstance(out, tuple) else (out,)
+
+
 def _run_backward(roots, root_grads, accumulate_into_grad: bool,
-                  wanted: Optional[Sequence] = None):
+                  wanted: Optional[Sequence] = None,
+                  create_graph: bool = False):
     """Core backward walk shared by Tensor.backward() and paddle.grad().
 
     ref-analog: eager/backward.cc RunBackward — queue-based topological walk
     routing grads along edges into GradTensorHolder accumulators.
+
+    With ``create_graph=True`` cotangents travel as Tensors and every
+    backward step is recorded via apply_op, so returned grads compose for
+    grad-of-grad.
     """
     from .tensor import Tensor
+
+    def _add(a, b):
+        if create_graph and (isinstance(a, Tensor) or isinstance(b, Tensor)):
+            return apply_op(lambda x, y: x + y, _as_t(a), _as_t(b),
+                            op_name="grad_add")
+        return a + b
+
+    def _as_t(g):
+        return g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
 
     node_cts: Dict[int, List[Any]] = {}
     node_by_id: Dict[int, GradNode] = {}
@@ -250,7 +330,7 @@ def _run_backward(roots, root_grads, accumulate_into_grad: bool,
     def seed(node, idx, g):
         node_by_id[id(node)] = node
         cts = node_cts.setdefault(id(node), [None] * len(node.out_avals))
-        cts[idx] = g if cts[idx] is None else cts[idx] + g
+        cts[idx] = g if cts[idx] is None else _add(cts[idx], g)
 
     order: List[GradNode] = []
     seen = set()
@@ -276,21 +356,30 @@ def _run_backward(roots, root_grads, accumulate_into_grad: bool,
         cts = node_cts.get(id(node))
         if cts is None:
             continue  # unreachable from seeds
-        full = tuple(
-            _ensure_jnp(c, a) for c, a in zip(cts, node.out_avals))
-        in_grads = node.vjp_fn(full)
+        if create_graph:
+            full = tuple(
+                _as_t(_zeros_ct(a)) if c is None else _as_t(c)
+                for c, a in zip(cts, node.out_avals))
+            in_grads = _node_backward_taped(node, full)
+        else:
+            full = tuple(
+                _ensure_jnp(c, a) for c, a in zip(cts, node.out_avals))
+            in_grads = node.vjp_fn(full)
         for t, g in zip(node.inputs, in_grads):
-            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
-                continue
-            g = _apply_hooks(t, g)
+            if not create_graph:
+                if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                    continue
+                g = _apply_hooks(t, g)
+            elif t._hooks:
+                g = Tensor(_apply_hooks(t, g._data), stop_gradient=True)
             if t._node is not None:
                 seed(t._node, t._out_index, g)
                 if t._retain_grads or (wanted_ids and id(t) in wanted_ids):
                     _accumulate_leaf(t, g, accumulate_into_grad, results,
-                                     wanted_ids, force=True)
+                                     wanted_ids, force=True, add=_add)
             else:
                 _accumulate_leaf(t, g, accumulate_into_grad, results,
-                                 wanted_ids)
+                                 wanted_ids, add=_add)
         # free residuals as we go unless the caller wants to re-run
         node_cts.pop(id(node), None)
     return results
@@ -309,25 +398,30 @@ def _apply_hooks(t, g):
 
 
 def _accumulate_leaf(t, g, accumulate_into_grad, results, wanted_ids,
-                     force=False):
+                     force=False, add=None):
     from .tensor import Tensor
     is_wanted = wanted_ids is not None and id(t) in wanted_ids
     if wanted_ids is not None and not is_wanted and not force:
         return
     if is_wanted or force:
         prev = results.get(id(t))
-        results[id(t)] = g if prev is None else prev + g
+        if prev is None:
+            results[id(t)] = g
+        else:
+            results[id(t)] = add(prev, g) if add is not None else prev + g
     if accumulate_into_grad and not t.stop_gradient:
         # ref-analog: GradNodeAccumulation writing param.grad
+        gd = g._data if isinstance(g, Tensor) else g
         if t.grad is None:
-            t.grad = Tensor(g, stop_gradient=True)
+            t.grad = Tensor(gd, stop_gradient=True)
         else:
-            t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+            t.grad = Tensor(t.grad._data + gd, stop_gradient=True)
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
     """paddle.autograd.backward. ref: python/paddle/autograd/autograd.py"""
     from .tensor import Tensor
+    flush_nan_checks()  # drain forward-pass flags before walking the tape
     if isinstance(tensors, Tensor):
         tensors = [tensors]
     if grad_tensors is None:
@@ -352,16 +446,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          no_grad_vars=None):
     """Functional gradient API. ref: python/paddle/base/dygraph/base.py grad
 
-    create_graph is not yet supported on the eager tape (the returned grads
-    are detached); use paddle_tpu.autograd.jacobian/hessian or jax.grad over
-    a functionalized program for higher-order derivatives.
+    With ``create_graph=True`` the backward pass is itself recorded on the
+    tape (each grad step re-runs the op's forward under jax.vjp via
+    apply_op), so the returned grads compose for grad-of-grad.
+    ref: paddle/fluid/eager/backward.cc:439 general_grad.
     """
     from .tensor import Tensor
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported by the eager tape; use "
-            "paddle_tpu.autograd.{jacobian,hessian,vjp} for higher-order "
-            "gradients (they compose jax.vjp/jax.jacobian directly).")
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
@@ -374,11 +464,13 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     for t, g in zip(outputs, grad_outputs):
         if g is None:
             g = jnp.ones(t.shape, t.dtype)
+        elif isinstance(g, Tensor):
+            g = g if create_graph else g._data
         else:
-            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+            g = jnp.asarray(g)
         seeds.append(g)
     results = _run_backward(outputs, seeds, accumulate_into_grad=False,
-                            wanted=inputs)
+                            wanted=inputs, create_graph=create_graph)
     out = []
     for t in inputs:
         g = results.get(id(t))
@@ -388,6 +480,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "One of the differentiated tensors appears unused; "
                     "pass allow_unused=True to return None for it")
             out.append(None)
+        elif isinstance(g, Tensor):
+            out.append(g)
         else:
-            out.append(Tensor(g, stop_gradient=True))
+            out.append(Tensor(g, stop_gradient=create_graph is False))
     return out

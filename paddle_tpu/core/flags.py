@@ -93,6 +93,11 @@ def flag_value(name: str):
 
 # Core flags (subset of the reference's ~180; ref: paddle/common/flags.cc)
 define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode")
+define_flag("check_nan_inf_stride", 1,
+            "Ops between host fetches of the batched NaN-check flags. "
+            "1 (default) = synchronous per-op raise, reference parity; "
+            ">1 amortizes the host sync (one fetch per stride ops; "
+            "essential over a high-RTT device link)")
 define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (no-op on TPU; XLA owns memory)")
 define_flag("use_bf16_matmul", True, "Prefer bfloat16 matmul accumulation defaults")
 define_flag("log_level", 0, "Framework verbosity")

@@ -15,9 +15,10 @@ from .api import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
-    all_gather_object, broadcast, reduce, scatter, alltoall,
-    alltoall_single, send, recv, isend, irecv, barrier, reduce_scatter,
-    stream,
+    all_gather_object, broadcast, broadcast_object_list, reduce, scatter,
+    scatter_object_list, alltoall, alltoall_single, send, recv, isend,
+    irecv, barrier, reduce_scatter, stream, P2POp, batch_isend_irecv,
+    get_backend, destroy_process_group, is_available,
 )
 from .parallel import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, is_initialized,

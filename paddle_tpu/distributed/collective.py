@@ -6,18 +6,26 @@ paddle/fluid/distributed/collective/process_group_nccl.cc. TPU-native design
 a side stream, each collective is a tiny cached XLA executable over the
 group's device mesh — the collective rides ICI inside the compiled program.
 
-Two operating regimes:
+Three operating regimes:
 - single-controller (default, incl. tests with 8 virtual CPU devices): one
   Python process drives all chips; "ranks" are devices. Eager collectives on
   replicated host values are identity-like (world through jit is the real
   path); collectives on device-sharded DistTensors run compiled psum etc.
-- multi-process (jax.distributed.initialize via launch CLI): rank ==
-  process_index, and the same compiled-collective cache spans hosts (DCN).
+- multi-process with a global jax runtime (jax.distributed.initialize):
+  compiled one-collective XLA executables span hosts (ICI/DCN).
+- multi-process without a global jax runtime (launch CLI on CPU, or eager
+  p2p/object exchange): a TCPStore channel transport
+  (ref: process_group_nccl.cc:834 + store/tcp_store.h:121 — the reference
+  likewise bootstraps every comm ring through its store). Tensors are
+  host-staged through the store; this is the correctness path — the
+  bandwidth path is always the compiled collective inside jit.
 """
 from __future__ import annotations
 
 import functools
-from typing import List, Optional
+import os
+import pickle
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,9 +35,11 @@ from ..core.tensor import Tensor
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
-    "all_gather_object", "broadcast", "reduce", "scatter", "alltoall",
-    "alltoall_single", "send", "recv", "isend", "irecv", "barrier",
-    "reduce_scatter", "stream",
+    "all_gather_object", "broadcast", "broadcast_object_list", "reduce",
+    "scatter", "scatter_object_list", "alltoall", "alltoall_single", "send",
+    "recv", "isend", "irecv", "barrier", "reduce_scatter", "stream",
+    "P2POp", "batch_isend_irecv", "get_backend", "destroy_process_group",
+    "is_available",
 ]
 
 
@@ -64,6 +74,10 @@ class Group:
         self.id = gid
         self.ranks = list(ranks)
         self.nranks = len(ranks)
+        # per-group collective sequence numbers (all members call group
+        # collectives in the same order, so local counters agree — the same
+        # invariant NCCL imposes on its rings)
+        self._seq: Dict[str, int] = {}
 
     @property
     def world_size(self):
@@ -91,11 +105,17 @@ _group_counter = 0
 
 
 def _global_rank() -> int:
-    return jax.process_index()
+    """Env-aware: launched CPU workers have jax.process_count()==1 but a
+    real rank from the launcher (PADDLE_TRAINER_ID)."""
+    if jax.process_count() > 1:
+        return jax.process_index()
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
 
 
 def _world_size() -> int:
-    return jax.process_count()
+    if jax.process_count() > 1:
+        return jax.process_count()
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
 
 
 def _ensure_default_group() -> Group:
@@ -127,6 +147,161 @@ def new_group(ranks: Optional[List[int]] = None, backend=None, timeout=None) -> 
 
 def _unwrap(t):
     return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _mode(g: Group) -> str:
+    """Pick the execution regime for a collective on group ``g``."""
+    if g.nranks <= 1:
+        return "local"
+    if jax.process_count() > 1:
+        return "compiled"
+    if _world_size() > 1:
+        return "store"
+    return "local"
+
+
+# -- TCPStore channel transport ----------------------------------------------
+# Host-staged tensor/object exchange for eager p2p and for collectives in
+# launched multi-process jobs that don't bring up a global jax runtime.
+# ref: the reference's ProcessGroup bootstraps every ring through its store
+# (process_group_nccl.cc CreateNCCLEnvCache); here the store IS the eager
+# transport — the fast path is always the compiled collective inside jit.
+
+_store = None
+
+
+def _comm_store():
+    global _store
+    if _store is None:
+        from .store import TCPStore
+        master = os.environ.get("PADDLE_MASTER",
+                                os.environ.get("MASTER_ADDR", ""))
+        if not master:
+            raise RuntimeError(
+                "cross-process eager collectives need PADDLE_MASTER "
+                "(set by paddle_tpu.distributed.launch)")
+        if ":" in master:
+            host, port = master.rsplit(":", 1)
+            port = int(port)
+        else:
+            host, port = master, int(os.environ.get("MASTER_PORT", "29500"))
+        # comm store lives next to the coordinator port
+        _store = TCPStore(host, port + 1, is_master=_global_rank() == 0,
+                          world_size=_world_size(),
+                          timeout=float(os.environ.get(
+                              "PADDLE_STORE_TIMEOUT", "120")))
+    return _store
+
+
+def _store_available() -> bool:
+    return _store is not None or bool(
+        os.environ.get("PADDLE_MASTER", os.environ.get("MASTER_ADDR", "")))
+
+
+def _allgather_bytes(g: Group, payload: bytes, tag: str) -> List[bytes]:
+    """Gather one bytes payload per rank. Uses the TCPStore when the
+    launcher env provides one; in a compiled multi-process regime without
+    a store (e.g. TPU auto-bootstrap), falls back to a size-exchange +
+    padded uint8 compiled all_gather."""
+    if _store_available():
+        st = _comm_store()
+        base = f"c{g.id}/{tag}/{_next_seq(g, tag)}"
+        st.set(f"{base}/{g.rank}", payload)
+        parts = [st.get(f"{base}/{i}") for i in range(g.nranks)]
+        if st.add(f"{base}/rc", 1) == g.nranks:
+            for i in range(g.nranks):
+                st.delete(f"{base}/{i}")
+            st.delete(f"{base}/rc")
+        return parts
+    buf = np.frombuffer(payload, dtype=np.uint8)
+    sizes = np.asarray(_cross_process(
+        "all_gather", jnp.asarray(np.array([buf.size], np.int32)),
+        g)).reshape(g.nranks)
+    maxlen = int(sizes.max())
+    padded = np.zeros(maxlen, np.uint8)
+    padded[:buf.size] = buf
+    gathered = np.asarray(_cross_process(
+        "all_gather", jnp.asarray(padded), g))
+    return [gathered[i][:sizes[i]].tobytes() for i in range(g.nranks)]
+
+
+def _pack(arr) -> bytes:
+    return pickle.dumps(np.asarray(arr), protocol=4)
+
+
+def _unpack(b: bytes):
+    return jnp.asarray(pickle.loads(b))
+
+
+def _next_seq(g: Group, tag: str) -> int:
+    n = g._seq.get(tag, 0)
+    g._seq[tag] = n + 1
+    return n
+
+
+def _reduce_parts(parts, op, nranks):
+    out = parts[0]
+    for p in parts[1:]:
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            out = out + p
+        elif op == ReduceOp.MAX:
+            out = np.maximum(out, p)
+        elif op == ReduceOp.MIN:
+            out = np.minimum(out, p)
+        elif op == ReduceOp.PROD:
+            out = out * p
+        else:
+            raise NotImplementedError(op)
+    if op == ReduceOp.AVG:
+        out = out / nranks
+    return out
+
+
+def _store_gather_all(g: Group, arr, tag: str):
+    """Every member contributes its array; every member reads all parts.
+    Refcounted cleanup: the last reader deletes the keys."""
+    st = _comm_store()
+    base = f"c{g.id}/{tag}/{_next_seq(g, tag)}"
+    st.set(f"{base}/{g.rank}", _pack(arr))
+    parts = [pickle.loads(st.get(f"{base}/{i}")) for i in range(g.nranks)]
+    if st.add(f"{base}/rc", 1) == g.nranks:
+        for i in range(g.nranks):
+            st.delete(f"{base}/{i}")
+        st.delete(f"{base}/rc")
+    return parts
+
+
+def _store_bcast_bytes(g: Group, payload: Optional[bytes], src_rank: int,
+                       tag: str) -> bytes:
+    st = _comm_store()
+    base = f"c{g.id}/{tag}/{_next_seq(g, tag)}"
+    if g.rank == src_rank:
+        st.set(base, payload)
+        out = payload
+    else:
+        out = st.get(base)
+    if st.add(f"{base}/rc", 1) == g.nranks:
+        st.delete(base)
+        st.delete(f"{base}/rc")
+    return out
+
+
+def _store_barrier(g: Group):
+    st = _comm_store()
+    base = f"c{g.id}/bar/{_next_seq(g, 'bar')}"
+    if st.add(f"{base}/cnt", 1) == g.nranks:
+        st.set(f"{base}/done", b"1")
+    st.wait(f"{base}/done")
+    if st.add(f"{base}/rc", 1) == g.nranks:
+        st.delete(f"{base}/cnt")
+        st.delete(f"{base}/done")
+        st.delete(f"{base}/rc")
+
+
+# Single-process emulation mailbox for send/recv, keyed by
+# (group_id, src, dst) so interleaved channels can't cross wires
+# (each directed edge is its own FIFO).
+_mailbox: Dict[Tuple[int, int, int], List] = {}
 
 
 # -- multi-process compiled collectives --------------------------------------
@@ -185,11 +360,16 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True) -> Task:
     """ref: communication/all_reduce.py:29. In-place on `tensor`."""
     g = _get_group(group)
-    if g.nranks <= 1 or _world_size() <= 1:
+    m = _mode(g)
+    if m == "local":
         # single-controller: value already holds the full contribution
         if op == ReduceOp.AVG and g.nranks > 1:
             tensor._data = _unwrap(tensor) / g.nranks
         return Task([_unwrap(tensor)])
+    if m == "store":
+        parts = _store_gather_all(g, _unwrap(tensor), "ar")
+        tensor._data = jnp.asarray(_reduce_parts(parts, op, g.nranks))
+        return Task([tensor._data])
     out = _cross_process("all_reduce", _unwrap(tensor), g, op=op)
     local = out[jax.process_index() % out.shape[0]] if out.ndim > _unwrap(tensor).ndim else out
     tensor._data = jnp.asarray(local)
@@ -201,9 +381,14 @@ def all_gather(tensor_list: List, tensor, group: Optional[Group] = None,
     """ref: communication/all_gather.py."""
     g = _get_group(group)
     arr = _unwrap(tensor)
-    if g.nranks <= 1 or _world_size() <= 1:
+    m = _mode(g)
+    if m == "local":
         for _ in range(g.nranks):
             tensor_list.append(Tensor(jnp.asarray(arr)))
+        return Task([arr])
+    if m == "store":
+        parts = _store_gather_all(g, arr, "ag")
+        tensor_list.extend(Tensor(jnp.asarray(p)) for p in parts)
         return Task([arr])
     out = _cross_process("all_gather", arr, g)
     host = np.asarray(out)
@@ -214,30 +399,30 @@ def all_gather(tensor_list: List, tensor, group: Optional[Group] = None,
 
 def all_gather_object(object_list: List, obj, group: Optional[Group] = None):
     g = _get_group(group)
-    if g.nranks <= 1 or _world_size() <= 1:
+    if _mode(g) == "local":
         object_list.extend(obj for _ in range(g.nranks))
         return
-    import pickle
-    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    size = np.array([payload.size], dtype=np.int32)
-    sizes = np.asarray(_cross_process("all_gather", jnp.asarray(size),
-                                      g)).reshape(g.nranks)
-    maxlen = int(sizes.max())
-    padded = np.zeros(maxlen, dtype=np.uint8)
-    padded[:payload.size] = payload
-    gathered = np.asarray(
-        _cross_process("all_gather", jnp.asarray(padded), g))
-    for i in range(g.nranks):
-        object_list.append(pickle.loads(gathered[i][:sizes[i]].tobytes()))
+    parts = _allgather_bytes(g, pickle.dumps(obj, protocol=4), "ago")
+    object_list.extend(pickle.loads(p) for p in parts)
 
 
 def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
               sync_op: bool = True) -> Task:
     """ref: communication/broadcast.py. Single-controller: identity."""
     g = _get_group(group)
-    if g.nranks <= 1 or _world_size() <= 1:
+    m = _mode(g)
+    if m == "local":
         return Task([_unwrap(tensor)])
-    # broadcast == all_reduce of (value if rank==src else zeros)
+    if m == "store":
+        sr = g.get_group_rank(src)
+        payload = _pack(_unwrap(tensor)) if g.rank == sr else None
+        out = _store_bcast_bytes(g, payload, sr, "bc")
+        if g.rank != sr:
+            tensor._data = _unpack(out)
+        return Task([_unwrap(tensor)])
+    # compiled regime: psum of (value if rank==src else zeros). Costs one
+    # allreduce (~2x a tree broadcast's bytes) but stays on ICI and fuses
+    # under jit; the store path above is the host-staged alternative.
     arr = _unwrap(tensor)
     if g.rank != g.get_group_rank(src):
         arr = jnp.zeros_like(arr)
@@ -247,33 +432,128 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
     return task
 
 
+def broadcast_object_list(object_list: List, src: int = 0,
+                          group: Optional[Group] = None):
+    """ref: communication/broadcast.py broadcast_object_list — in-place."""
+    g = _get_group(group)
+    if _mode(g) == "local":
+        return
+    sr = g.get_group_rank(src)
+    if _store_available():
+        payload = (pickle.dumps(list(object_list), protocol=4)
+                   if g.rank == sr else None)
+        out = _store_bcast_bytes(g, payload, sr, "bco")
+    else:  # compiled regime without a store: gather, keep src's payload
+        mine = pickle.dumps(list(object_list) if g.rank == sr else None,
+                            protocol=4)
+        out = _allgather_bytes(g, mine, "bco")[sr]
+    if g.rank != sr:
+        object_list[:] = pickle.loads(out)
+
+
 def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
            group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    """ref: communication/reduce.py — only ``dst`` holds the reduced value
+    afterwards; other ranks' tensors are left untouched."""
+    g = _get_group(group)
+    m = _mode(g)
+    if m == "local":
+        return all_reduce(tensor, op, group)
+    if m == "store":
+        st = _comm_store()
+        dr = g.get_group_rank(dst)
+        base = f"c{g.id}/rd/{_next_seq(g, 'rd')}"
+        if g.rank == dr:
+            parts = [np.asarray(_unwrap(tensor))]
+            parts += [pickle.loads(st.take(f"{base}/{i}"))
+                      for i in range(g.nranks) if i != dr]
+            tensor._data = jnp.asarray(_reduce_parts(parts, op, g.nranks))
+        else:
+            st.set(f"{base}/{g.rank}", _pack(_unwrap(tensor)))
+        return Task([_unwrap(tensor)])
+    # compiled regime: allreduce, then non-dst ranks restore their input
+    # (dst-selectivity is semantic, not a bandwidth saving, on a ring)
+    orig = _unwrap(tensor)
     task = all_reduce(tensor, op, group)
+    if g.rank != g.get_group_rank(dst):
+        tensor._data = orig
     return task
 
 
 def scatter(tensor, tensor_list=None, src: int = 0,
             group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    """ref: communication/scatter.py — ``src`` distributes tensor_list[i]
+    to group rank i."""
     g = _get_group(group)
-    if g.nranks <= 1 or _world_size() <= 1:
+    m = _mode(g)
+    if m == "local":
         if tensor_list:
             tensor._data = _unwrap(tensor_list[0])
         return Task([_unwrap(tensor)])
-    raise NotImplementedError(
-        "cross-process scatter requires the launch runtime")
+    st = _comm_store()
+    sr = g.get_group_rank(src)
+    base = f"c{g.id}/sc/{_next_seq(g, 'sc')}"
+    if g.rank == sr:
+        if not tensor_list or len(tensor_list) != g.nranks:
+            raise ValueError(
+                f"scatter src needs tensor_list of len {g.nranks}")
+        for i in range(g.nranks):
+            if i == sr:
+                tensor._data = _unwrap(tensor_list[i])
+            else:
+                st.set(f"{base}/{i}", _pack(_unwrap(tensor_list[i])))
+    else:
+        tensor._data = _unpack(st.take(f"{base}/{g.rank}"))
+    return Task([_unwrap(tensor)])
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src: int = 0, group: Optional[Group] = None):
+    """ref: communication/scatter.py scatter_object_list."""
+    g = _get_group(group)
+    if _mode(g) == "local":
+        if in_object_list:
+            out_object_list[:] = [in_object_list[0]]
+        return
+    sr = g.get_group_rank(src)
+    if g.rank == sr and (in_object_list is None or
+                         len(in_object_list) != g.nranks):
+        raise ValueError(
+            f"scatter src needs in_object_list of len {g.nranks}")
+    if _store_available():
+        st = _comm_store()
+        base = f"c{g.id}/sco/{_next_seq(g, 'sco')}"
+        if g.rank == sr:
+            for i in range(g.nranks):
+                if i != sr:
+                    st.set(f"{base}/{i}",
+                           pickle.dumps(in_object_list[i], protocol=4))
+            out_object_list[:] = [in_object_list[sr]]
+        else:
+            out_object_list[:] = [pickle.loads(st.take(f"{base}/{g.rank}"))]
+    else:  # compiled regime without a store: gather src's list, pick own
+        mine = pickle.dumps(in_object_list if g.rank == sr else None,
+                            protocol=4)
+        full = pickle.loads(_allgather_bytes(g, mine, "sco")[sr])
+        out_object_list[:] = [full[g.rank]]
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op: bool = True) -> Task:
     g = _get_group(group)
-    if g.nranks <= 1 or _world_size() <= 1:
+    m = _mode(g)
+    if m == "local":
         idx = max(g.rank, 0)
         t = Tensor(_unwrap(tensor_list[idx]))
         all_reduce(t, op, g)
         tensor._data = t._data
         return Task([tensor._data])
     stacked = jnp.stack([_unwrap(t) for t in tensor_list])
+    if m == "store":
+        parts = _store_gather_all(g, stacked, "rs")
+        summed = _reduce_parts(parts, op, g.nranks)
+        tensor._data = jnp.asarray(summed[g.rank])
+        return Task([tensor._data])
     summed = _cross_process("all_reduce", stacked, g, op=op)
     tensor._data = jnp.asarray(summed)[g.rank]
     return Task([tensor._data])
@@ -282,8 +562,23 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM,
 def alltoall(out_tensor_list: List, in_tensor_list: List,
              group: Optional[Group] = None, sync_op: bool = True) -> Task:
     g = _get_group(group)
-    if g.nranks <= 1 or _world_size() <= 1:
+    m = _mode(g)
+    if m == "local":
         out_tensor_list.extend(Tensor(_unwrap(t)) for t in in_tensor_list)
+        return Task([])
+    if m == "store":
+        st = _comm_store()
+        base = f"c{g.id}/a2a/{_next_seq(g, 'a2a')}"
+        r = g.rank
+        for d in range(g.nranks):
+            if d != r:
+                st.set(f"{base}/{r}>{d}", _pack(_unwrap(in_tensor_list[d])))
+        for s in range(g.nranks):
+            if s == r:
+                out_tensor_list.append(Tensor(_unwrap(in_tensor_list[r])))
+            else:
+                out_tensor_list.append(Tensor(_unpack(
+                    st.take(f"{base}/{s}>{r}"))))
         return Task([])
     stacked = jnp.stack([_unwrap(t) for t in in_tensor_list])
     gathered = np.asarray(_cross_process("all_gather", stacked, g))
@@ -296,32 +591,63 @@ def alltoall(out_tensor_list: List, in_tensor_list: List,
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group: Optional[Group] = None,
                     sync_op: bool = True) -> Task:
+    """ref: communication/all_to_all.py alltoall_single — axis-0 splits of
+    one tensor exchanged pairwise."""
     g = _get_group(group)
-    if g.nranks <= 1 or _world_size() <= 1:
+    m = _mode(g)
+    if m == "local":
         out_tensor._data = _unwrap(in_tensor)
         return Task([out_tensor._data])
-    raise NotImplementedError(
-        "cross-process alltoall_single requires the launch runtime")
+    arr = _unwrap(in_tensor)
+    n = g.nranks
+    if in_split_sizes is None:
+        if arr.shape[0] % n:
+            raise ValueError(
+                f"alltoall_single dim0 {arr.shape[0]} not divisible by "
+                f"group size {n}")
+        in_split_sizes = [arr.shape[0] // n] * n
+    offs = np.cumsum([0] + list(in_split_sizes))
+    chunks = [arr[offs[i]:offs[i + 1]] for i in range(n)]
+    ins, outs = [Tensor(c) for c in chunks], []
+    alltoall(outs, ins, group=g, sync_op=sync_op)
+    out_tensor._data = jnp.concatenate([_unwrap(t) for t in outs], axis=0)
+    return Task([out_tensor._data])
 
 
 def send(tensor, dst: int = 0, group: Optional[Group] = None,
          sync_op: bool = True) -> Task:
-    if _world_size() <= 1:
-        _p2p_buf.append(jnp.asarray(_unwrap(tensor)))
+    """ref: communication/send.py + process_group_nccl.cc:252 Send. Cross-
+    process transport is the TCPStore channel (host-staged); per-directed-
+    edge FIFO sequence numbers pair each send with its recv."""
+    g = _get_group(group)
+    if _mode(g) == "local":
+        key = (g.id, max(g.rank, 0), dst)
+        _mailbox.setdefault(key, []).append(jnp.asarray(_unwrap(tensor)))
         return Task([])
-    raise NotImplementedError("cross-process send requires the launch runtime")
+    st = _comm_store()
+    r = g.rank
+    seq = _next_seq(g, f"p2p/{r}>{dst}")
+    st.set(f"c{g.id}/p2p/{r}>{dst}/{seq}", _pack(_unwrap(tensor)))
+    return Task([])
 
 
 def recv(tensor, src: int = 0, group: Optional[Group] = None,
          sync_op: bool = True) -> Task:
-    if _world_size() <= 1:
-        if _p2p_buf:
-            tensor._data = _p2p_buf.pop(0)
+    g = _get_group(group)
+    if _mode(g) == "local":
+        key = (g.id, src, max(g.rank, 0))
+        q = _mailbox.get(key)
+        if not q:
+            raise RuntimeError(
+                f"recv(src={src}) has no pending message on channel "
+                f"{key} (single-process mode cannot block)")
+        tensor._data = q.pop(0)
         return Task([])
-    raise NotImplementedError("cross-process recv requires the launch runtime")
-
-
-_p2p_buf: List = []
+    st = _comm_store()
+    r = g.rank
+    seq = _next_seq(g, f"p2p/{src}>{r}")
+    tensor._data = _unpack(st.take(f"c{g.id}/p2p/{src}>{r}/{seq}"))
+    return Task([tensor._data])
 
 
 def isend(tensor, dst=0, group=None):
@@ -332,12 +658,61 @@ def irecv(tensor, src=0, group=None):
     return recv(tensor, src, group, sync_op=False)
 
 
+class P2POp:
+    """ref: communication/batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError("P2POp op must be paddle.distributed.isend/irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[Task]:
+    """ref: communication/batch_isend_irecv.py. Sends are issued before
+    recvs so the host-staged transport cannot deadlock on ordering."""
+    sends = [p for p in p2p_op_list if p.op in (isend, send)]
+    recvs = [p for p in p2p_op_list if p.op in (irecv, recv)]
+    tasks = [p.op(p.tensor, p.peer, p.group) for p in sends]
+    tasks += [p.op(p.tensor, p.peer, p.group) for p in recvs]
+    return tasks
+
+
 def barrier(group: Optional[Group] = None):
     g = _get_group(group)
-    if g.nranks <= 1 or _world_size() <= 1:
+    m = _mode(g)
+    if m == "local":
+        return
+    if m == "store":
+        _store_barrier(g)
         return
     t = Tensor(jnp.zeros((1,), jnp.float32))
     all_reduce(t, ReduceOp.SUM, g).wait()
+
+
+def get_backend(group: Optional[Group] = None) -> str:
+    """ref: communication/group.py get_backend (NCCL/GLOO there)."""
+    dev = jax.devices()[0].platform
+    return "XCCL" if dev == "tpu" else "GLOO"
+
+
+def is_available() -> bool:
+    return True
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    """ref: communication/group.py destroy_process_group."""
+    global _store
+    if group is None or group.id == 0:
+        _group_map.clear()
+        _mailbox.clear()
+        if _store is not None:
+            _store.shutdown()
+            _store = None
+    else:
+        _group_map.pop(group.id, None)
 
 
 class stream:

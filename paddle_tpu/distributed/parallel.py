@@ -18,7 +18,8 @@ import jax
 
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
-from .collective import Group, ReduceOp, _ensure_default_group, all_reduce
+from .collective import (Group, ReduceOp, _ensure_default_group, all_reduce,
+                         _global_rank, _world_size)
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
@@ -42,8 +43,16 @@ def init_parallel_env() -> Group:
     if nranks > 1 and jax.process_count() == 1:
         port = os.environ.get("MASTER_PORT", "")
         addr = master if ":" in master or not port else f"{master}:{port}"
-        jax.distributed.initialize(
-            coordinator_address=addr, num_processes=nranks, process_id=rank)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr, num_processes=nranks,
+                process_id=rank)
+        except RuntimeError as e:
+            if "must be called before" not in str(e):
+                raise  # genuine bootstrap failure (bad address etc.)
+            # XLA backend already up (e.g. the import touched jax.devices,
+            # or the CPU test harness): eager collectives fall back to the
+            # TCPStore channel transport — ranks come from the launcher env.
     _initialized = True
     return _ensure_default_group()
 
@@ -55,14 +64,13 @@ def is_initialized() -> bool:
 def get_rank(group: Optional[Group] = None) -> int:
     if group is not None:
         return group.rank
-    return jax.process_index()
+    return _global_rank()
 
 
 def get_world_size(group: Optional[Group] = None) -> int:
     if group is not None:
         return group.nranks
-    return max(jax.process_count(),
-               int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+    return _world_size()
 
 
 class ParallelEnv:

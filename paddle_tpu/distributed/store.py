@@ -57,6 +57,18 @@ class TCPStore:
     def add(self, key: str, amount: int = 1) -> int:
         return _lib.store_add(self._client, key, int(amount))
 
+    def take(self, key: str) -> bytes:
+        """Blocking get that atomically deletes the key — the single-consumer
+        channel primitive backing eager p2p (send/recv) transport."""
+        v = _lib.store_take(self._client, key)
+        if v is None:
+            raise ConnectionError(
+                f"TCPStore take of {key!r} aborted (server shut down)")
+        return v
+
+    def delete(self, key: str) -> None:
+        _lib.store_delete(self._client, key)
+
     def wait(self, keys) -> None:
         if isinstance(keys, str):
             keys = [keys]

@@ -1,6 +1,8 @@
 """paddle.nn equivalent. ref: python/paddle/nn/__init__.py"""
 from .layer import Layer, ParamAttr  # noqa: F401
-from .container import Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
+from .container import (  # noqa: F401
+    Sequential, LayerList, ParameterList, LayerDict, ParameterDict,
+)
 from .layers_common import (  # noqa: F401
     Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
     Flatten, Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
@@ -36,7 +38,16 @@ from .transformer import (  # noqa: F401
 )
 from .rnn import (  # noqa: F401
     SimpleRNN, LSTM, GRU, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+    RNNCellBase,
 )
+from .layers_extra import (  # noqa: F401
+    PairwiseDistance, Softmax2D, Unflatten, FeatureAlphaDropout,
+    ZeroPad1D, ZeroPad3D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    LPPool1D, LPPool2D, FractionalMaxPool2D, FractionalMaxPool3D,
+    RNNTLoss, HSigmoidLoss, TripletMarginWithDistanceLoss,
+    AdaptiveLogSoftmaxWithLoss,
+)
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 

@@ -1,0 +1,215 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+ref: python/paddle/nn/decode.py:161 (BeamSearchDecoder), :1090
+(dynamic_decode). Host-driven decode loop (the reference's dynamic
+while_op path collapses to a Python loop under eager); each step's math is
+jnp so the per-step programs jit-cache. Final sequences are reconstructed
+with nn.functional.gather_tree.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from .layer import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def _map_structure(fn, *structs):
+    s0 = structs[0]
+    if isinstance(s0, (list, tuple)):
+        return type(s0)(_map_structure(fn, *xs) for xs in zip(*structs))
+    return fn(*structs)
+
+
+def _data(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+class Decoder:
+    """Abstract decode-step protocol (ref: decode.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """ref: decode.py:161 BeamSearchDecoder.
+
+    cell: an RNNCell-like Layer returning (output, next_state);
+    embedding_fn maps token ids -> embeddings; output_fn (e.g. the
+    projection to vocab logits) is applied to the cell output.
+    """
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.kinf = 1e9
+
+    # -- beam helpers (ref: decode.py tile_beam_merge_with_batch etc.) ----
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] (repeat each row beam times)."""
+        def f(a):
+            return jnp.repeat(a, beam_size, axis=0)
+        return _map_structure(
+            lambda t: Tensor(f(_data(t))) if isinstance(t, Tensor)
+            else f(t), x)
+
+    def _expand_to_beam_size(self, x):
+        a = _data(x)
+        tiled = jnp.repeat(a[:, None], self.beam_size, axis=1)
+        return tiled  # [batch, beam, ...]
+
+    def _merge_batch_beams(self, x):
+        a = _data(x)
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split_batch_beams(self, x):
+        a = _data(x)
+        return a.reshape((-1, self.beam_size) + a.shape[1:])
+
+    # -- protocol ----------------------------------------------------------
+    def initialize(self, initial_cell_states):
+        cell_states = _map_structure(
+            lambda s: self._merge_batch_beams(self._expand_to_beam_size(s)),
+            initial_cell_states)
+        first = initial_cell_states
+        while isinstance(first, (list, tuple)):
+            first = first[0]
+        batch = _data(first).shape[0]
+        self.batch_size = batch
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-self.kinf] * (self.beam_size - 1)],
+                        jnp.float32), (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int32)
+        init_inputs = jnp.full((batch * self.beam_size,), self.start_token,
+                               jnp.int32)
+        if self.embedding_fn is not None:
+            init_inputs = self.embedding_fn(Tensor(init_inputs))
+            init_inputs = _data(init_inputs)
+        state = self.StateWrapper(cell_states, log_probs, finished, lengths)
+        return init_inputs, state, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_cell_states = self.cell(
+            Tensor(inputs) if not isinstance(inputs, Tensor) else inputs,
+            _map_structure(lambda s: Tensor(s) if not isinstance(s, Tensor)
+                           else s, states.cell_states), **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = _data(cell_out)                       # [batch*beam, vocab]
+        vocab = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        step_lp = step_lp.reshape(self.batch_size, self.beam_size, vocab)
+
+        # finished beams only extend with end_token at no cost
+        noend = jnp.full((vocab,), -self.kinf, jnp.float32
+                         ).at[self.end_token].set(0.0)
+        step_lp = jnp.where(states.finished[:, :, None],
+                            noend[None, None, :], step_lp)
+
+        total = states.log_probs[:, :, None] + step_lp
+        flat = total.reshape(self.batch_size, -1)
+        top_scores, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // vocab).astype(jnp.int32)  # [batch, beam]
+        token = (top_idx % vocab).astype(jnp.int32)
+
+        next_finished = jnp.take_along_axis(states.finished, parent, 1) | \
+            (token == self.end_token)
+        next_lengths = jnp.take_along_axis(states.lengths, parent, 1) + \
+            (~jnp.take_along_axis(states.finished, parent, 1)).astype(
+                jnp.int32)
+
+        # gather cell states along the parent beams
+        flat_parent = (parent + jnp.arange(self.batch_size)[:, None] *
+                       self.beam_size).reshape(-1)
+
+        def gather_state(s):
+            return _data(s)[flat_parent]
+        next_cell = _map_structure(
+            lambda s: gather_state(s), next_cell_states)
+
+        next_state = self.StateWrapper(next_cell, top_scores, next_finished,
+                                       next_lengths)
+        out = self.OutputWrapper(top_scores, token, parent)
+        next_inputs = token.reshape(-1)
+        if self.embedding_fn is not None:
+            next_inputs = _data(self.embedding_fn(Tensor(next_inputs)))
+        return out, next_state, next_inputs, next_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        from .functional.extension import gather_tree
+        preds = gather_tree(Tensor(outputs.predicted_ids),
+                            Tensor(outputs.parent_ids))
+        return preds, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """ref: decode.py:1090 dynamic_decode — run decoder.step until all
+    beams finish or max_step_num."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs_t = []
+    step = 0
+    limit = max_step_num if max_step_num is not None else 10 ** 9
+    seq_lens = None
+    while not bool(np.asarray(finished).all()) and step <= limit:
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        outputs_t.append(out)
+        step += 1
+    if hasattr(states, "lengths"):
+        seq_lens = states.lengths
+    if isinstance(outputs_t[0], tuple) and hasattr(outputs_t[0], "_fields"):
+        stacked = type(outputs_t[0])(*[
+            jnp.stack([_data(getattr(o, f)) for o in outputs_t])
+            for f in outputs_t[0]._fields])
+    else:
+        stacked = _map_structure(
+            lambda *xs: jnp.stack([_data(x) for x in xs]), *outputs_t)
+    final_outputs, final_states = decoder.finalize(stacked, states, seq_lens)
+
+    def to_batch_major(t):
+        a = _data(t)
+        perm = (1, 0) + tuple(range(2, a.ndim))
+        return Tensor(jnp.transpose(a, perm))
+
+    if not output_time_major:
+        final_outputs = _map_structure(
+            lambda t: to_batch_major(t), final_outputs)
+    if return_length:
+        return final_outputs, final_states, Tensor(seq_lens)
+    return final_outputs, final_states

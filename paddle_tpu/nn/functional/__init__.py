@@ -5,8 +5,11 @@ from .conv import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
+from .extension import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
-    scaled_dot_product_attention, flash_attention,
+    scaled_dot_product_attention, flash_attention, flash_attn_qkvpacked,
+    flash_attn_varlen_qkvpacked, flashmask_attention,
 )
 
 # pad lives with tensor manipulation but is exported via F as well

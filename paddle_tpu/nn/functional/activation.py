@@ -189,3 +189,34 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
         slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
         return jnp.where(a >= 0, a, slope * a)
     return apply_op(f, x, op_name="rrelu")
+
+
+# -- inplace variants ---------------------------------------------------------
+# ref: the reference generates relu_/tanh_/... siblings writing into the
+# input buffer (python/paddle/nn/functional/activation.py). Tensors wrap
+# immutable jax.Arrays, so inplace = compute + buffer swap, the same
+# user-visible contract as paddle_tpu.ops.inplace.
+
+def _inplace(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._data = out._data
+        x._node = out._node
+        x._out_index = out._out_index
+        x.stop_gradient = out.stop_gradient
+        return x
+    wrapper.__name__ = fn.__name__ + "_"
+    wrapper.__qualname__ = fn.__qualname__ + "_"
+    return wrapper
+
+
+relu_ = _inplace(relu)
+tanh_ = _inplace(tanh)
+elu_ = _inplace(elu)
+hardtanh_ = _inplace(hardtanh)
+leaky_relu_ = _inplace(leaky_relu)
+softmax_ = _inplace(softmax)
+thresholded_relu_ = _inplace(thresholded_relu)

@@ -72,6 +72,110 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """ref: nn/functional/flash_attention.py flash_attn_qkvpacked —
+    qkv [B, L, 3, H, D]."""
+    def f(p):
+        return p[:, :, 0], p[:, :, 1], p[:, :, 2]
+    q, k, v = apply_op(f, qkv, op_name="qkv_unpack")
+    out, sm = flash_attention(q, k, v, dropout=dropout, causal=causal,
+                              return_softmax=return_softmax,
+                              training=training)
+    return out, sm
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale,
+                                dropout=0.0, causal=False,
+                                return_softmax=False,
+                                fixed_seed_offset=None, rng_name="",
+                                varlen_padded=True, training=True,
+                                name=None):
+    """Varlen packed attention: sequences packed along dim 0, delimited by
+    cu_seqlens; attention never crosses a sequence boundary.
+
+    ref: python/paddle/nn/functional/flash_attention.py:792. TPU-native:
+    cu_seqlens become per-token segment ids fed to the segment-masked
+    Pallas flash kernel (paddle_tpu.ops.pallas.flash_attention,
+    flash_attention_segmented) — tiles where seg_q != seg_k contribute
+    nothing, so packing costs no extra FLOPs materialization.
+    qkv: [total_tokens, 3, H, D]; returns [total_tokens, H, D].
+    """
+    from ...ops.pallas.flash_attention import flash_attention_segmented
+
+    def f(p, cu_arr):
+        total = p.shape[0]
+        # segment id per token: number of boundaries at or before it
+        seg = jnp.searchsorted(cu_arr[1:], jnp.arange(total), side="right")
+        q, k, v = p[:, 0], p[:, 1], p[:, 2]     # [total, H, D]
+        out = flash_attention_segmented(
+            q[None], k[None], v[None], seg[None].astype(jnp.int32),
+            causal, scale)
+        return out[0]
+
+    out = apply_op(f, qkv, cu_seqlens_q, op_name="flash_attn_varlen")
+    return out, None
+
+
+def flashmask_attention(query, key, value, startend_row_indices,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask: column-wise sparse mask representation.
+
+    ref: python/paddle/nn/functional/flash_attention.py:1098
+    flashmask_attention. startend_row_indices [B, H|1, Lk, C]:
+      C=1 (causal): rows >= LTS masked;
+      C=2 (causal): rows in [LTS, LTE) masked;
+      C=2 (non-causal): rows >= LTS and rows < UTE masked;
+      C=4: rows in [LTS, LTE) or [UTS, UTE) masked.
+    TPU-native fallback expands the column encoding to an additive mask
+    under jit (XLA fuses it into the attention); the Pallas tile-skip
+    path is future work tracked with the sparse-attention kernel.
+    """
+    def f(q, k, v, se):
+        lq, lk = q.shape[1], k.shape[1]
+        rows = jnp.arange(lq).reshape(1, 1, lq, 1)   # i (query/row)
+        se = se.astype(jnp.int32)                     # [B, H1, Lk, C]
+        c = se.shape[-1]
+        lts = se[..., 0][:, :, None, :]               # [B, H1, 1, Lk]
+        if causal:
+            if c == 1:
+                masked = rows >= lts
+            elif c == 2:
+                lte = se[..., 1][:, :, None, :]
+                masked = (rows >= lts) & (rows < lte)
+            else:
+                raise ValueError(
+                    f"causal flashmask expects 1 or 2 columns, got {c}")
+        else:
+            if c == 2:
+                ute = se[..., 1][:, :, None, :]
+                masked = (rows >= lts) | (rows < ute)
+            elif c == 4:
+                lte = se[..., 1][:, :, None, :]
+                uts = se[..., 2][:, :, None, :]
+                ute = se[..., 3][:, :, None, :]
+                masked = ((rows >= lts) & (rows < lte)) | \
+                         ((rows >= uts) & (rows < ute))
+            else:
+                raise ValueError(
+                    f"non-causal flashmask expects 2 or 4 columns, got {c}")
+        mask = jnp.where(masked, -1e30, 0.0).astype(jnp.float32)
+        return _sdpa_reference(q, k, v, mask=mask, causal=causal)
+
+    out = apply_op(f, query, key, value, startend_row_indices,
+                   op_name="flashmask_attention")
+    if return_softmax_lse or return_seed_offset:
+        extras = [None] * (int(return_softmax_lse) +
+                           int(return_seed_offset))
+        return (out, *extras)
+    return out
+
+
 def _should_use_flash(q) -> bool:
     import jax as _jax
     try:

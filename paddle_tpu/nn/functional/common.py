@@ -257,3 +257,54 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
                              wj:wj + ow * st[1]:st[1]].add(a[:, :, i, j])
         return out[:, :, pd[0]:ph - pd[2], pd[1]:pw - pd[3]]
     return apply_op(f, x, op_name="fold")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """ref: python/paddle/nn/functional/distance.py pairwise_distance."""
+    def f(a, b):
+        d = a - b + epsilon
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == float("-inf"):
+            out = jnp.min(jnp.abs(d), axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.sum(jnp.abs(d) ** p, axis=-1,
+                          keepdims=keepdim) ** (1.0 / p)
+        return out
+    return apply_op(f, x, y, op_name="pairwise_distance")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """ref: common.py feature_alpha_dropout — alpha dropout with the mask
+    shared per feature map (channel dim 1)."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if not 0 <= p < 1:
+        raise ValueError(f"p must be in [0, 1), got {p}")
+    from ...core import random as random_mod
+    key = random_mod.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        mask_shape = a.shape[:2] + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
+        b_coef = -a_coef * alpha_p * (1 - q)
+        return a_coef * (jnp.where(keep, a, alpha_p)) + b_coef
+    return apply_op(f, x, op_name="feature_alpha_dropout")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """ref: common.py zeropad2d — padding [left, right, top, bottom]."""
+    if hasattr(padding, "numpy"):
+        padding = padding.numpy().tolist()
+    l, r, t, b = [int(v) for v in padding]
+
+    def f(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (t, b), (l, r)))
+        return jnp.pad(a, ((0, 0), (t, b), (l, r), (0, 0)))
+    return apply_op(f, x, op_name="zeropad2d")

@@ -1,0 +1,160 @@
+"""Extension functionals: sequence_mask, gather_tree, sparse_attention,
+class_center_sample.
+
+ref: python/paddle/nn/functional/extension.py:56 (sequence_mask), :149
+(gather_tree); common.py:2372 (class_center_sample);
+input.py (sparse_attention in the reference op zoo).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+__all__ = ["sequence_mask", "gather_tree", "sparse_attention",
+           "class_center_sample"]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """ref: extension.py:56 — mask[i, ..., j] = j < x[i, ...]."""
+    from ...core.dtype import convert_dtype
+    if maxlen is None:
+        data = x._data if isinstance(x, Tensor) else np.asarray(x)
+        maxlen = int(np.asarray(data).max())
+    jd = convert_dtype(dtype)
+
+    def f(lens):
+        ar = jnp.arange(maxlen)
+        return (ar < lens[..., None]).astype(jd)
+
+    return apply_op(f, x, op_name="sequence_mask")
+
+
+def gather_tree(ids, parents):
+    """ref: extension.py:149 gather_tree — backtrace beam-search ancestry.
+    ids/parents: [max_time, batch, beam]."""
+    def f(idv, par):
+        t_max = idv.shape[0]
+        beam = idv.shape[2]
+
+        def step(carry, t_inp):
+            beams = carry                      # [batch, beam] parent ptrs
+            ids_t, par_t = t_inp
+            out_t = jnp.take_along_axis(ids_t, beams, axis=1)
+            beams = jnp.take_along_axis(par_t, beams, axis=1)
+            return beams, out_t
+
+        init = jnp.broadcast_to(jnp.arange(beam, dtype=par.dtype),
+                                idv.shape[1:])
+        # walk from the last step backwards
+        rev_ids = idv[::-1]
+        rev_par = par[::-1]
+        _, outs = jax.lax.scan(step, init, (rev_ids, rev_par))
+        return outs[::-1]
+
+    return apply_op(f, ids, parents, op_name="gather_tree")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention given a per-(batch, head) CSR pattern.
+
+    ref: the reference's sparse_attention op (phi sparse attention kernel).
+    TPU-native fallback: materialize the CSR pattern as a dense mask and
+    let XLA fuse the masked softmax — correct for any pattern; a Pallas
+    tile-skipping kernel is the perf path for real block-sparse layouts.
+    q/k/v: [B, H, M, D]; offset: [B, H, M+1]; columns: [B, H, nnz].
+    """
+    def f(q, k, v, off, cols, *rest):
+        b, h, m, d = q.shape
+        nnz = cols.shape[-1]
+        # row id of each nnz entry: searchsorted over the offset vector
+        def row_of(off_1d):
+            return jnp.searchsorted(off_1d, jnp.arange(nnz), side="right") - 1
+        rows = jax.vmap(jax.vmap(row_of))(off)        # [B, H, nnz]
+        mask = jnp.zeros((b, h, m, m), jnp.bool_)
+        bidx = jnp.arange(b)[:, None, None]
+        hidx = jnp.arange(h)[None, :, None]
+        mask = mask.at[bidx, hidx, rows, cols].set(True)
+        scores = jnp.einsum("bhmd,bhnd->bhmn", q, k) / jnp.sqrt(
+            jnp.asarray(d, q.dtype))
+        neg = jnp.asarray(-1e30, scores.dtype)
+        scores = jnp.where(mask, scores, neg)
+        i = 0
+        if key_padding_mask is not None:
+            kpm = rest[i]; i += 1
+            scores = jnp.where(kpm[:, None, None, :] != 0, scores, neg)
+        if attn_mask is not None:
+            am = rest[i]; i += 1
+            scores = jnp.where(am != 0, scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1)
+        # fully-masked rows produce uniform softmax over -1e30 → zero out
+        any_valid = jnp.any(mask, axis=-1, keepdims=True)
+        probs = jnp.where(any_valid, probs, 0.0)
+        return jnp.einsum("bhmn,bhnd->bhmd", probs, v)
+
+    extra = [t for t in (key_padding_mask, attn_mask) if t is not None]
+    return apply_op(f, query, key, value, sparse_csr_offset,
+                    sparse_csr_columns, *extra, op_name="sparse_attention")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC class-center sampling (arXiv:2010.05222).
+
+    ref: common.py:2372 class_center_sample. Keeps all positive class
+    centers, pads with uniformly sampled negatives to num_samples, and
+    remaps labels into the sampled index space. Under a model-parallel
+    group each rank samples within its own class shard after pooling the
+    positives across ranks (all_gather_object). Host-side (data-dependent
+    output size), eager-only — as in the reference, this feeds the data
+    pipeline of margin_cross_entropy.
+    """
+    from ...distributed import collective as coll
+    from ...core import random as random_mod
+
+    lab = np.asarray(label._data if isinstance(label, Tensor) else label)
+    mp = group is not False
+    g = coll._get_group(group if group is not True else None) if mp else None
+
+    if g is not None and g.nranks > 1:
+        pooled = []
+        coll.all_gather_object(pooled, lab.tolist(), group=g)
+        all_pos = np.unique(np.concatenate([np.asarray(p) for p in pooled]))
+        # this rank's class shard: [offset, offset + num_classes)
+        sizes = []
+        coll.all_gather_object(sizes, int(num_classes), group=g)
+        offset = sum(sizes[:g.rank])
+    else:
+        all_pos = np.unique(lab)
+        offset = 0
+
+    local_pos = all_pos[(all_pos >= offset) & (all_pos < offset + num_classes)]
+    local_pos = local_pos - offset
+    n_pos = len(local_pos)
+    seed = int(np.asarray(
+        jax.random.randint(random_mod.next_key(), (), 0, 2 ** 31 - 1)))
+    rng = np.random.default_rng(seed)
+    if n_pos >= num_samples:
+        sampled = np.sort(local_pos)
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), local_pos,
+                                assume_unique=False)
+        extra = rng.choice(neg_pool, size=num_samples - n_pos, replace=False)
+        sampled = np.sort(np.concatenate([local_pos, extra]))
+    # remap: global label -> position in the (global) sampled order
+    if g is not None and g.nranks > 1:
+        all_sampled = []
+        coll.all_gather_object(all_sampled, (sampled + offset).tolist(),
+                               group=g)
+        flat = np.concatenate([np.asarray(s) for s in all_sampled])
+    else:
+        flat = sampled
+    lut = {int(c): i for i, c in enumerate(flat)}
+    remapped = np.asarray([lut.get(int(v), -1) for v in lab.reshape(-1)],
+                          dtype=lab.dtype).reshape(lab.shape)
+    return (Tensor(jnp.asarray(remapped)),
+            Tensor(jnp.asarray(sampled.astype(lab.dtype))))

@@ -340,3 +340,336 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
             v = v + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi))
         return _reduce(v, reduction)
     return apply_op(f, input, label, variance, op_name="gaussian_nll_loss")
+
+
+# -- round-2 long-tail losses -------------------------------------------------
+
+def square_error_cost(input, label):
+    """ref: loss.py square_error_cost — element-wise (input - label)^2."""
+    return apply_op(lambda a, b: (a - b) ** 2, input, label,
+                    op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """ref: loss.py log_loss."""
+    def f(a, b):
+        return (-b * jnp.log(a + epsilon)
+                - (1.0 - b) * jnp.log(1.0 - a + epsilon))
+    return apply_op(f, input, label, op_name="log_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """ref: loss.py dice_loss — 1 - 2|X∩Y|/(|X|+|Y|), mean over batch."""
+    def f(a, b):
+        lbl = jax.nn.one_hot(jnp.squeeze(b, -1), a.shape[-1], dtype=a.dtype)
+        axes = tuple(range(1, a.ndim))
+        inse = jnp.sum(a * lbl, axis=axes)
+        denom = jnp.sum(a, axis=axes) + jnp.sum(lbl, axis=axes)
+        return jnp.mean(1.0 - 2.0 * inse / (denom + epsilon))
+    return apply_op(f, input, label, op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """ref: loss.py npair_loss (NPairs metric-learning loss)."""
+    def f(a, p, l):
+        n = l.shape[0]
+        lm = (l.reshape(n, 1) == l.reshape(1, n)).astype(a.dtype)
+        lm = lm / jnp.sum(lm, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) \
+            * 0.25 * l2_reg
+        sim = a @ p.T
+        ce = -jnp.sum(lm * jax.nn.log_softmax(sim, axis=-1), axis=-1)
+        celoss = jnp.mean(jnp.sum(lm * ce[:, None], axis=0))
+        return l2 + celoss
+    return apply_op(f, anchor, positive, labels, op_name="npair_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    """ref: loss.py sigmoid_focal_loss (RetinaNet focal loss on logits)."""
+    def f(x, y, *rest):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        loss = ce * ((1 - p_t) ** gamma)
+        if alpha >= 0:
+            a_t = alpha * y + (1 - alpha) * (1 - y)
+            loss = a_t * loss
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    args = [normalizer] if normalizer is not None else []
+    return apply_op(f, logit, label, *args, op_name="sigmoid_focal_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """ref: loss.py triplet_margin_with_distance_loss."""
+    dist = distance_function
+    if dist is None:
+        def dist(x, y):
+            from ...ops import math as _m
+            return apply_op(
+                lambda a, b: jnp.sqrt(jnp.sum((a - b) ** 2, -1) + 1e-12),
+                x, y, op_name="pdist")
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dsw = dist(positive, negative)
+        dn = apply_op(lambda a, b: jnp.minimum(a, b), dn, dsw,
+                      op_name="min")
+    return apply_op(
+        lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0), reduction),
+        dp, dn, op_name="triplet_margin_with_distance_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over a complete binary tree (or a custom
+    tree via path_table/path_code).
+
+    ref: python/paddle/nn/functional/loss.py hsigmoid_loss; default-tree
+    bit coding per phi/kernels/funcs/matrix_bit_code.h SimpleCode:
+    c = label + num_classes; path node j = (c >> (j+1)) - 1,
+    bit j = (c >> j) & 1, path length = floor(log2(c)).
+    """
+    import numpy as _np
+    if num_classes < 2:
+        raise ValueError(f"Expected num_classes >= 2 (got {num_classes})")
+    if (path_table is None) != (path_code is None):
+        raise ValueError(
+            "path_table and path_code must be given together (custom tree)")
+
+    def f(x, lbl, w, *rest):
+        b = rest[0] if bias is not None else None
+        if path_table is None:
+            # default complete binary tree, host-computed bit tables are
+            # data-dependent → compute on device from label
+            c = lbl.astype(jnp.int32) + num_classes
+            max_len = int(_np.floor(_np.log2(2 * num_classes - 1)))
+            js = jnp.arange(max_len)
+            # node index and bit per path position
+            nodes = (c[:, None] >> (js[None, :] + 1)) - 1
+            bits = (c[:, None] >> js[None, :]) & 1
+            # valid while (c >> (j+1)) > 0  <=> node >= 0
+            valid = nodes >= 0
+            nodes = jnp.maximum(nodes, 0)
+        else:
+            pt, pc = rest[-2], rest[-1]
+            nodes = pt.astype(jnp.int32)
+            bits = pc.astype(jnp.int32)
+            valid = nodes >= 0
+            nodes = jnp.maximum(nodes, 0)
+        wn = w[nodes]                     # [N, L, D]
+        pre = jnp.einsum("nld,nd->nl", wn, x)
+        if b is not None:
+            pre = pre + jnp.reshape(b, (-1,))[nodes]
+        pre = jnp.clip(pre, -40.0, 40.0)
+        # binary logistic: log(1+e^pre) - bit*pre, summed over the path
+        per = jnp.logaddexp(0.0, pre) - bits.astype(pre.dtype) * pre
+        per = jnp.where(valid, per, 0.0)
+        return jnp.sum(per, axis=1, keepdims=True)
+
+    args = [a for a in (bias, path_table, path_code) if a is not None]
+    return apply_op(f, input, label, weight, *args, op_name="hsigmoid_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss via a forward-variable DP in pure XLA ops.
+
+    ref: python/paddle/nn/functional/loss.py rnnt_loss (warprnnt kernel,
+    phi/kernels/cpu/warprnnt_kernel.cc). input: [B, T, U+1, V] logits
+    (log_softmax applied internally, as the kernel does); label [B, U];
+    FastEmit (arXiv:2010.11148) applies a (1+lambda) log-weight on label
+    emissions.
+    """
+    def f(acts, lbl, tlen, ulen):
+        logp = jax.nn.log_softmax(acts.astype(jnp.float32), axis=-1)
+        B, T, U1, V = logp.shape
+        U = U1 - 1
+        blank_lp = logp[..., blank]                      # [B, T, U+1]
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :U, :], lbl[:, None, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]                             # [B, T, U]
+        if fastemit_lambda:
+            emit_lp = emit_lp + jnp.log1p(
+                jnp.asarray(fastemit_lambda, jnp.float32))
+        NEG = jnp.asarray(-1e30, jnp.float32)
+
+        # alpha[t, u]: log-prob of emitting first u labels in t frames.
+        # scan over t; within a row, u-recursion via associative scan
+        # (alpha[t,u] = logaddexp(alpha[t-1,u]+blank[t-1,u],
+        #                         alpha[t,u-1]+emit[t,u-1]))
+        def row_update(carry, t_inp):
+            prev_alpha = carry                            # [B, U+1]
+            blank_prev, emit_cur = t_inp                  # [B,U+1],[B,U]
+            base = prev_alpha + blank_prev                # horizontal step
+            # alpha_t[u] = logsumexp over k<=u of
+            #   base[k] + sum_{j=k..u-1} emit_cur[j]
+            csum = jnp.concatenate(
+                [jnp.zeros((B, 1), jnp.float32),
+                 jnp.cumsum(emit_cur, axis=1)], axis=1)   # [B, U+1]
+            shifted = base - csum
+            # exact running logsumexp along u (associative, stable)
+            lse = jax.lax.associative_scan(jnp.logaddexp, shifted, axis=1)
+            alpha_t = lse + csum
+            return alpha_t, alpha_t
+
+        # t = 0 row: alpha[0, u] = sum emit[0, :u]
+        emit0 = emit_lp[:, 0, :]
+        alpha0 = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.float32),
+             jnp.cumsum(emit0, axis=1)], axis=1)
+        xs = (jnp.moveaxis(blank_lp[:, :-1, :], 1, 0),
+              jnp.moveaxis(emit_lp[:, 1:, :], 1, 0))
+        _, rows = jax.lax.scan(row_update, alpha0, xs)
+        alphas = jnp.concatenate([alpha0[None], rows], axis=0)  # [T, B, U+1]
+        alphas = jnp.moveaxis(alphas, 1, 0)                     # [B, T, U+1]
+
+        t_idx = (tlen.astype(jnp.int32) - 1)
+        u_idx = ulen.astype(jnp.int32)
+        a_fin = jnp.take_along_axis(
+            jnp.take_along_axis(
+                alphas, t_idx[:, None, None], axis=1)[:, 0],
+            u_idx[:, None], axis=1)[:, 0]
+        b_fin = jnp.take_along_axis(
+            jnp.take_along_axis(
+                blank_lp, t_idx[:, None, None], axis=1)[:, 0],
+            u_idx[:, None], axis=1)[:, 0]
+        nll = -(a_fin + b_fin)
+        if reduction == "mean":
+            return jnp.sum(nll) / B
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply_op(f, input, label, input_lengths, label_lengths,
+                    op_name="rnnt_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax CE.
+
+    ref: python/paddle/nn/functional/loss.py:2224 margin_cross_entropy —
+    logit of the true class becomes
+    cos(m1*theta + m2) - m3, all scaled by s. With a model-parallel group
+    (class-sharded logits) the softmax runs over the global class dim via
+    the group collectives (ref: c_softmax_with_cross_entropy).
+    """
+    from ...distributed import collective as coll
+
+    mp = group is not False and group is not None
+    g = coll._get_group(group) if mp else None
+    class_offset = 0
+    if mp and g.nranks > 1:
+        # class-sharded logits: global class id offset of this rank
+        sizes = []
+        coll.all_gather_object(sizes, int(logits.shape[-1]), group=g)
+        class_offset = sum(sizes[:g.rank])
+
+    def f(lg, lb):
+        lb = lb.reshape(lb.shape[0]) if lb.ndim > 1 else lb
+        local = lb.astype(jnp.int32) - class_offset
+        in_range = (local >= 0) & (local < lg.shape[-1])
+        safe = jnp.where(in_range, local, 0)
+        onehot = jax.nn.one_hot(safe, lg.shape[-1], dtype=lg.dtype) \
+            * in_range[:, None].astype(lg.dtype)
+        cos_t = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos_t)
+        modified = jnp.cos(margin1 * theta + margin2) - margin3
+        out = jnp.where(onehot > 0, modified, cos_t) * scale
+        return out, onehot
+
+    out, onehot = apply_op(f, logits, label,
+                           op_name="margin_cross_entropy_logits")
+
+    if mp and g is not None and g.nranks > 1:
+        from ..functional import softmax as _softmax
+        # distributed softmax: subtract global max, divide by global sum
+        def g_max(a):
+            return jnp.max(a, axis=-1, keepdims=True)
+        mx = apply_op(g_max, out, op_name="rowmax")
+        coll.all_reduce(mx, coll.ReduceOp.MAX, g)
+        exp = apply_op(lambda a, m: jnp.exp(a - m), out, mx, op_name="exp")
+        den = apply_op(lambda e: jnp.sum(e, -1, keepdims=True), exp,
+                       op_name="rowsum")
+        coll.all_reduce(den, coll.ReduceOp.SUM, g)
+        sm = apply_op(lambda e, d: e / d, exp, den, op_name="div")
+        logden = apply_op(lambda d: jnp.log(d), den, op_name="log")
+        tgt = apply_op(lambda o, a, m: jnp.sum(o * (a - m), -1,
+                                               keepdims=True),
+                       onehot, out, mx, op_name="target_logit")
+        coll.all_reduce(tgt, coll.ReduceOp.SUM, g)
+        loss = apply_op(lambda ld, t: ld - t, logden, tgt, op_name="nll")
+    else:
+        def f2(o, oh):
+            lsm = jax.nn.log_softmax(o, axis=-1)
+            loss = -jnp.sum(oh * lsm, axis=-1, keepdims=True)
+            return loss, jnp.exp(lsm)
+        loss, sm = apply_op(f2, out, onehot, op_name="margin_ce")
+
+    if reduction == "mean":
+        loss = apply_op(lambda v: jnp.mean(v), loss, op_name="mean")
+    elif reduction == "sum":
+        loss = apply_op(lambda v: jnp.sum(v), loss, op_name="sum")
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """ref: loss.py adaptive_log_softmax_with_loss (Grave et al. 2017).
+    Masked vectorized form (no data-dependent gathers) so it jits clean.
+    Returns (per-sample log-prob of the target, mean NLL loss)."""
+
+    def f(x, y, hw, *rest):
+        if x.ndim == 1:
+            x = x[None]
+            y = jnp.reshape(y, (1,))
+        hb = rest[0] if head_bias is not None else None
+        tails = rest[1:] if head_bias is not None else rest
+        # paddle contract: cutoffs excludes num_classes; head covers
+        # [0, cutoffs[0]) plus one slot per tail cluster
+        shortlist = cutoffs[0]
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lp = jax.nn.log_softmax(head_logits, axis=-1)
+        y = y.astype(jnp.int32)
+        out = jnp.take_along_axis(
+            head_lp, jnp.minimum(y, shortlist - 1)[:, None], axis=1)[:, 0]
+        bounds = [0] + list(cutoffs)
+        for i, (w1, w2) in enumerate(tails):
+            lo = bounds[i + 1]
+            hi = bounds[i + 2] if i + 2 < len(bounds) else lo + w2.shape[-1]
+            mask = (y >= lo) & (y < hi)
+            rel = jnp.clip(y - lo, 0, w2.shape[-1] - 1)
+            tail_lp = jax.nn.log_softmax((x @ w1) @ w2, axis=-1)
+            cluster_lp = head_lp[:, shortlist + i] + jnp.take_along_axis(
+                tail_lp, rel[:, None], axis=1)[:, 0]
+            out = jnp.where(mask, cluster_lp, out)
+        loss = -jnp.mean(out)
+        return out, loss
+
+    args = [head_weight]
+    if head_bias is not None:
+        args.append(head_bias)
+    args += [w for pair in tail_weights for w in pair]
+
+    def wrapper(x, y, hw, *rest):
+        hb = ()
+        if head_bias is not None:
+            hb, rest = (rest[0],), rest[1:]
+        pairs = [(rest[2 * i], rest[2 * i + 1])
+                 for i in range(len(rest) // 2)]
+        return f(x, y, hw, *hb, *pairs)
+
+    return apply_op(wrapper, input, label, *args,
+                    op_name="adaptive_log_softmax_with_loss")

@@ -83,6 +83,10 @@ def _pool(x, kernel, stride, padding, nd, data_format, reducer, init,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   "max_pool1d", ceil_mode,
+                                   channel_last=data_format == "NLC")
     df = "NWC" if data_format == "NLC" else "NCW"
     return _pool(x, kernel_size, stride, padding, 1, df, "max", None,
                  "max_pool1d", ceil_mode)
@@ -90,12 +94,20 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   "max_pool2d", ceil_mode,
+                                   channel_last=data_format == "NHWC")
     return _pool(x, kernel_size, stride, padding, 2, data_format, "max",
                  None, "max_pool2d", ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   "max_pool3d", ceil_mode,
+                                   channel_last=data_format == "NDHWC")
     return _pool(x, kernel_size, stride, padding, 3, data_format, "max",
                  None, "max_pool3d", ceil_mode)
 
@@ -192,3 +204,259 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, "NCDHW", "max",
                           "adaptive_max_pool3d")
+
+
+# -- mask-returning max pooling + unpooling ----------------------------------
+# ref: python/paddle/nn/functional/pooling.py max_pool2d(return_mask=True) /
+# max_unpool2d. The mask holds flat spatial indices into the input (per
+# N, C), the contract the reference's unpool kernels consume
+# (phi/kernels/impl/unpool_kernel_impl.h).
+
+def _max_pool_with_mask(x, kernel, stride, padding, nd, op_name,
+                        ceil_mode=False, channel_last=False):
+    """NCX layouts only — the reference likewise rejects channel-last
+    when return_mask=True."""
+    if channel_last:
+        raise ValueError(
+            f"{op_name}(return_mask=True) only supports channel-first "
+            f"layouts (NCL/NCHW/NCDHW), matching the reference unpool "
+            f"contract")
+    k = _tuple(kernel, nd)
+    s = _tuple(stride if stride is not None else kernel, nd)
+    pad = _pad_cfg(padding, nd)
+    if isinstance(pad, str):
+        raise ValueError(f"{op_name}(return_mask=True) needs numeric padding")
+
+    def f(a):
+        spatial = a.shape[2:]
+        # finite sentinel: patches are extracted via a one-hot convolution
+        # where 0 * -inf would poison real windows with NaN
+        neg = (jnp.finfo(jnp.float32).min / 2
+               if jnp.issubdtype(a.dtype, jnp.floating)
+               else jnp.iinfo(a.dtype).min)
+        full_pad = [(0, 0), (0, 0)] + [tuple(p) for p in pad]
+        if ceil_mode:
+            # extend right pad so the last partial window is included
+            for i in range(nd):
+                lo, hi = full_pad[2 + i]
+                total = spatial[i] + lo + hi - k[i]
+                rem = total % s[i]
+                if rem != 0:
+                    full_pad[2 + i] = (lo, hi + (s[i] - rem))
+        ap = jnp.pad(a, full_pad, constant_values=neg)
+        # flat *unpadded* spatial index carried alongside each element
+        idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        idx = jnp.broadcast_to(idx, a.shape)
+        idxp = jnp.pad(idx, full_pad, constant_values=-1)
+        # extract windows: patches of shape (N, C*prod(k), *out_spatial)
+        patches = jax.lax.conv_general_dilated_patches(
+            ap.astype(jnp.float32), k, s, "VALID")
+        n, _, *out_sp = patches.shape
+        c = a.shape[1]
+        patches = patches.reshape(n, c, int(np.prod(k)), *out_sp)
+        arg = jnp.argmax(patches, axis=2)  # in-window offset
+        idx_patches = jax.lax.conv_general_dilated_patches(
+            idxp.astype(jnp.float32), k, s, "VALID").reshape(
+            n, c, int(np.prod(k)), *out_sp)
+        mask = jnp.take_along_axis(
+            idx_patches, arg[:, :, None], axis=2).squeeze(2).astype(jnp.int32)
+        vals = jnp.take_along_axis(
+            patches, arg[:, :, None], axis=2).squeeze(2).astype(a.dtype)
+        return vals, mask
+
+    return apply_op(f, x, op_name=op_name)
+
+
+def _max_unpool(x, indices, kernel, stride, padding, output_size, nd,
+                op_name):
+    k = _tuple(kernel, nd)
+    s = _tuple(stride if stride is not None else kernel, nd)
+    p = _tuple(padding, nd)
+
+    def f(a, idx):
+        n, c, *in_sp = a.shape
+        if output_size is not None:
+            out_sp = list(_tuple(output_size, nd))
+        else:
+            out_sp = [(in_sp[i] - 1) * s[i] - 2 * p[i] + k[i]
+                      for i in range(nd)]
+        flat = jnp.zeros((n, c, int(np.prod(out_sp))), a.dtype)
+        ii = idx.reshape(n, c, -1).astype(jnp.int32)
+        vv = a.reshape(n, c, -1)
+        flat = jax.vmap(jax.vmap(
+            lambda z, i, v: z.at[i].set(v)))(flat, ii, vv)
+        return flat.reshape(n, c, *out_sp)
+
+    return apply_op(f, x, indices, op_name=op_name)
+
+
+def _trim_output_size(output_size, nd):
+    """Accept both the spatial form [*spatial] and the full form
+    [N, C, *spatial] the reference allows."""
+    if output_size is not None and len(output_size) == nd + 2:
+        return list(output_size)[2:]
+    return output_size
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """ref: pooling.py max_unpool1d."""
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       _trim_output_size(output_size, 1), 1, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """ref: pooling.py max_unpool2d."""
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       _trim_output_size(output_size, 2), 2, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """ref: pooling.py max_unpool3d."""
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       _trim_output_size(output_size, 3), 3, "max_unpool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """Power-average pooling: (sum x^p)^(1/p). ref: pooling.py lp_pool1d."""
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 1,
+                    "NWC" if data_format == "NLC" else "NCW", ceil_mode,
+                    "lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """ref: pooling.py lp_pool2d."""
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 2,
+                    data_format, ceil_mode, "lp_pool2d")
+
+
+def _lp_pool(x, p, kernel, stride, padding, nd, data_format, ceil_mode,
+             op_name):
+    p = float(p)
+    if p == float("inf"):
+        return _pool(x, kernel, stride, padding, nd, data_format, "max",
+                     None, op_name, ceil_mode)
+    k = _tuple(kernel, nd)
+    # (sum_w x^p)^(1/p) = (mean * count)^(1/p); reuse the sum path
+    xp = apply_op(lambda a: jnp.power(a, p), x, op_name=f"{op_name}_pow")
+    pooled = _pool(xp, kernel, stride, padding, nd, data_format, "mean",
+                   None, op_name, ceil_mode, exclusive=False)
+    return apply_op(
+        lambda a: jnp.power(a * float(np.prod(k)), 1.0 / p),
+        pooled, op_name=f"{op_name}_root")
+
+
+def _fractional_starts(n_in, n_out, u):
+    alpha = n_in / n_out
+    starts = np.ceil(alpha * (np.arange(n_out) + u)).astype(np.int64) - 1
+    ends = np.ceil(alpha * (np.arange(n_out) + 1 + u)).astype(np.int64) - 1
+    starts = np.clip(starts, 0, n_in - 1)
+    ends = np.clip(ends, 1, n_in)
+    return starts, ends
+
+
+def _fractional_max_pool(x, output_size, kernel_size, random_u, return_mask,
+                         nd, op_name):
+    """ref: pooling.py fractional_max_pool2d/3d (Graham 2015):
+    start=ceil(alpha*(i+u))-1, end=ceil(alpha*(i+1+u))-1 per dim;
+    kernel_size overrides the window length when given."""
+    if random_u is None:
+        # framework-seeded RNG (paddle.seed reproducibility), like every
+        # other stochastic op
+        from ...core import random as random_mod
+        u = float(np.clip(np.asarray(
+            jax.random.uniform(random_mod.next_key(), ())),
+            1e-6, 1.0 - 1e-6))
+    else:
+        u = float(random_u)
+        if not 0.0 < u < 1.0:
+            raise ValueError(f"random_u must be in (0, 1), got {u}")
+    os = _tuple(output_size, nd)
+    ks = _tuple(kernel_size, nd) if kernel_size is not None else None
+
+    def f(a):
+        spatial = a.shape[2:]
+        # per-dim gather of variable windows; windows are data-independent
+        # (host-computed index tables), so this stays jit-friendly
+        tables = []
+        for d in range(nd):
+            n_in, n_out = spatial[d], os[d] if os[d] else spatial[d]
+            st, en = _fractional_starts(n_in, n_out, u)
+            if ks is not None:
+                en = np.minimum(st + ks[d], n_in)
+            tables.append((st, en))
+        # reduce one dim at a time via segment max over gathered slices
+        cur = a
+        for d in range(nd):
+            axis = 2 + d
+            st, en = tables[d]
+            maxw = int((en - st).max())
+            # gather windows: for each output index, take maxw elements
+            # starting at st (clamped), mask beyond en
+            gidx = np.minimum(st[:, None] + np.arange(maxw)[None, :],
+                              cur.shape[axis] - 1)
+            valid = (st[:, None] + np.arange(maxw)[None, :]) < en[:, None]
+            g = jnp.take(cur, jnp.asarray(gidx.reshape(-1)), axis=axis)
+            new_shape = (cur.shape[:axis] + (len(st), maxw) +
+                         cur.shape[axis + 1:])
+            g = g.reshape(new_shape)
+            neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                   else jnp.iinfo(a.dtype).min)
+            vshape = [1] * g.ndim
+            vshape[axis], vshape[axis + 1] = valid.shape
+            g = jnp.where(jnp.asarray(valid).reshape(vshape), g, neg)
+            cur = jnp.max(g, axis=axis + 1)
+        if not return_mask:
+            return cur
+        # mask: recompute flat argmax indices by comparing to input values
+        # window-by-window (correctness path; mask consumers are unpool-ish)
+        # recompute with flat input indices carried through the same
+        # per-dim argmax chain
+        cur2 = a
+        idxs = jnp.broadcast_to(
+            jnp.arange(int(np.prod(spatial))).reshape(spatial), a.shape)
+        curi = idxs
+        for d in range(nd):
+            axis = 2 + d
+            st, en = tables[d]
+            maxw = int((en - st).max())
+            gidx = np.minimum(st[:, None] + np.arange(maxw)[None, :],
+                              cur2.shape[axis] - 1)
+            valid = (st[:, None] + np.arange(maxw)[None, :]) < en[:, None]
+            gv = jnp.take(cur2, jnp.asarray(gidx.reshape(-1)), axis=axis)
+            gi = jnp.take(curi, jnp.asarray(gidx.reshape(-1)), axis=axis)
+            new_shape = (cur2.shape[:axis] + (len(st), maxw) +
+                         cur2.shape[axis + 1:])
+            gv = gv.reshape(new_shape)
+            gi = gi.reshape(new_shape)
+            neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                   else jnp.iinfo(a.dtype).min)
+            vshape = [1] * gv.ndim
+            vshape[axis], vshape[axis + 1] = valid.shape
+            gv = jnp.where(jnp.asarray(valid).reshape(vshape), gv, neg)
+            arg = jnp.argmax(gv, axis=axis + 1, keepdims=True)
+            cur2 = jnp.take_along_axis(gv, arg, axis=axis + 1).squeeze(
+                axis + 1)
+            curi = jnp.take_along_axis(gi, arg, axis=axis + 1).squeeze(
+                axis + 1)
+        return cur, curi.astype(jnp.int32)
+
+    return apply_op(f, x, op_name=op_name)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """ref: pooling.py fractional_max_pool2d."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 2, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """ref: pooling.py fractional_max_pool3d."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 3, "fractional_max_pool3d")

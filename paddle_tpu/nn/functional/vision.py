@@ -1,0 +1,173 @@
+"""Vision functionals: affine_grid, grid_sample, temporal_shift.
+
+ref: python/paddle/nn/functional/vision.py:140 (affine_grid), grid_sample
+(same file), extension.py:247 (temporal_shift). TPU-native: pure gather
+algebra — XLA lowers the index arithmetic + gathers onto the VPU; no
+cudnn sampler analog needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply_op
+
+__all__ = ["affine_grid", "grid_sample", "temporal_shift"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """ref: vision.py affine_grid — theta [N,2,3] + out [N,C,H,W] ->
+    sampling grid [N,H,W,2] (or the 5-D/3-D variant [N,D,H,W,3])."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy().tolist()]
+    out_shape = [int(v) for v in out_shape]
+    nd = len(out_shape) - 2  # 2 (H,W) or 3 (D,H,W)
+
+    def f(th):
+        sizes = out_shape[2:]
+
+        def axis_coords(n):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, n)
+            step = 2.0 / n
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+        axes = [axis_coords(s) for s in sizes]
+        mesh = jnp.meshgrid(*axes, indexing="ij")  # each [*sizes]
+        # grid last-dim order is (x, y[, z]) = (W, H[, D]) — reversed
+        coords = jnp.stack(list(reversed(mesh)) + [jnp.ones_like(mesh[0])],
+                           axis=-1)  # [*sizes, nd+1]
+        # [N, *sizes, nd] = coords @ theta^T
+        out = jnp.einsum("...k,njk->n...j", coords, th)
+        return out.astype(th.dtype)
+
+    return apply_op(f, theta, op_name="affine_grid")
+
+
+def _reflect(coord, lo, hi):
+    """Reflection padding coordinate fold (align_corners grid units)."""
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.zeros_like(coord)
+    double = 2 * rng
+    coord = jnp.abs((coord - lo) % double)
+    return jnp.where(coord > rng, double - coord, coord) + lo
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """ref: vision.py grid_sample — NCHW x [N,C,H,W] sampled at
+    grid [N,Ho,Wo,2] ((x,y) in [-1,1]); 5-D NCDHW with grid [...,3] too."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear|nearest, "
+                         f"got {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode}")
+
+    def f(a, g):
+        nd = g.shape[-1]
+        spatial = a.shape[2:]  # (H, W) or (D, H, W)
+        if len(spatial) != nd:
+            raise ValueError(
+                f"grid last dim {nd} does not match input rank {a.ndim}")
+        g = g.astype(jnp.float32)
+        # unnormalize each coordinate; grid order (x, y[, z]) maps to
+        # spatial axes reversed
+        coords = []
+        for i in range(nd):
+            size = spatial[nd - 1 - i]
+            c = g[..., i]
+            if align_corners:
+                c = (c + 1) / 2 * (size - 1)
+            else:
+                c = ((c + 1) * size - 1) / 2
+            coords.append(c)
+        coords = coords[::-1]  # now ordered like spatial axes
+
+        def fold(c, size):
+            if padding_mode == "border":
+                return jnp.clip(c, 0, size - 1), None
+            if padding_mode == "reflection":
+                if align_corners:
+                    c = _reflect(c, 0.0, float(size - 1))
+                else:
+                    c = _reflect(c, -0.5, size - 0.5)
+                    c = jnp.clip(c, 0, size - 1)
+                return c, None
+            # zeros: keep, mask later
+            valid = (c >= -1) & (c <= size)  # loose; exact mask per corner
+            return c, valid
+
+        folded = []
+        for c, size in zip(coords, spatial):
+            c2, _ = fold(c, size)
+            folded.append(c2)
+
+        def gather_at(idxs):
+            """idxs: list of integer index arrays [N, *out_sp]; returns
+            gathered values [N, C, *out_sp] with zero padding mask."""
+            valid = None
+            cl = []
+            for idx, size in zip(idxs, spatial):
+                v = (idx >= 0) & (idx < size)
+                valid = v if valid is None else (valid & v)
+                cl.append(jnp.clip(idx, 0, size - 1))
+            n = a.shape[0]
+            bidx = jnp.arange(n).reshape((n,) + (1,) * (cl[0].ndim - 1))
+            bidx = jnp.broadcast_to(bidx, cl[0].shape)
+            # a: [N, C, *spatial] -> take per batch
+            moved = jnp.moveaxis(a, 1, -1)  # [N, *spatial, C]
+            vals = moved[(bidx,) + tuple(cl)]  # [N, *out_sp, C]
+            if padding_mode == "zeros":
+                vals = jnp.where(valid[..., None], vals, 0.0)
+            return jnp.moveaxis(vals, -1, 1)
+
+        if mode == "nearest":
+            idxs = [jnp.round(c).astype(jnp.int32) for c in folded]
+            return gather_at(idxs).astype(a.dtype)
+
+        # bilinear / trilinear
+        lows = [jnp.floor(c) for c in folded]
+        fracs = [c - lo for c, lo in zip(folded, lows)]
+        lows = [lo.astype(jnp.int32) for lo in lows]
+        out = None
+        for corner in range(2 ** nd):
+            idxs, w = [], None
+            for d in range(nd):
+                hi = (corner >> d) & 1
+                idxs.append(lows[d] + hi)
+                wd = fracs[d] if hi else (1.0 - fracs[d])
+                w = wd if w is None else w * wd
+            v = gather_at(idxs)
+            contrib = v * w[:, None]
+            out = contrib if out is None else out + contrib
+        return out.astype(a.dtype)
+
+    return apply_op(f, x, grid, op_name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """ref: extension.py:247 temporal_shift (TSM)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"bad data_format {data_format}")
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        r = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.pad(r, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        s1 = pad[:, :seg_num, :c1]           # shift from t-1
+        s2 = pad[:, 2:, c1:c2]               # shift from t+1
+        s3 = pad[:, 1:seg_num + 1, c2:]      # unshifted
+        out = jnp.concatenate([s1, s2, s3], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op(f, x, op_name="temporal_shift")

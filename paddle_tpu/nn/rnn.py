@@ -23,7 +23,37 @@ def _uniform_init(hidden_size):
     return I.Uniform(-k, k)
 
 
-class SimpleRNNCell(Layer):
+class RNNCellBase(Layer):
+    """Base class for RNN cells (ref: python/paddle/nn/layer/rnn.py
+    RNNCellBase) — provides get_initial_states over possibly-nested
+    state shapes."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        if shape is None:
+            shape = self.state_shape
+        if dtype is None:
+            dtype = jnp.float32
+
+        def build(s):
+            if isinstance(s, (list, tuple)) and s and \
+                    isinstance(s[0], (list, tuple)):
+                return type(s)(build(x) for x in s)
+            dims = [batch] + [int(d) for d in s]
+            return Tensor(jnp.full(dims, init_value, dtype))
+
+        return build(shape)
+
+    @property
+    def state_shape(self):
+        if hasattr(self, "hidden_size"):
+            return [self.hidden_size]
+        raise NotImplementedError(
+            "cells must define state_shape or hidden_size")
+
+
+class SimpleRNNCell(RNNCellBase):
     def __init__(self, input_size, hidden_size, activation="tanh",
                  weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
                  bias_hh_attr=None, name=None):
@@ -57,7 +87,7 @@ class SimpleRNNCell(Layer):
         return h, h
 
 
-class LSTMCell(Layer):
+class LSTMCell(RNNCellBase):
     def __init__(self, input_size, hidden_size, weight_ih_attr=None,
                  weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
                  proj_size=None, name=None):
@@ -76,6 +106,10 @@ class LSTMCell(Layer):
         self.bias_hh = self.create_parameter(
             [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ([self.hidden_size], [self.hidden_size])
 
     def forward(self, inputs, states=None):
         if states is None:
@@ -98,7 +132,7 @@ class LSTMCell(Layer):
         return h, (h, c)
 
 
-class GRUCell(Layer):
+class GRUCell(RNNCellBase):
     def __init__(self, input_size, hidden_size, weight_ih_attr=None,
                  weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
                  name=None):
@@ -358,3 +392,4 @@ class BiRNN(Layer):
         out_f, st_f = self.rnn_fw(inputs, sf)
         out_b, st_b = self.rnn_bw(inputs, sb)
         return concat([out_f, out_b], axis=-1), (st_f, st_b)
+

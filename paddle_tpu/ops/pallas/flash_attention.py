@@ -20,7 +20,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention_fwd", "flash_attention"]
+__all__ = ["flash_attention_fwd", "flash_attention",
+           "flash_attention_segmented"]
 
 _NEG_INF = -1e30
 
@@ -58,8 +59,13 @@ except Exception:  # pragma: no cover
 # forward kernel: one (batch*head, q-block) program; inner loop tiles KV
 # with online softmax; also emits logsumexp for the backward pass
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
-                seq_len, causal, scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
+                seq_len, causal, scale, segmented=False):
+    if segmented:
+        seg_ref, o_ref, lse_ref = rest
+    else:
+        seg_ref = None
+        o_ref, lse_ref = rest
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
 
@@ -73,6 +79,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
         num_k_blocks_eff = (q_offset + block_q + block_k - 1) // block_k
     else:
         num_k_blocks_eff = num_k_blocks
+    if segmented:
+        seg_q = seg_ref[0, pl.ds(q_offset, block_q), :]  # [block_q, 1]
 
     def body(ki, carry):
         m, l, acc = carry
@@ -85,6 +93,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
             k_ids = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             logits = jnp.where(q_ids >= k_ids, logits, _NEG_INF)
+        if segmented:
+            # varlen packing: tokens attend within their segment only
+            seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]
+            logits = jnp.where(seg_q == seg_k.reshape(1, block_k),
+                               logits, _NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
         p = jnp.exp(logits - m_new)
         alpha = jnp.exp(m - m_new)
@@ -93,8 +106,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks_eff, body, (m, l, acc))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 # ---------------------------------------------------------------------------
@@ -104,8 +117,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
 #   dS = P ∘ (dO @ Vᵀ − Δ) · scale     with Δ = rowsum(dO ∘ O)
 #   dQ = dS @ K ;  dK = dSᵀ @ Q
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_q, block_k, seq_len, causal, scale):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   block_q, block_k, seq_len, causal, scale,
+                   segmented=False):
+    if segmented:
+        seg_ref, dq_ref = rest
+    else:
+        seg_ref = None
+        (dq_ref,) = rest
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -116,6 +135,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         num_k_blocks_eff = (q_offset + block_q + block_k - 1) // block_k
     else:
         num_k_blocks_eff = seq_len // block_k
+    if segmented:
+        seg_q = seg_ref[0, pl.ds(q_offset, block_q), :]
 
     def body(ki, dq):
         k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
@@ -128,6 +149,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_ids = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             p = jnp.where(q_ids >= k_ids, p, 0.0)
+        if segmented:
+            seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]
+            p = jnp.where(seg_q == seg_k.reshape(1, block_k), p, 0.0)
         dp = do @ v_blk.T
         ds = p * (dp - delta) * scale
         return dq + ds @ k_blk
@@ -139,8 +163,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q, block_k, seq_len, causal,
-                    scale):
+                    *rest, block_q, block_k, seq_len, causal,
+                    scale, segmented=False):
+    if segmented:
+        seg_ref, dk_ref, dv_ref = rest
+    else:
+        seg_ref = None
+        dk_ref, dv_ref = rest
     ki = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)      # [block_k, d]
     v_blk = v_ref[0].astype(jnp.float32)
@@ -148,6 +177,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_q_blocks = seq_len // block_q
     # causal: only q blocks at or after this kv block contribute
     q_start = k_offset // block_q if causal else 0
+    if segmented:
+        seg_k = seg_ref[0, pl.ds(k_offset, block_k), :]
 
     def body(qi, carry):
         dk, dv = carry
@@ -164,6 +195,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_ids = k_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             p = jnp.where(q_ids >= k_ids, p, 0.0)
+        if segmented:
+            seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
+            p = jnp.where(seg_q == seg_k.reshape(1, block_k), p, 0.0)
         dv_new = dv + p.T @ do_blk
         dp = do_blk @ v_blk.T
         ds = p * (dp - delta) * scale
@@ -342,3 +376,146 @@ flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
     """Entry used by nn.functional.attention."""
     return flash_attention(q, k, v, causal, scale)
+
+
+# ---------------------------------------------------------------------------
+# segmented (varlen-packed) flash attention: cu_seqlens -> per-token segment
+# ids; kernel tiles mask cross-segment pairs. This is the packing path the
+# reference exposes as flash_attn_varlen_qkvpacked
+# (ref: python/paddle/nn/functional/flash_attention.py:792).
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k"))
+def _flash_fwd_pallas_seg(q, k, v, seg, causal, scale, block_q=256,
+                          block_k=256):
+    """q,k,v: [BH, L, D]; seg: [BH, L, 1] int32 segment ids."""
+    bh, seq_len, d = q.shape
+    grid = (bh, seq_len // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, seq_len=seq_len,
+        causal=causal, scale=scale, segmented=True)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
+        ],
+    )(q, k, v, seg)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k"))
+def _flash_bwd_pallas_seg(q, k, v, out, lse, do, seg, causal, scale,
+                          block_q=256, block_k=256):
+    bh, seq_len, d = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_q=block_q, block_k=block_k,
+            seq_len=seq_len, causal=causal, scale=scale, segmented=True),
+        grid=(bh, seq_len // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_len, d), q.dtype),
+    )(q, k, v, do, lse, delta, seg)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            seq_len=seq_len, causal=causal, scale=scale, segmented=True),
+        grid=(bh, seq_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_len, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_len, d), v.dtype),
+        ],
+    )(q, k, v, do, lse, delta, seg)
+    return dq, dk, dv
+
+
+def _sdpa_xla_seg(q, k, v, seg, causal, scale):
+    """XLA oracle for segmented attention; seg: [B, L] int32."""
+    same = (seg[:, :, None] == seg[:, None, :])  # [B, Lq, Lk]
+    mask = jnp.where(same[:, None, :, :], 0.0, _NEG_INF)
+    return _sdpa_xla(q, k, v, causal=causal, scale=scale, mask=mask)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_attention_segmented(q, k, v, seg, causal=False, scale=None):
+    """[B, L, H, D] + seg [B, L] int32 — attention restricted to equal
+    segment ids (varlen packing), composable with causal."""
+    out, _ = _flash_seg_fwd_res(q, k, v, seg, causal, scale)
+    return out
+
+
+def _flash_seg_fwd_res(q, k, v, seg, causal, scale):
+    b, l, h, d = q.shape
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    if _use_pallas(l, d):
+        blk = _pick_block(l)
+        seg3 = jnp.repeat(seg[:, None, :], h, axis=1).reshape(b * h, l, 1)
+        seg3 = seg3.astype(jnp.int32)
+        out_bhld, lse = _flash_fwd_pallas_seg(
+            _to_bhld(q), _to_bhld(k), _to_bhld(v), seg3, causal, s,
+            block_q=blk, block_k=blk)
+        return _from_bhld(out_bhld, b, h), (out_bhld, lse, seg3)
+    return _sdpa_xla_seg(q, k, v, seg, causal, s), None
+
+
+def _flash_seg_vjp_fwd(q, k, v, seg, causal, scale):
+    out, res = _flash_seg_fwd_res(q, k, v, seg, causal, scale)
+    return out, (q, k, v, seg, res)
+
+
+def _flash_seg_vjp_bwd(causal, scale, residuals, g):
+    q, k, v, seg, res = residuals
+    b, l, h, d = q.shape
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    if res is not None:
+        out_bhld, lse, seg3 = res
+        blk = _pick_block(l)
+        dq, dk, dv = _flash_bwd_pallas_seg(
+            _to_bhld(q), _to_bhld(k), _to_bhld(v), out_bhld, lse,
+            _to_bhld(g), seg3, causal, s, block_q=blk, block_k=blk)
+        return (_from_bhld(dq, b, h), _from_bhld(dk, b, h),
+                _from_bhld(dv, b, h), None)
+    _, vjp = jax.vjp(
+        lambda a, b_, c: _sdpa_xla_seg(a, b_, c, seg, causal, s), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+flash_attention_segmented.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)

@@ -47,3 +47,48 @@ class TestNanCheck:
             assert float(out.sum()) == 5.0
         finally:
             paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestNanCheckBatched:
+    def test_batched_flush_names_op(self):
+        """Batched NaN checks: device flags accumulate, one host fetch at
+        the stride/flush point names the offending (op, output)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.core import autograd as ag
+        paddle.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_check_nan_inf_stride": 64})
+        try:
+            x = paddle.to_tensor([1.0, 0.0])
+            y = x / x  # 0/0 -> NaN, but no host sync yet
+            assert ag._nan_pending, "flag should be pending, not fetched"
+            with pytest.raises(FloatingPointError, match="divide"):
+                ag.flush_nan_checks()
+            assert not ag._nan_pending
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+            ag._nan_pending.clear()
+
+    def test_stride_one_is_synchronous(self):
+        import paddle_tpu as paddle
+        paddle.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_check_nan_inf_stride": 1})
+        try:
+            x = paddle.to_tensor([0.0])
+            with pytest.raises(FloatingPointError):
+                x / x
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_backward_flushes(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.core import autograd as ag
+        paddle.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_check_nan_inf_stride": 64})
+        try:
+            x = paddle.to_tensor([0.0], stop_gradient=False)
+            y = (x / x).sum()
+            with pytest.raises(FloatingPointError):
+                y.backward()
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+            ag._nan_pending.clear()

@@ -58,3 +58,86 @@ def test_elastic_restart(tmp_path):
         env={"MARKER": str(tmp_path / "marker")})
     assert proc.returncode == 0, proc.stderr
     assert "resumed ok" in (log / "workerlog.0").read_text()
+
+
+def test_cross_process_collectives(tmp_path):
+    """2-process eager collectives over the TCPStore channel transport
+    (ref: process_group_nccl.cc Send/Recv + store bootstrap)."""
+    proc, log = _run_launch(tmp_path, """
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        dist.init_parallel_env()
+        r, n = dist.get_rank(), dist.get_world_size()
+        assert n == 2, n
+
+        # all_reduce
+        t = paddle.to_tensor(np.full((3,), float(r + 1), np.float32))
+        dist.all_reduce(t)
+        np.testing.assert_allclose(t.numpy(), np.full((3,), 3.0))
+
+        # broadcast from rank 1
+        b = paddle.to_tensor(np.full((2,), float(10 * (r + 1)), np.float32))
+        dist.broadcast(b, src=1)
+        np.testing.assert_allclose(b.numpy(), np.full((2,), 20.0))
+
+        # reduce to dst=1 only
+        d = paddle.to_tensor(np.full((2,), float(r + 1), np.float32))
+        dist.reduce(d, dst=1)
+        expect = 3.0 if r == 1 else float(r + 1)
+        np.testing.assert_allclose(d.numpy(), np.full((2,), expect))
+
+        # p2p: 0 -> 1 twice (FIFO), 1 -> 0 once; interleaved channels
+        if r == 0:
+            dist.send(paddle.to_tensor(np.array([1.0], np.float32)), dst=1)
+            dist.send(paddle.to_tensor(np.array([2.0], np.float32)), dst=1)
+            got = paddle.to_tensor(np.zeros(1, np.float32))
+            dist.recv(got, src=1)
+            assert got.numpy()[0] == 7.0
+        else:
+            dist.send(paddle.to_tensor(np.array([7.0], np.float32)), dst=0)
+            a = paddle.to_tensor(np.zeros(1, np.float32))
+            b2 = paddle.to_tensor(np.zeros(1, np.float32))
+            dist.recv(a, src=0); dist.recv(b2, src=0)
+            assert (a.numpy()[0], b2.numpy()[0]) == (1.0, 2.0)
+
+        # scatter from 0
+        s = paddle.to_tensor(np.zeros((2,), np.float32))
+        if r == 0:
+            dist.scatter(s, [paddle.to_tensor(np.full((2,), 5.0, np.float32)),
+                             paddle.to_tensor(np.full((2,), 9.0, np.float32))],
+                         src=0)
+        else:
+            dist.scatter(s, src=0)
+        np.testing.assert_allclose(s.numpy(),
+                                   np.full((2,), 5.0 if r == 0 else 9.0))
+
+        # alltoall_single: rank r sends [r*10+j] to rank j
+        inp = paddle.to_tensor(
+            np.array([r * 10, r * 10 + 1], np.float32))
+        out = paddle.to_tensor(np.zeros((2,), np.float32))
+        dist.alltoall_single(out, inp)
+        np.testing.assert_allclose(out.numpy(), np.array([r, 10 + r]))
+
+        # object collectives
+        objs = []
+        dist.all_gather_object(objs, {"rank": r})
+        assert objs == [{"rank": 0}, {"rank": 1}]
+        ol = [None]
+        if r == 0:
+            ol = [{"cfg": 42}]
+        dist.broadcast_object_list(ol, src=0)
+        assert ol == [{"cfg": 42}]
+        so = [None]
+        dist.scatter_object_list(so, [["a"], ["b"]] if r == 0 else None,
+                                 src=0)
+        assert so == [["a"] if r == 0 else ["b"]]
+
+        dist.barrier()
+        print("CROSS_PROC_OK rank", r)
+    """, extra=["--nproc_per_node", "2"])
+    assert proc.returncode == 0, proc.stderr + (
+        (log / "workerlog.0").read_text() if log.exists() else "")
+    for i in (0, 1):
+        assert "CROSS_PROC_OK" in (log / f"workerlog.{i}").read_text()
