@@ -279,6 +279,12 @@ def _node_backward_taped(node: GradNode, ct_tensors):
     are themselves differentiable (w.r.t. both the node's primal inputs and
     the incoming cotangents). Used by create_graph=True.
     ref-analog: eager/backward.cc:439 general_grad."""
+    if node.datas is None:
+        raise RuntimeError(
+            f"create_graph backward through {node.name}: the node's "
+            f"forward inputs were already freed by a previous "
+            f"backward(); pass retain_graph=True to the first backward "
+            f"if you need grad-of-grad afterwards")
     nprim = len(node.diff_idx)
 
     def node_grad_fn(*flat):
@@ -301,7 +307,8 @@ def _node_backward_taped(node: GradNode, ct_tensors):
 
 def _run_backward(roots, root_grads, accumulate_into_grad: bool,
                   wanted: Optional[Sequence] = None,
-                  create_graph: bool = False):
+                  create_graph: bool = False,
+                  retain_graph: bool = False):
     """Core backward walk shared by Tensor.backward() and paddle.grad().
 
     ref-analog: eager/backward.cc RunBackward — queue-based topological walk
@@ -365,13 +372,24 @@ def _run_backward(roots, root_grads, accumulate_into_grad: bool,
             full = tuple(
                 _ensure_jnp(c, a) for c, a in zip(cts, node.out_avals))
             in_grads = node.vjp_fn(full)
+            if not retain_graph:
+                # release the retained forward inputs (kept for potential
+                # create_graph re-differentiation) once the node is
+                # consumed — the eager-training memory profile then
+                # matches the plain vjp-residual tape
+                node.fn = node.datas = node.kwargs = None
         for t, g in zip(node.inputs, in_grads):
             if not create_graph:
                 if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
                     continue
                 g = _apply_hooks(t, g)
             elif t._hooks:
-                g = Tensor(_apply_hooks(t, g._data), stop_gradient=True)
+                # hooks receive the live taped Tensor so a hook built from
+                # paddle ops stays differentiable for grad-of-grad
+                for hook in list(t._hooks.values()):
+                    r = hook(g)
+                    if r is not None:
+                        g = r if isinstance(r, Tensor) else _as_t(r)
             if t._node is not None:
                 seed(t._node, t._out_index, g)
                 if t._retain_grads or (wanted_ids and id(t) in wanted_ids):
@@ -438,7 +456,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         else:
             g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         seeds.append(g)
-    _run_backward(tensors, seeds, accumulate_into_grad=True)
+    _run_backward(tensors, seeds, accumulate_into_grad=True,
+                  retain_graph=retain_graph)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
@@ -470,7 +489,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             g = jnp.asarray(g)
         seeds.append(g)
     results = _run_backward(outputs, seeds, accumulate_into_grad=False,
-                            wanted=inputs, create_graph=create_graph)
+                            wanted=inputs, create_graph=create_graph,
+                            retain_graph=bool(retain_graph) or create_graph)
     out = []
     for t in inputs:
         g = results.get(id(t))

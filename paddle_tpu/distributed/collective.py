@@ -258,17 +258,10 @@ def _reduce_parts(parts, op, nranks):
 
 
 def _store_gather_all(g: Group, arr, tag: str):
-    """Every member contributes its array; every member reads all parts.
-    Refcounted cleanup: the last reader deletes the keys."""
-    st = _comm_store()
-    base = f"c{g.id}/{tag}/{_next_seq(g, tag)}"
-    st.set(f"{base}/{g.rank}", _pack(arr))
-    parts = [pickle.loads(st.get(f"{base}/{i}")) for i in range(g.nranks)]
-    if st.add(f"{base}/rc", 1) == g.nranks:
-        for i in range(g.nranks):
-            st.delete(f"{base}/{i}")
-        st.delete(f"{base}/rc")
-    return parts
+    """Every member contributes its array; every member reads all parts
+    (host numpy). Shares the set/read-all/refcounted-delete protocol with
+    _allgather_bytes."""
+    return [pickle.loads(p) for p in _allgather_bytes(g, _pack(arr), tag)]
 
 
 def _store_bcast_bytes(g: Group, payload: Optional[bytes], src_rank: int,
@@ -621,13 +614,13 @@ def send(tensor, dst: int = 0, group: Optional[Group] = None,
     edge FIFO sequence numbers pair each send with its recv."""
     g = _get_group(group)
     if _mode(g) == "local":
-        key = (g.id, max(g.rank, 0), dst)
+        key = (g.id, _global_rank(), dst)
         _mailbox.setdefault(key, []).append(jnp.asarray(_unwrap(tensor)))
         return Task([])
     st = _comm_store()
-    r = g.rank
-    seq = _next_seq(g, f"p2p/{r}>{dst}")
-    st.set(f"c{g.id}/p2p/{r}>{dst}/{seq}", _pack(_unwrap(tensor)))
+    me = _global_rank()  # dst/src are GLOBAL ranks (paddle contract)
+    seq = _next_seq(g, f"p2p/{me}>{dst}")
+    st.set(f"c{g.id}/p2p/{me}>{dst}/{seq}", _pack(_unwrap(tensor)))
     return Task([])
 
 
@@ -635,7 +628,7 @@ def recv(tensor, src: int = 0, group: Optional[Group] = None,
          sync_op: bool = True) -> Task:
     g = _get_group(group)
     if _mode(g) == "local":
-        key = (g.id, src, max(g.rank, 0))
+        key = (g.id, src, _global_rank())
         q = _mailbox.get(key)
         if not q:
             raise RuntimeError(
@@ -644,9 +637,9 @@ def recv(tensor, src: int = 0, group: Optional[Group] = None,
         tensor._data = q.pop(0)
         return Task([])
     st = _comm_store()
-    r = g.rank
-    seq = _next_seq(g, f"p2p/{src}>{r}")
-    tensor._data = _unpack(st.take(f"c{g.id}/p2p/{src}>{r}/{seq}"))
+    me = _global_rank()
+    seq = _next_seq(g, f"p2p/{src}>{me}")
+    tensor._data = _unpack(st.take(f"c{g.id}/p2p/{src}>{me}/{seq}"))
     return Task([tensor._data])
 
 

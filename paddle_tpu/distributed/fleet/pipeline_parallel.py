@@ -86,7 +86,12 @@ class PipelineParallel(Layer):
     def forward_backward_pipeline(self, data, scaler=None):
         """ref: :575 — on a single controller all stages are local, so 1F1B
         degenerates to looped fwd+bwd per micro-batch with grad
-        accumulation (identical numerics and memory shape)."""
+        accumulation (identical numerics and memory shape). Across launched
+        processes (one stage per rank) this runs the real 1F1B schedule
+        with p2p activations/grads over the pp group."""
+        from ..parallel import get_world_size
+        if self.num_stages > 1 and get_world_size() > 1:
+            return self._forward_backward_1f1b_multiproc(data, scaler)
         inputs, labels = data if isinstance(data, (tuple, list)) and \
             len(data) == 2 else (data, None)
         micro_inputs = self._split_micro(inputs)
@@ -102,6 +107,80 @@ class PipelineParallel(Layer):
             self.total_loss = (loss if self.total_loss is None else
                                Tensor(self.total_loss._data + loss._data))
         return Tensor(self.total_loss._data / self.accumulate_steps)
+
+    def _forward_backward_1f1b_multiproc(self, data, scaler):
+        """Cross-process 1F1B (ref: pipeline_parallel.py:575-720 — warmup
+        forwards, steady interleaved fwd/bwd, cooldown backwards).
+        Activations/grads are exchanged with the eager p2p channel
+        (ref: pp_utils/p2p_communication.py:576 _p2p_helper; shapes ride
+        inside the message, so no separate meta handshake is needed)."""
+        import jax.numpy as jnp
+        from ..collective import broadcast, recv, send
+
+        g = self._hcg.get_pipe_parallel_group()
+        pp_ranks = g.ranks
+        s, S, M = self.stage_id, self.num_stages, self.accumulate_steps
+        prev_rank = pp_ranks[s - 1] if s > 0 else None
+        next_rank = pp_ranks[s + 1] if s < S - 1 else None
+
+        inputs, labels = data if isinstance(data, (tuple, list)) and \
+            len(data) == 2 else (data, None)
+        micro_inputs = self._split_micro(inputs) if self.is_first_stage \
+            else [None] * M
+        micro_labels = self._split_micro(labels) if self.is_last_stage \
+            else [None] * M
+        self.total_loss = None
+
+        def do_forward(m):
+            if self.is_first_stage:
+                x = micro_inputs[m]
+            else:
+                x = Tensor(jnp.zeros((1,), jnp.float32))
+                recv(x, src=prev_rank, group=g)
+                x.stop_gradient = False  # leaf: backward fills x.grad
+            out = self._layers(x) if not isinstance(x, (tuple, list)) \
+                else self._layers(*x)
+            if self.is_last_stage:
+                loss = self._layers._loss_fn(out, micro_labels[m])
+                if isinstance(loss, Tensor) and loss._data.ndim > 0:
+                    loss = loss.mean()
+                self.total_loss = (loss if self.total_loss is None else
+                                   Tensor(self.total_loss._data +
+                                          loss._data))
+                return x, loss
+            send(out, dst=next_rank, group=g)
+            return x, out
+
+        def do_backward(x, out):
+            if self.is_last_stage:
+                scaled = scaler.scale(out) if scaler is not None else out
+                self._backward_step(apply_scale(scaled, 1.0 / M))
+            else:
+                og = Tensor(jnp.zeros((1,), jnp.float32))
+                recv(og, src=next_rank, group=g)
+                self._backward_step(out, og)
+            if not self.is_first_stage:
+                send(x.grad, dst=prev_rank, group=g)
+
+        warmup = min(S - 1 - s, M)
+        queue = []
+        m_fwd = 0
+        for _ in range(warmup):
+            queue.append(do_forward(m_fwd))
+            m_fwd += 1
+        for _ in range(M - warmup):          # steady 1F1B
+            queue.append(do_forward(m_fwd))
+            m_fwd += 1
+            do_backward(*queue.pop(0))
+        while queue:                         # cooldown
+            do_backward(*queue.pop(0))
+
+        # surface the last stage's mean loss on every rank
+        loss_t = Tensor(
+            (self.total_loss._data / M) if self.total_loss is not None
+            else jnp.zeros((), jnp.float32))
+        broadcast(loss_t, src=pp_ranks[-1], group=g)
+        return loss_t
 
     def train_batch(self, data, optimizer=None, lr_scheduler=None,
                     scaler=None):

@@ -139,6 +139,7 @@ def flashmask_attention(query, key, value, startend_row_indices,
     def f(q, k, v, se):
         lq, lk = q.shape[1], k.shape[1]
         rows = jnp.arange(lq).reshape(1, 1, lq, 1)   # i (query/row)
+        cols = jnp.arange(lk).reshape(1, 1, 1, lk)   # j (key/col)
         se = se.astype(jnp.int32)                     # [B, H1, Lk, C]
         c = se.shape[-1]
         lts = se[..., 0][:, :, None, :]               # [B, H1, 1, Lk]
@@ -164,6 +165,14 @@ def flashmask_attention(query, key, value, startend_row_indices,
             else:
                 raise ValueError(
                     f"non-causal flashmask expects 2 or 4 columns, got {c}")
+        if window_size is not None:
+            # sliding window (left, right): only keys within
+            # [i - left, i + right] may attend
+            left, right = (window_size if isinstance(window_size,
+                                                     (tuple, list))
+                           else (window_size, window_size))
+            masked = masked | (cols < rows - int(left)) | \
+                (cols > rows + int(right))
         mask = jnp.where(masked, -1e30, 0.0).astype(jnp.float32)
         return _sdpa_reference(q, k, v, mask=mask, causal=causal)
 

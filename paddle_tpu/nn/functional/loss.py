@@ -564,8 +564,11 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
     """
     from ...distributed import collective as coll
 
-    mp = group is not False and group is not None
-    g = coll._get_group(group) if mp else None
+    # reference semantics: group=None -> default group (model parallel),
+    # group=False -> data parallel (no cross-rank softmax)
+    mp = group is not False
+    g = coll._get_group(None if group in (None, True) else group) \
+        if mp else None
     class_offset = 0
     if mp and g.nranks > 1:
         # class-sharded logits: global class id offset of this rank

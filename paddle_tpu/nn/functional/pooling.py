@@ -229,40 +229,46 @@ def _max_pool_with_mask(x, kernel, stride, padding, nd, op_name,
 
     def f(a):
         spatial = a.shape[2:]
-        # finite sentinel: patches are extracted via a one-hot convolution
-        # where 0 * -inf would poison real windows with NaN
-        neg = (jnp.finfo(jnp.float32).min / 2
-               if jnp.issubdtype(a.dtype, jnp.floating)
-               else jnp.iinfo(a.dtype).min)
-        full_pad = [(0, 0), (0, 0)] + [tuple(p) for p in pad]
+        # window geometry is shape-static: build host-side index tables
+        # (flat gather index per (output position, window offset)) so
+        # values never round-trip through float32 and indices stay exact
+        pads = [tuple(p) for p in pad]
         if ceil_mode:
-            # extend right pad so the last partial window is included
+            pads = list(pads)
             for i in range(nd):
-                lo, hi = full_pad[2 + i]
-                total = spatial[i] + lo + hi - k[i]
-                rem = total % s[i]
+                lo, hi = pads[i]
+                rem = (spatial[i] + lo + hi - k[i]) % s[i]
                 if rem != 0:
-                    full_pad[2 + i] = (lo, hi + (s[i] - rem))
-        ap = jnp.pad(a, full_pad, constant_values=neg)
-        # flat *unpadded* spatial index carried alongside each element
-        idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
-        idx = jnp.broadcast_to(idx, a.shape)
-        idxp = jnp.pad(idx, full_pad, constant_values=-1)
-        # extract windows: patches of shape (N, C*prod(k), *out_spatial)
-        patches = jax.lax.conv_general_dilated_patches(
-            ap.astype(jnp.float32), k, s, "VALID")
-        n, _, *out_sp = patches.shape
-        c = a.shape[1]
-        patches = patches.reshape(n, c, int(np.prod(k)), *out_sp)
-        arg = jnp.argmax(patches, axis=2)  # in-window offset
-        idx_patches = jax.lax.conv_general_dilated_patches(
-            idxp.astype(jnp.float32), k, s, "VALID").reshape(
-            n, c, int(np.prod(k)), *out_sp)
-        mask = jnp.take_along_axis(
-            idx_patches, arg[:, :, None], axis=2).squeeze(2).astype(jnp.int32)
-        vals = jnp.take_along_axis(
-            patches, arg[:, :, None], axis=2).squeeze(2).astype(a.dtype)
-        return vals, mask
+                    pads[i] = (lo, hi + (s[i] - rem))
+        out_sp = [(spatial[i] + pads[i][0] + pads[i][1] - k[i]) // s[i] + 1
+                  for i in range(nd)]
+        # per-dim absolute input coordinate (may be out of range = padding)
+        coord = np.meshgrid(*[
+            np.arange(out_sp[i]) * s[i] - pads[i][0]
+            for i in range(nd)], indexing="ij")  # each [*out_sp]
+        offs = np.meshgrid(*[np.arange(k[i]) for i in range(nd)],
+                           indexing="ij")
+        flat_strides = [int(np.prod(spatial[i + 1:])) for i in range(nd)]
+        gidx = np.zeros((int(np.prod(out_sp)), int(np.prod(k))), np.int64)
+        valid = np.ones_like(gidx, bool)
+        for i in range(nd):
+            ci = (coord[i].reshape(-1, 1) +
+                  offs[i].reshape(1, -1))  # [P, K] abs coord in dim i
+            valid &= (ci >= 0) & (ci < spatial[i])
+            gidx += np.clip(ci, 0, spatial[i] - 1) * flat_strides[i]
+        gidx = np.where(valid, gidx, 0)
+        n, c = a.shape[:2]
+        flat = a.reshape(n, c, -1)
+        wins = flat[:, :, jnp.asarray(gidx)]          # [N, C, P, K] native
+        neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+               else jnp.iinfo(a.dtype).min)
+        wins = jnp.where(jnp.asarray(valid)[None, None], wins, neg)
+        arg = jnp.argmax(wins, axis=-1)               # [N, C, P]
+        vals = jnp.take_along_axis(wins, arg[..., None], -1)[..., 0]
+        mask = jnp.asarray(gidx.astype(np.int32))[
+            jnp.arange(gidx.shape[0])[None, None], arg]
+        return (vals.reshape(n, c, *out_sp).astype(a.dtype),
+                mask.reshape(n, c, *out_sp))
 
     return apply_op(f, x, op_name=op_name)
 

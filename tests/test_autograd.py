@@ -286,3 +286,29 @@ class TestDoubleGrad:
         z = (gw * w).sum()
         gx, = paddle.grad(z, [w])
         np.testing.assert_allclose(gx.numpy(), gw.numpy())
+
+
+class TestDoubleGradEdgeCases:
+    def test_hook_stays_differentiable(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        x.register_hook(lambda g: g * 2)
+        y = x * x * x
+        g, = paddle.grad(y, [x], create_graph=True)
+        assert g.item() == pytest.approx(54.0)   # hook doubles 3x^2
+        gg, = paddle.grad(g, [x])
+        # hook fires on every backward: 2 * d(6x^2)/dx = 2 * 12x
+        assert gg.item() == pytest.approx(72.0)
+
+    def test_inputs_freed_after_plain_backward(self):
+        a = paddle.to_tensor(2.0, stop_gradient=False)
+        b = a * a
+        b.backward()
+        with pytest.raises(RuntimeError, match="already freed"):
+            paddle.grad(b, [a], create_graph=True)
+
+    def test_retain_graph_keeps_double_grad_alive(self):
+        a = paddle.to_tensor(2.0, stop_gradient=False)
+        c = a * a
+        c.backward(retain_graph=True)
+        g, = paddle.grad(c, [a], create_graph=True)
+        assert g.item() == pytest.approx(4.0)

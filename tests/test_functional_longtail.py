@@ -596,3 +596,29 @@ class TestReviewRegressions:
                 paddle.to_tensor(np.zeros(2, np.int64)), 4,
                 paddle.to_tensor(np.zeros((3, 3), np.float32)),
                 path_table=paddle.to_tensor(np.zeros((2, 2), np.int64)))
+
+
+class TestReviewRegressions2:
+    def test_int_pooling_mask_exact_above_2_24(self):
+        big = np.random.randint(0, 2 ** 30, (1, 1, 6, 6)).astype(np.int32)
+        o, m = F.max_pool2d(paddle.to_tensor(big), 2, 2, return_mask=True)
+        exp = big.reshape(1, 1, 3, 2, 3, 2).max(3).max(4)
+        np.testing.assert_array_equal(o.numpy(), exp)
+
+    def test_flashmask_window_size(self):
+        import jax.numpy as jnp
+        from paddle_tpu.nn.functional.attention import _sdpa_reference
+        L, D = 8, 8
+        q = np.random.randn(1, L, 1, D).astype(np.float32)
+        sr = np.full((1, 1, L, 1), L, np.int32)
+        got = F.flashmask_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(sr), causal=True, window_size=2).numpy()
+        rows = np.arange(L)[:, None]
+        cols = np.arange(L)[None, :]
+        wmask = np.where((cols < rows - 2) | (cols > rows + 2),
+                         -1e30, 0.0)[None, None].astype(np.float32)
+        exp = np.asarray(_sdpa_reference(
+            jnp.asarray(q), jnp.asarray(q), jnp.asarray(q),
+            mask=jnp.asarray(wmask), causal=True))
+        np.testing.assert_allclose(got, exp, rtol=2e-4, atol=1e-5)
