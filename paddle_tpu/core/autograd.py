@@ -174,6 +174,10 @@ def _maybe_check_nan_inf(name: str, outs) -> None:
 # tape; None in the (default) eager mode — one global check per op.
 _op_recorder = None
 
+# SOT hook: notified when a backward walk starts (a recorded trace that
+# ran autograd internally cannot be replayed as pure forward segments).
+_backward_observer = None
+
 
 def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     """Run ``fn`` (a pure JAX function) on mixed Tensor/raw args, recording a
@@ -319,6 +323,8 @@ def _run_backward(roots, root_grads, accumulate_into_grad: bool,
     grad-of-grad.
     """
     from .tensor import Tensor
+    if _backward_observer is not None:
+        _backward_observer()
 
     def _add(a, b):
         if create_graph and (isinstance(a, Tensor) or isinstance(b, Tensor)):

@@ -90,7 +90,14 @@ def default_generator() -> Generator:
     return _default_generator
 
 
+# SOT tracer hook: observes RNG draws during recording (a recorded trace
+# that consumed randomness must not be replayed with frozen keys).
+_key_observer = None
+
+
 def next_key():
+    if _key_observer is not None:
+        _key_observer()
     if _stream_stack:
         return _stream_stack[-1].next_key()
     return _default_generator.next_key()

@@ -21,6 +21,12 @@ from . import dtype as dtype_mod
 from .autograd import apply_op, backward as _backward, is_grad_enabled
 
 
+# SOT (dy2static) hooks: the graph-break tracer installs these to observe
+# host-value materializations (guards) and in-place buffer mutations.
+_materialize_hook = None
+_mutation_hook = None
+
+
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
                  "_retain_grads", "_hooks", "_hook_counter", "name",
@@ -79,17 +85,25 @@ class Tensor:
 
     # -- host interop -------------------------------------------------------
     def numpy(self):
+        if _materialize_hook is not None:
+            _materialize_hook(self, "numpy")
         return np.asarray(self._data)
 
     def item(self, *args):
+        if _materialize_hook is not None:
+            _materialize_hook(self, "item")
         if args:
             return np.asarray(self._data).item(*args)
         return np.asarray(self._data).item()
 
     def tolist(self):
+        if _materialize_hook is not None:
+            _materialize_hook(self, "numpy")
         return np.asarray(self._data).tolist()
 
     def __array__(self, dtype=None):
+        if _materialize_hook is not None:
+            _materialize_hook(self, "numpy")
         a = np.asarray(self._data)
         return a.astype(dtype) if dtype is not None else a
 
@@ -158,6 +172,8 @@ class Tensor:
 
     # -- mutation (leaf-only, used by optimizers / state loading) -----------
     def set_value(self, value):
+        if _mutation_hook is not None:
+            _mutation_hook(self)
         if isinstance(value, Tensor):
             value = value._data
         self._data = jnp.asarray(value, self._data.dtype).reshape(
@@ -168,10 +184,14 @@ class Tensor:
         return self.set_value(other)
 
     def fill_(self, value):
+        if _mutation_hook is not None:
+            _mutation_hook(self)
         self._data = jnp.full_like(self._data, value)
         return self
 
     def zero_(self):
+        if _mutation_hook is not None:
+            _mutation_hook(self)
         self._data = jnp.zeros_like(self._data)
         return self
 
@@ -219,6 +239,8 @@ class Tensor:
             idx = tuple(i._data if isinstance(i, Tensor) else i for i in idx)
         if isinstance(value, Tensor):
             value = value._data
+        if _mutation_hook is not None:
+            _mutation_hook(self)
         self._data = self._data.at[idx].set(value)
 
     def __iter__(self):

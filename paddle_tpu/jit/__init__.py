@@ -9,3 +9,4 @@ trace path).
 """
 from .api import to_static, functionalize, TrainStep, save, load, not_to_static  # noqa: F401
 from .api import ignore_module  # noqa: F401
+from .sot import sot_compile, SOTFunction, BucketPolicy  # noqa: F401

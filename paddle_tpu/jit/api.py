@@ -162,10 +162,36 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
-    """ref: python/paddle/jit/api.py to_static. Decorator or direct call."""
+              backend=None, full_graph=False, bucket_policy=None, **kwargs):
+    """ref: python/paddle/jit/api.py to_static.
+
+    full_graph=False (default, the reference's SOT mode): op-level tracer
+    with graph breaks — data-dependent Python control flow works; breaks
+    become guards, paths replay compiled, non-replayable traces (RNG /
+    in-place mutation / inner backward) fall back to eager
+    (see paddle_tpu.jit.sot).
+
+    full_graph=True (the reference's AST mode): whole-program jax.jit —
+    fastest when the function is fully traceable (no data-dependent
+    control flow), with proper functionalization of Layer params/buffers
+    and RNG.
+    """
     def decorate(fn):
-        return StaticFunction(fn, input_spec, **kwargs)
+        if full_graph:
+            return StaticFunction(fn, input_spec, **kwargs)
+        from .sot import SOTFunction
+        from ..nn.layer import Layer
+        if isinstance(fn, Layer):
+            # patch forward in place so the object keeps its Layer API
+            # (parameters/train/eval/state_dict, jit.save) — the
+            # reference's to_static(layer) likewise returns the layer
+            # with a StaticFunction forward
+            sot = SOTFunction(fn.forward, bucket_policy=bucket_policy,
+                              input_spec=input_spec)
+            fn.forward = sot
+            return fn
+        return SOTFunction(fn, bucket_policy=bucket_policy,
+                           input_spec=input_spec)
     if function is not None:
         return decorate(function)
     return decorate

@@ -1,0 +1,547 @@
+"""SOT-style dy2static: guarded compiled subgraphs with graph breaks.
+
+The reference compiles arbitrary user Python with a CPython-bytecode
+tracer (ref: python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py — guard-based cache, graph-break fallback) plus an AST
+transpiler (python/paddle/jit/dy2static/). A bytecode interpreter is the
+wrong tool on TPU, where every tensor op already flows through ONE
+dispatch point (core.autograd.apply_op). This tracer therefore works at
+the op-dispatch level:
+
+- **Record**: run the function EAGERLY (so it is always correct, any
+  Python allowed) while logging each apply_op into the current *segment*.
+  When Python forces a host value out of a tensor (``bool()``/``item()``/
+  ``.numpy()`` — i.e. data-dependent control flow), the segment is closed
+  and the extracted value becomes a **guard** (the analog of the
+  reference's graph break + guard).
+- **Replay**: later calls with the same input signature execute the
+  recorded segments as jit-compiled programs; after each break the guard
+  tensor is fetched and compared against the recorded path. Matching
+  paths run fully compiled; a mismatch re-records that branch (the trace
+  tree grows one path per taken branch, e.g. one per while-loop trip
+  count).
+- **Fallback**: recordings that consumed RNG (dropout) or mutated
+  buffers in place (BN train-mode running stats) are marked non-
+  replayable — those calls simply stay eager, which is the reference's
+  graph-break fallback contract with correctness guaranteed.
+
+Dynamic shapes: the compile cache is keyed on input signatures and
+LRU-bounded (FLAGS_sot_cache_size). Axes declared dynamic via
+``BucketPolicy`` are padded up to the next bucket so varlen batches
+reuse a bounded set of entries instead of compiling per length.
+"""
+from __future__ import annotations
+
+import warnings
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core import tensor as tensor_mod
+from ..core import autograd as autograd_mod
+from ..core.flags import define_flag, flag_value
+from ..core.tensor import Tensor
+
+__all__ = ["sot_compile", "SOTFunction", "BucketPolicy"]
+
+define_flag("sot_cache_size", 64,
+            "Max (signature, guard-path) entries in a SOTFunction's "
+            "compile cache (LRU eviction)")
+
+
+class BucketPolicy:
+    """Pad dynamic axes up to bucket sizes so varlen inputs share compiled
+    entries. ``axes`` maps arg index -> {axis: buckets}; ``buckets`` is a
+    sorted list of sizes, or "pow2" for powers of two.
+
+    Padding uses ``pad_value`` — choose it so the padded region is
+    numerically inert for your model (e.g. the loss ignore_index for
+    token ids, 0 for already-masked activations). This is an explicit
+    policy, not silent magic: bucketing changes tensor shapes the
+    function sees.
+    """
+
+    def __init__(self, axes: Dict[int, Dict[int, Any]], pad_value=0):
+        self.axes = axes
+        self.pad_value = pad_value
+
+    def bucket_of(self, size: int, buckets) -> int:
+        if buckets == "pow2":
+            b = 1
+            while b < size:
+                b *= 2
+            return b
+        for b in buckets:
+            if b >= size:
+                return int(b)
+        return int(buckets[-1])  # larger than every bucket: use max
+
+    def apply(self, args: tuple):
+        out = list(args)
+        for idx, ax_map in self.axes.items():
+            if idx >= len(out) or not isinstance(out[idx], Tensor):
+                continue
+            arr = out[idx]._data
+            pads = [(0, 0)] * arr.ndim
+            changed = False
+            for axis, buckets in ax_map.items():
+                size = arr.shape[axis]
+                tgt = self.bucket_of(size, buckets)
+                if tgt > size:
+                    pads[axis] = (0, tgt - size)
+                    changed = True
+            if changed:
+                arr = jnp.pad(arr, pads, constant_values=self.pad_value)
+                out[idx] = Tensor(arr, stop_gradient=out[idx].stop_gradient)
+        return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# recording structures
+# ---------------------------------------------------------------------------
+
+class _Op:
+    __slots__ = ("fn", "arg_refs", "kwargs", "out_ids", "multi")
+
+    def __init__(self, fn, arg_refs, kwargs, out_ids, multi):
+        self.fn = fn            # pure jax fn captured at record time
+        self.arg_refs = arg_refs  # list of ("id", sot_id) | ("ext", Tensor) | ("lit", value)
+        self.kwargs = kwargs
+        self.out_ids = out_ids
+        self.multi = multi
+
+
+class _Segment:
+    __slots__ = ("ops", "jitted", "input_ids", "ext_tensors", "output_ids")
+
+    def __init__(self):
+        self.ops: List[_Op] = []
+        self.jitted = None
+        self.input_ids: List[int] = []
+        self.ext_tensors: List[Tensor] = []
+        self.output_ids: List[int] = []
+
+
+class _Guard:
+    __slots__ = ("tensor_id", "kind", "value")
+
+    def __init__(self, tensor_id, kind, value):
+        self.tensor_id = tensor_id
+        self.kind = kind        # "item" | "numpy"
+        self.value = value      # python scalar or small-ndarray bytes
+
+
+class _Recording:
+    """One straight-line trace: segments alternating with guards, plus the
+    provenance of the final return value."""
+
+    __slots__ = ("segments", "guards", "ext_guards", "result_spec",
+                 "replayable", "why_not")
+
+    def __init__(self):
+        self.segments: List[_Segment] = []
+        self.guards: List[_Guard] = []
+        # (Tensor ref, bytes): captured tensors whose host value steered
+        # Python during recording — re-checked up front at every replay
+        self.ext_guards: List[Tuple[Tensor, bytes]] = []
+        self.result_spec = None
+        self.replayable = True
+        self.why_not = ""
+
+
+_MAX_GUARD_BYTES = 256
+
+
+class _Recorder:
+    """Installs the apply_op / materialize / mutation / rng hooks for the
+    duration of one eagerly-executed call."""
+
+    def __init__(self):
+        self.rec = _Recording()
+        self.cur = _Segment()
+        self.next_id = 0
+        self.tensor_ids: Dict[int, int] = {}   # id(Tensor) -> sot id
+        self.keepalive: List[Tensor] = []      # pin tensors so ids stay valid
+        self.produced_in_cur: set = set()
+        self.guard_values: List[Any] = []
+
+    # -- id helpers --------------------------------------------------------
+    def tag(self, t: Tensor) -> int:
+        sid = self.next_id
+        self.next_id += 1
+        self.tensor_ids[id(t)] = sid
+        self.keepalive.append(t)
+        return sid
+
+    def ref_of(self, t: Tensor):
+        sid = self.tensor_ids.get(id(t))
+        if sid is None:
+            return ("ext", t)      # parameter / captured tensor
+        return ("id", sid)
+
+    # -- hooks -------------------------------------------------------------
+    def on_op(self, fn, args, kwargs, outs, name):
+        arg_refs = []
+        for a in args:
+            if isinstance(a, Tensor):
+                arg_refs.append(self.ref_of(a))
+            else:
+                arg_refs.append(("lit", a))
+        out_ids = []
+        for o in outs:
+            sid = self.tag(o)
+            out_ids.append(sid)
+            self.produced_in_cur.add(sid)
+        self.cur.ops.append(
+            _Op(fn, arg_refs, dict(kwargs), out_ids, len(outs) > 1))
+
+    def on_materialize(self, t: Tensor, kind: str):
+        sid = self.tensor_ids.get(id(t))
+        arr = np.asarray(t._data)
+        if arr.nbytes > _MAX_GUARD_BYTES:
+            self.rec.replayable = False
+            self.rec.why_not = (
+                f"materialized a {arr.nbytes}-byte tensor into Python "
+                f"(> {_MAX_GUARD_BYTES}B guard limit)")
+            return
+        value = arr.tobytes()
+        if sid is None:
+            # a tensor from outside the trace (captured param/const)
+            # steered Python: guard on its value directly
+            self.rec.ext_guards.append((t, value))
+            return
+        self._break(sid, kind, value)
+
+    def on_mutation(self, t: Tensor):
+        self.rec.replayable = False
+        self.rec.why_not = "in-place tensor mutation during trace"
+
+    def on_rng(self):
+        self.rec.replayable = False
+        self.rec.why_not = "RNG consumed during trace (e.g. dropout)"
+
+    def on_backward(self):
+        self.rec.replayable = False
+        self.rec.why_not = "autograd backward ran during trace"
+
+    def _break(self, sid: int, kind: str, value):
+        # only tensors produced in the CURRENT segment need exporting from
+        # it; guards on inputs or earlier-segment outputs read the replay
+        # env directly
+        extra = [sid] if sid in self.produced_in_cur else []
+        self._close_segment(extra_outputs=extra)
+        self.rec.guards.append(_Guard(sid, kind, value))
+
+    def _close_segment(self, extra_outputs=()):
+        seg = self.cur
+        for sid in extra_outputs:
+            if sid not in seg.output_ids:
+                seg.output_ids.append(sid)
+        self.rec.segments.append(seg)
+        self.cur = _Segment()
+        self.produced_in_cur = set()
+
+    # -- finalize ----------------------------------------------------------
+    def finish(self, result):
+        # mark every id consumed by later segments / the result as a
+        # segment output, and compute each segment's inputs
+        def result_refs(r):
+            if isinstance(r, Tensor):
+                return self.ref_of(r)
+            if isinstance(r, (list, tuple)):
+                return (type(r).__name__,
+                        [result_refs(v) for v in r])
+            if isinstance(r, dict):
+                return ("dict", {k: result_refs(v) for k, v in r.items()})
+            return ("lit", r)
+
+        self._close_segment()
+        self.rec.result_spec = result_refs(result)
+
+        produced_by = {}
+        for si, seg in enumerate(self.rec.segments):
+            for op in seg.ops:
+                for oid in op.out_ids:
+                    produced_by[oid] = si
+
+        needed_after: Dict[int, set] = {}
+
+        def note_need(sid, at_seg):
+            src = produced_by.get(sid)
+            if src is not None and src != at_seg:
+                needed_after.setdefault(src, set()).add(sid)
+
+        for si, seg in enumerate(self.rec.segments):
+            for op in seg.ops:
+                for kind, v in op.arg_refs:
+                    if kind == "id":
+                        note_need(v, si)
+
+        def walk_result(spec):
+            kind = spec[0]
+            if kind == "id":
+                note_need(spec[1], -1)
+            elif kind in ("list", "tuple"):
+                for v in spec[1]:
+                    walk_result(v)
+            elif kind == "dict":
+                for v in spec[1].values():
+                    walk_result(v)
+
+        walk_result(self.rec.result_spec)
+        # a guard read after later segments still needs its producer to
+        # export it
+        for g in self.rec.guards:
+            note_need(g.tensor_id, -1)
+
+        for si, seg in enumerate(self.rec.segments):
+            outs = set(seg.output_ids) | needed_after.get(si, set())
+            seg.output_ids = sorted(outs)
+            ins = []
+            exts = []
+            seen_ext = set()
+            local = {oid for op in seg.ops for oid in op.out_ids}
+            for op in seg.ops:
+                for kind, v in op.arg_refs:
+                    if kind == "id" and v not in local and v not in ins:
+                        ins.append(v)
+                    elif kind == "ext" and id(v) not in seen_ext:
+                        seen_ext.add(id(v))
+                        exts.append(v)
+            seg.input_ids = ins
+            seg.ext_tensors = exts
+        return self.rec
+
+
+class _RecorderSession:
+    def __init__(self, recorder: _Recorder):
+        self.recorder = recorder
+
+    def __enter__(self):
+        r = self.recorder
+        if autograd_mod._op_recorder is not None:
+            raise RuntimeError(
+                "SOT recording cannot nest with static-graph recording")
+        autograd_mod._op_recorder = \
+            lambda fn, args, kwargs, outs, name: r.on_op(
+                fn, args, kwargs, outs, name)
+        tensor_mod._materialize_hook = r.on_materialize
+        tensor_mod._mutation_hook = r.on_mutation
+        random_mod._key_observer = r.on_rng
+        autograd_mod._backward_observer = r.on_backward
+        return r
+
+    def __exit__(self, *exc):
+        autograd_mod._op_recorder = None
+        tensor_mod._materialize_hook = None
+        tensor_mod._mutation_hook = None
+        random_mod._key_observer = None
+        autograd_mod._backward_observer = None
+        return False
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+def _compile_segment(seg: _Segment):
+    """Build one jitted callable: (ext_arrays, input_arrays) -> outputs."""
+    ops = seg.ops
+    input_ids = list(seg.input_ids)
+    output_ids = list(seg.output_ids)
+
+    def seg_fn(ext_vals, in_vals):
+        env: Dict[int, Any] = dict(zip(input_ids, in_vals))
+        ext_map = {id(t): v for t, v in zip(seg.ext_tensors, ext_vals)}
+        for op in ops:
+            call = []
+            for kind, v in op.arg_refs:
+                if kind == "id":
+                    call.append(env[v])
+                elif kind == "ext":
+                    call.append(ext_map[id(v)])
+                else:
+                    call.append(v)
+            res = op.fn(*call, **op.kwargs)
+            res = tuple(res) if op.multi else (res,)
+            for oid, r in zip(op.out_ids, res):
+                env[oid] = r
+        return [env[o] for o in output_ids]
+
+    return jax.jit(seg_fn)
+
+
+class _CompiledPath:
+    """One guard path of one signature: compiled segments + guards."""
+
+    def __init__(self, rec: _Recording, input_ids: List[int]):
+        self.rec = rec
+        self.input_ids = input_ids
+        for seg in rec.segments:
+            seg.jitted = _compile_segment(seg)
+
+    def replay(self, input_tensors: List[Tensor]):
+        """Returns (ok, result). ok=False on a guard miss.
+
+        Each segment executes through apply_op, so replayed outputs carry
+        tape nodes: loss.backward() after a replayed call differentiates
+        THROUGH the compiled segments into the inputs and the captured
+        parameters (apply_op takes jax.vjp of the jitted segment — the
+        jit boundary is kept as a call primitive, so it stays compiled).
+        """
+        from ..core.autograd import apply_op
+        rec = self.rec
+        for t, val in rec.ext_guards:
+            if np.asarray(t._data).tobytes() != val:
+                return False, None
+        env: Dict[int, Tensor] = dict(zip(self.input_ids, input_tensors))
+        for si, seg in enumerate(rec.segments):
+            n_ext = len(seg.ext_tensors)
+            in_tensors = [env[i] for i in seg.input_ids]
+            if seg.ops:
+                jitted = seg.jitted
+
+                def run_seg(*flat, _j=jitted, _n=n_ext):
+                    return tuple(_j(list(flat[:_n]), list(flat[_n:])))
+
+                outs = apply_op(run_seg, *seg.ext_tensors, *in_tensors,
+                                op_name="sot_segment")
+                if not isinstance(outs, tuple):
+                    outs = (outs,)
+                for oid, o in zip(seg.output_ids, outs):
+                    env[oid] = o
+            if si < len(rec.guards):
+                g = rec.guards[si]
+                got = np.asarray(env[g.tensor_id]._data).tobytes()
+                if got != g.value:
+                    return False, None  # guard miss
+        return True, self._build_result(env)
+
+    def _build_result(self, env):
+        def build(spec):
+            kind = spec[0]
+            if kind == "id":
+                return env[spec[1]]
+            if kind == "ext":
+                return spec[1]
+            if kind in ("list", "tuple"):
+                vals = [build(v) for v in spec[1]]
+                return tuple(vals) if kind == "tuple" else vals
+            if kind == "dict":
+                return {k: build(v) for k, v in spec[1].items()}
+            return spec[1]
+        return build(self.rec.result_spec)
+
+
+class SOTFunction:
+    """paddle.jit.to_static with graph breaks (see module docstring)."""
+
+    def __init__(self, fn: Callable, bucket_policy: Optional[BucketPolicy]
+                 = None, name: Optional[str] = None, input_spec=None):
+        self._fn = fn
+        self._bucket = bucket_policy
+        self.input_spec = input_spec  # kept for save/export tooling parity
+        self._name = name or getattr(fn, "__name__", "fn")
+        # (signature, guard-values-tuple) -> _CompiledPath | "eager"
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._warned = set()
+
+    # -- signature ---------------------------------------------------------
+    @staticmethod
+    def _arg_key(a):
+        if isinstance(a, Tensor):
+            return ("T", tuple(a._data.shape), str(a._data.dtype),
+                    not a.stop_gradient)
+        if isinstance(a, (np.ndarray, jax.Array)):
+            # raw arrays are baked into the trace as constants, so the
+            # key must cover their CONTENT (repr truncates large arrays)
+            import hashlib
+            arr = np.asarray(a)
+            return ("A", arr.shape, str(arr.dtype),
+                    hashlib.sha1(arr.tobytes()).hexdigest())
+        return ("L", repr(a))
+
+    def _signature(self, args, kwargs):
+        parts = [self._arg_key(a) for a in args]
+        for k in sorted(kwargs):
+            parts.append((k, self._arg_key(kwargs[k])))
+        return tuple(parts)
+
+    def _cache_put(self, key, value):
+        self._cache[key] = value
+        self._cache.move_to_end(key)
+        limit = max(int(flag_value("sot_cache_size") or 64), 1)
+        while len(self._cache) > limit:
+            self._cache.popitem(last=False)
+
+    def cache_size(self):
+        return len(self._cache)
+
+    @staticmethod
+    def _tensor_args(args, kwargs):
+        return [a for a in args if isinstance(a, Tensor)] + \
+            [kwargs[k] for k in sorted(kwargs)
+             if isinstance(kwargs[k], Tensor)]
+
+    # -- record ------------------------------------------------------------
+    def _record(self, sig, args, kwargs):
+        rec_obj = _Recorder()
+        tensor_args = self._tensor_args(args, kwargs)
+        input_ids = [rec_obj.tag(t) for t in tensor_args]
+        with _RecorderSession(rec_obj):
+            result = self._fn(*args, **kwargs)
+        rec = rec_obj.finish(result)
+        guard_path = tuple(g.value for g in rec.guards)
+        if rec.replayable:
+            path = _CompiledPath(rec, input_ids)
+            self._cache_put((sig, guard_path), path)
+        else:
+            self._cache_put((sig, ()), "eager")
+            if self._name not in self._warned:
+                self._warned.add(self._name)
+                warnings.warn(
+                    f"to_static({self._name}): trace is not replayable "
+                    f"({rec.why_not}); running eagerly (graph-break "
+                    f"fallback)", stacklevel=3)
+        return result
+
+    # -- call --------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        # nested under an active recording (outer SOTFunction or static
+        # program tape): run the plain function so the OUTER recorder sees
+        # every op — an inner replay would hide ops behind opaque ext refs
+        if autograd_mod._op_recorder is not None:
+            return self._fn(*args, **kwargs)
+        if self._bucket is not None:
+            args = self._bucket.apply(args)
+        sig = self._signature(args, kwargs)
+        if self._cache.get((sig, ())) == "eager":
+            self._cache.move_to_end((sig, ()))
+            return self._fn(*args, **kwargs)
+
+        tensor_args = self._tensor_args(args, kwargs)
+        # candidate paths for this signature, most-recently-used first.
+        # Each replay re-checks its own guards, so trying candidates in
+        # order is always correct; a taken-branch set of size k costs at
+        # most k replay attempts before falling back to re-recording.
+        candidates = [(k, v) for k, v in reversed(self._cache.items())
+                      if k[0] == sig and v != "eager"]
+        for key, path in candidates:
+            ok, result = path.replay(tensor_args)
+            if ok:
+                self._cache.move_to_end(key)
+                return result
+        return self._record(sig, args, kwargs)
+
+
+def sot_compile(fn=None, bucket_policy: Optional[BucketPolicy] = None):
+    """Decorator form: @sot_compile or sot_compile(fn, bucket_policy=...)."""
+    def deco(f):
+        return SOTFunction(f, bucket_policy)
+    if fn is not None:
+        return deco(fn)
+    return deco

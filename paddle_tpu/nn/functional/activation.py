@@ -202,6 +202,9 @@ def _inplace(fn):
 
     @functools.wraps(fn)
     def wrapper(x, *args, **kwargs):
+        from ...core import tensor as tensor_mod
+        if tensor_mod._mutation_hook is not None:
+            tensor_mod._mutation_hook(x)
         out = fn(x, *args, **kwargs)
         x._data = out._data
         x._node = out._node

@@ -143,6 +143,11 @@ class Layer:
         return [p for _, p in self.named_parameters(
             include_sublayers=include_sublayers)]
 
+    def clear_gradients(self):
+        """ref: nn/layer/layers.py Layer.clear_gradients."""
+        for p in self.parameters():
+            p.clear_grad()
+
     def named_buffers(self, prefix="", include_sublayers=True):
         for name, b in self._buffers.items():
             if b is not None:

@@ -24,6 +24,9 @@ def cast(x, dtype):
 
 
 def increment(x, value=1.0, name=None):
+    from ..core import tensor as tensor_mod
+    if tensor_mod._mutation_hook is not None:
+        tensor_mod._mutation_hook(x)
     x._data = x._data + value
     return x
 
@@ -115,23 +118,33 @@ def _patch_operators():
     Tensor.__or__ = _binary_op(m.logical_or)
     Tensor.__xor__ = _binary_op(m.logical_xor)
 
-    # in-place arithmetic used by optimizers / user code on leaves
+    # in-place arithmetic used by optimizers / user code on leaves; the
+    # mutation hook keeps the SOT tracer honest about buffer rebinds
+    def _notify(self):
+        from ..core import tensor as tensor_mod
+        if tensor_mod._mutation_hook is not None:
+            tensor_mod._mutation_hook(self)
+
     def _iadd(self, other):
+        _notify(self)
         self._data = self._data + (other._data if isinstance(other, Tensor)
                                    else other)
         return self
 
     def _isub(self, other):
+        _notify(self)
         self._data = self._data - (other._data if isinstance(other, Tensor)
                                    else other)
         return self
 
     def _imul(self, other):
+        _notify(self)
         self._data = self._data * (other._data if isinstance(other, Tensor)
                                    else other)
         return self
 
     def _idiv(self, other):
+        _notify(self)
         self._data = self._data / (other._data if isinstance(other, Tensor)
                                    else other)
         return self
@@ -140,7 +153,9 @@ def _patch_operators():
     Tensor.subtract_ = _isub
     Tensor.multiply_ = _imul
     Tensor.divide_ = _idiv
+
     def _iscale(self, scale=1.0, bias=0.0, bias_after_scale=True):
+        _notify(self)
         if bias_after_scale:
             self._data = self._data * scale + bias
         else:

@@ -54,6 +54,9 @@ def _make_inplace(fname):
         return None
 
     def inplace(self, *args, **kwargs):
+        from ..core import tensor as tensor_mod
+        if tensor_mod._mutation_hook is not None:
+            tensor_mod._mutation_hook(self)
         out = fn(self, *args, **kwargs)
         self._data = out._data if isinstance(out, Tensor) else out
         return self
