@@ -195,8 +195,13 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     # AMP hook (the analog of the generated ad_func AMP block,
     # ref: multiply_fwd_func.cc:49-70)
     from ..amp.auto_cast import _state as _amp_state, maybe_cast_inputs
+    record_fn = fn
     if _amp_state.enabled:
         datas = maybe_cast_inputs(name, datas)
+        # recorders (SOT/static tape) must capture the cast too, so a
+        # replayed program reproduces the same AMP numerics
+        def record_fn(*a, _fn=fn, _name=name, **kw):
+            return _fn(*maybe_cast_inputs(_name, list(a)), **kw)
 
     diff_idx = [
         i for i, a in enumerate(args)
@@ -212,7 +217,7 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
         _maybe_check_nan_inf(name, outs)
         wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
         if _op_recorder is not None:
-            _op_recorder(fn, args, kwargs, wrapped, name)
+            _op_recorder(record_fn, args, kwargs, wrapped, name)
         return wrapped if multi else wrapped[0]
 
     struct = {"multi": False}
@@ -238,7 +243,7 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
         Tensor(o, stop_gradient=False, node=node, out_index=k)
         for k, o in enumerate(outs))
     if _op_recorder is not None:
-        _op_recorder(fn, args, kwargs, wrapped, name)
+        _op_recorder(record_fn, args, kwargs, wrapped, name)
     if not multi:
         return wrapped[0]
     return wrapped

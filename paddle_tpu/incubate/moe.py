@@ -108,11 +108,21 @@ class MoELayer(Layer):
 
     def __init__(self, d_model: int, d_hidden: int, num_experts: int,
                  gate: str = "gshard", top_k: int = 2,
-                 capacity_factor: float = 1.25, activation: str = "gelu"):
+                 capacity_factor: float = 1.25, activation: str = "gelu",
+                 dispatch_mode: str = "index"):
         super().__init__()
         self.d_model = d_model
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
+        if dispatch_mode not in ("index", "dense"):
+            raise ValueError(
+                f"dispatch_mode must be 'index' or 'dense', got "
+                f"{dispatch_mode!r}")
+        # "index": gather/scatter dispatch + grouped-matmul experts,
+        # O(E*C*H) (see incubate.moe_dispatch — the scalable path).
+        # "dense": one-hot einsum oracle, O(T*E*C*H) (kept as the
+        # numeric reference the tests align against).
+        self.dispatch_mode = dispatch_mode
         if gate == "naive":
             self.gate = NaiveGate(d_model, num_experts, top_k)
         elif gate == "switch":
@@ -142,18 +152,29 @@ class MoELayer(Layer):
         act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
                "silu": jax.nn.silu}[self.activation]
 
-        def impl(x_arr, gate_w, w_in, w_out):
-            tokens = x_arr.reshape(b * l, h)
-            logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
-            combine, dispatch, aux = _gshard_dispatch(
-                logits, self.top_k, capacity)
-            # dispatch: [T,E,C] x [T,H] -> [E,C,H]  (the alltoall moment)
-            xs = jnp.einsum("tec,th->ech", dispatch.astype(x_arr.dtype),
-                            tokens)
-            hdn = act(jnp.einsum("ech,ehf->ecf", xs, w_in))
-            ys = jnp.einsum("ecf,efh->ech", hdn, w_out)
-            out = jnp.einsum("tec,ech->th", combine.astype(x_arr.dtype), ys)
-            return out.reshape(b, l, h), aux
+        if self.dispatch_mode == "index":
+            from .moe_dispatch import moe_forward_indices
+
+            def impl(x_arr, gate_w, w_in, w_out):
+                tokens = x_arr.reshape(b * l, h)
+                out, aux = moe_forward_indices(
+                    tokens, gate_w, w_in, w_out, self.top_k, capacity, act)
+                return out.reshape(b, l, h), aux
+        else:
+            def impl(x_arr, gate_w, w_in, w_out):
+                tokens = x_arr.reshape(b * l, h)
+                logits = tokens.astype(jnp.float32) @ gate_w.astype(
+                    jnp.float32)
+                combine, dispatch, aux = _gshard_dispatch(
+                    logits, self.top_k, capacity)
+                # dispatch: [T,E,C] x [T,H] -> [E,C,H] (the alltoall moment)
+                xs = jnp.einsum("tec,th->ech", dispatch.astype(x_arr.dtype),
+                                tokens)
+                hdn = act(jnp.einsum("ech,ehf->ecf", xs, w_in))
+                ys = jnp.einsum("ecf,efh->ech", hdn, w_out)
+                out = jnp.einsum("tec,ech->th",
+                                 combine.astype(x_arr.dtype), ys)
+                return out.reshape(b, l, h), aux
 
         out, aux = apply_op(impl, x, self.gate.weight, self.w_in,
                             self.w_out, op_name="moe_layer")

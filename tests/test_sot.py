@@ -312,3 +312,65 @@ class TestReviewFixes:
         out = net(x)
         out2 = net(x)
         np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-6)
+
+
+class TestReviewFixes2:
+    def test_train_eval_mode_separates_cache(self):
+        """net.eval() trace must not replay for net.train() calls (review:
+        mode is part of the signature, like the reference's attribute
+        guards)."""
+        net = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+        sf = SOTFunction(lambda t: net(t))
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        net.eval()
+        e1 = sf(x)
+        e2 = sf(x)
+        np.testing.assert_allclose(e1.numpy(), e2.numpy())  # deterministic
+        net.train()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            t1 = sf(x)
+            t2 = sf(x)
+        # train mode: dropout live (eager fallback), differs from eval out
+        assert not np.allclose(t1.numpy(), e1.numpy())
+        assert not np.allclose(t1.numpy(), t2.numpy())
+        net.eval()
+        e3 = sf(x)  # eval path still compiled and correct
+        np.testing.assert_allclose(e3.numpy(), e1.numpy())
+
+    def test_amp_replay_reproduces_autocast(self):
+        net = nn.Linear(16, 16)
+        sf = SOTFunction(lambda t: net(t))
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        with paddle.amp.auto_cast(level="O2"):
+            a1 = sf(x)   # record under AMP
+            a2 = sf(x)   # replay under AMP
+        np.testing.assert_allclose(a1.numpy(), a2.numpy())
+        f1 = sf(x)       # records a separate non-AMP path
+        f2 = sf(x)
+        np.testing.assert_allclose(f1.numpy(), f2.numpy(), rtol=1e-6)
+        # AMP output is bf16-rounded -> differs from the fp32 path
+        assert a1.numpy().dtype != f1.numpy().dtype or \
+            not np.array_equal(a1.numpy(), f1.numpy())
+
+    def test_eager_branch_does_not_evict_compiled_sibling(self):
+        flag = paddle.to_tensor(np.float32(1.0))
+
+        def f(x):
+            if (flag):
+                return x * 2          # pure branch
+            return paddle.nn.functional.dropout(x, 0.5)  # rng branch
+
+        sf = SOTFunction(f)
+        x = paddle.to_tensor(np.ones((8,), np.float32))
+        r1 = sf(x)
+        np.testing.assert_allclose(r1.numpy(), 2.0)
+        flag.set_value(np.float32(0.0))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sf(x)                     # rng branch -> eager marker
+        flag.set_value(np.float32(1.0))
+        calls = sf.cache_size()
+        r3 = sf(x)                    # compiled pure path must survive
+        np.testing.assert_allclose(r3.numpy(), 2.0)
+        assert sf.cache_size() == calls  # replayed, not re-recorded
