@@ -27,6 +27,22 @@ from .parallel import (  # noqa: F401
 
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import io  # noqa: F401
+from . import launch  # noqa: F401
+from .auto_parallel.api_ext import (  # noqa: F401
+    shard_optimizer, shard_scaler, shard_dataloader, ShardDataloader,
+    ShardingStage1, ShardingStage2, ShardingStage3, Strategy, DistModel,
+    to_static,
+)
+from .misc import (  # noqa: F401
+    ParallelMode, ReduceType, gather, wait, gloo_init_parallel_env,
+    gloo_barrier, gloo_release,
+)
+from .spawn import spawn  # noqa: F401
+from .ps_compat import (  # noqa: F401
+    ProbabilityEntry, CountFilterEntry, ShowClickEntry, InMemoryDataset,
+    QueueDataset,
+)
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from .watchdog import Watchdog, WatchdogBusy, WatchdogTimeout  # noqa: F401

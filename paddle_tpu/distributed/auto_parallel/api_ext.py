@@ -1,0 +1,376 @@
+"""Semi-auto parallel API completion: shard_optimizer / shard_scaler /
+shard_dataloader, sharding-stage shard_fns, and dist.to_static
+(Strategy + DistModel).
+
+ref: python/paddle/distributed/auto_parallel/api.py:1613 (shard_optimizer
++ ShardingStage1/2/3), :2132 (shard_scaler), :2715 (shard_dataloader),
+and the to_static/DistModel machinery in the same file. TPU-native: a
+"distributed view" of the optimizer means optimizer-state arrays carry
+the placements the shard_fn decides (GSPMD then keeps every update local
+to the shard owner — the ZeRO contract); to_static compiles the whole
+train step with DistTrainStep instead of building a static Program.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+from ..api import DistAttr, _named_sharding, shard_tensor
+from ..placement import Partial, Replicate, Shard
+from ..process_mesh import ProcessMesh
+
+__all__ = [
+    "shard_optimizer", "shard_scaler", "shard_dataloader",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "Strategy", "DistModel", "to_static", "ShardDataloader",
+]
+
+
+# ---------------------------------------------------------------------------
+# sharding-stage shard_fns (ref: api.py _ShardingStageBase and subclasses)
+# ---------------------------------------------------------------------------
+
+class _ShardingStageBase:
+    def __init__(self, mesh: Optional[ProcessMesh] = None):
+        self._mesh = mesh
+        self._sharding_mesh_axis = 0
+
+    def _set_sharding_mesh_axis(self, axis: int):
+        self._sharding_mesh_axis = axis
+
+    def _mesh_of(self, param: Tensor) -> Optional[ProcessMesh]:
+        if param._dist_attr is not None:
+            return param._dist_attr.process_mesh
+        return self._mesh
+
+    def _param_placements(self, param: Tensor,
+                          mesh: ProcessMesh) -> List:
+        if param._dist_attr is not None:
+            return list(param._dist_attr.placements)
+        return [Replicate() for _ in range(mesh.ndim)]
+
+
+def _apply_placements(arr, mesh: ProcessMesh, placements) -> Any:
+    return jax.device_put(
+        arr, _named_sharding(mesh, placements, np.ndim(arr)))
+
+
+class ShardingStage1(_ShardingStageBase):
+    """Builtin shard_fn: optimizer momenta sharded along the sharding mesh
+    axis, scalar betas replicated (ref: api.py ShardingStage1)."""
+
+    def __call__(self, key: str, param: Tensor, accumulator: Tensor):
+        mesh = self._mesh_of(param)
+        if mesh is None:
+            return accumulator
+        acc = accumulator._data if isinstance(accumulator, Tensor) \
+            else accumulator
+        placements = self._param_placements(param, mesh)
+        if "beta" not in key and np.ndim(acc) > 0:
+            # add sharding on dim 0 via the sharding mesh axis unless some
+            # axis already shards it
+            if not any(isinstance(p, Shard) for p in placements):
+                placements[self._sharding_mesh_axis] = Shard(0)
+        else:
+            placements = [Replicate() for _ in range(mesh.ndim)]
+        out = Tensor(_apply_placements(acc, mesh, placements))
+        out._dist_attr = DistAttr(mesh, placements)
+        return out
+
+
+class ShardingStage2(ShardingStage1):
+    """Stage 2 == stage 1 for optimizer-state placement purposes under
+    GSPMD (gradient sharding comes from the compiled reduce-scatter —
+    ref: api.py ShardingStage2 shares stage 1's accumulator rule)."""
+
+
+class ShardingStage3(_ShardingStageBase):
+    """Builtin shard_fn: accumulators inherit the (fully sharded) param
+    placements (ref: api.py ShardingStage3)."""
+
+    def __call__(self, key: str, param: Tensor, accumulator: Tensor):
+        mesh = self._mesh_of(param)
+        if mesh is None:
+            return accumulator
+        acc = accumulator._data if isinstance(accumulator, Tensor) \
+            else accumulator
+        placements = self._param_placements(param, mesh)
+        if np.ndim(acc) == 0 or "beta" in key:
+            placements = [Replicate() for _ in range(mesh.ndim)]
+        out = Tensor(_apply_placements(acc, mesh, placements))
+        out._dist_attr = DistAttr(mesh, placements)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# shard_optimizer / shard_scaler / shard_dataloader
+# ---------------------------------------------------------------------------
+
+class _ShardOptimizer:
+    """Distributed view of an optimizer: every state slot created by
+    _init_state is placed by shard_fn (or inherits its param's sharding).
+    Everything else delegates, so it drops into both the eager step() path
+    and DistTrainStep."""
+
+    def __init__(self, optimizer, shard_fn=None,
+                 gradient_accumulation_steps: int = 1):
+        self.__dict__["_inner"] = optimizer
+        self.__dict__["_shard_fn"] = shard_fn
+        self.__dict__["gradient_accumulation_steps"] = \
+            gradient_accumulation_steps
+        # the wrapper must also intercept the INNER's own calls (step()
+        # uses self._state_for -> self._init_state), so patch the instance
+        orig = optimizer._init_state
+
+        def sharded_init(p, _orig=orig, _self=self):
+            slots = dict(_orig(p))
+            for name, v in slots.items():
+                slots[name] = _self._place_slot(name, p, v)
+            return slots
+
+        optimizer._init_state = sharded_init
+
+    def _place_slot(self, name, p, v):
+        if not hasattr(v, "shape"):
+            return v
+        if self._shard_fn is not None:
+            out = self._shard_fn(name, p, Tensor(v))
+            return out._data if isinstance(out, Tensor) else out
+        # default: pass down the param's own placements to same-shaped
+        # slots (ref: shard_optimizer docstring)
+        arr = getattr(p, "_data", p)
+        if hasattr(arr, "sharding") and getattr(v, "shape", None) == \
+                arr.shape:
+            return jax.device_put(v, arr.sharding)
+        return v
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __setattr__(self, name, value):
+        setattr(self._inner, name, value)
+
+
+def shard_optimizer(optimizer, shard_fn=None,
+                    gradient_accumulation_steps: int = 1):
+    """ref: auto_parallel/api.py:1613 shard_optimizer."""
+    return _ShardOptimizer(optimizer, shard_fn,
+                           gradient_accumulation_steps)
+
+
+def shard_scaler(scaler):
+    """ref: auto_parallel/api.py:2132 shard_scaler — the found-inf flag is
+    agreed across ranks so every rank skips the same steps. On a single
+    controller the grads are already global; the cross-process eager path
+    ORs the flag over the default group."""
+    orig_unscale = scaler.unscale_
+
+    def unscale_(optimizer, _orig=orig_unscale, _s=scaler):
+        _orig(optimizer)
+        from .. import collective as coll
+        g = coll._get_group(None)
+        if coll._mode(g) != "local":
+            flag = Tensor(np.asarray([1.0 if _s._found_inf else 0.0],
+                                     np.float32))
+            coll.all_reduce(flag, coll.ReduceOp.MAX, g)
+            _s._found_inf = bool(np.asarray(flag._data)[0] > 0)
+
+    scaler.unscale_ = unscale_
+    return scaler
+
+
+class ShardDataloader:
+    """ref: auto_parallel/api.py ShardDataloader — wraps a DataLoader so
+    every batch element comes out as a DistTensor on the mesh, sharded on
+    the batch dim along ``shard_dims`` (data parallel)."""
+
+    def __init__(self, dataloader, meshes, input_keys=None,
+                 shard_dims=None, is_dataset_splitted: bool = False):
+        self._loader = dataloader
+        self._meshes = meshes if isinstance(meshes, (list, tuple)) \
+            else [meshes]
+        self._input_keys = input_keys
+        self._shard_dims = shard_dims
+        self._is_split = is_dataset_splitted
+
+    def _mesh_for(self, i: int) -> ProcessMesh:
+        return self._meshes[min(i, len(self._meshes) - 1)]
+
+    def _placements_for(self, i: int, ndim: int):
+        mesh = self._mesh_for(i)
+        placements = [Replicate() for _ in range(mesh.ndim)]
+        sd = self._shard_dims
+        if isinstance(sd, (list, tuple)):
+            sd = sd[min(i, len(sd) - 1)]
+        if sd is not None:
+            axis = sd if isinstance(sd, int) else \
+                mesh.dim_names.index(sd)
+            placements[axis] = Shard(0)
+        return placements
+
+    def _shard_item(self, i, item):
+        if isinstance(item, (list, tuple)):
+            return type(item)(self._shard_item(i, v) for v in item)
+        if isinstance(item, dict):
+            return {k: self._shard_item(i, v) for k, v in item.items()}
+        t = item if isinstance(item, Tensor) else Tensor(
+            jax.numpy.asarray(np.asarray(item)))
+        mesh = self._mesh_for(i)
+        return shard_tensor(t, mesh,
+                            self._placements_for(i, t._data.ndim))
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                keys = self._input_keys or list(batch.keys())
+                yield {k: self._shard_item(j, batch[k])
+                       for j, k in enumerate(keys)}
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(
+                    self._shard_item(j, v) for j, v in enumerate(batch))
+            else:
+                yield self._shard_item(0, batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted: bool = False) -> ShardDataloader:
+    """ref: auto_parallel/api.py:2715 shard_dataloader."""
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)
+
+
+# ---------------------------------------------------------------------------
+# Strategy + DistModel + dist.to_static
+# ---------------------------------------------------------------------------
+
+class _Config:
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Strategy:
+    """ref: auto_parallel/api.py Strategy — sharding / fused_passes /
+    gradient_merge / pipeline / amp knobs for the compiled program."""
+
+    def __init__(self, config: Optional[Dict] = None):
+        cfg = config or {}
+
+        def sub(name, **defaults):
+            defaults.update(cfg.get(name, {}))
+            return _Config(**defaults)
+
+        self.sharding = sub("sharding", enable=False, stage=1, degree=8)
+        self.fused_passes = sub("fused_passes", enable=False,
+                                fused_passes_list=[])
+        self.gradient_merge = sub("gradient_merge", enable=False,
+                                  k_steps=1, avg=True)
+        self.pipeline = sub("pipeline", enable=False,
+                            schedule_mode="1F1B", micro_batch_size=1,
+                            accumulate_steps=1)
+        self.amp = sub("amp", enable=False, dtype="bfloat16", level="O1")
+
+    def __repr__(self):
+        return (f"Strategy(sharding={self.sharding}, "
+                f"pipeline={self.pipeline}, amp={self.amp})")
+
+
+class DistModel:
+    """ref: auto_parallel/api.py DistModel — the compiled distributed
+    program with train/eval/predict modes. Here the 'static graph' is the
+    jitted whole-train-step (DistTrainStep) / jitted forward."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy: Optional[Strategy] = None, metrics=None):
+        from ..dist_train import DistTrainStep
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = None
+        self._step = None
+        if loss is not None and optimizer is not None:
+            def loss_fn(out, *labels):
+                if callable(loss) and not hasattr(loss, "forward"):
+                    return loss(out, *labels)
+                return loss(out, *labels)
+            self._step = DistTrainStep(layer, loss_fn, optimizer)
+            self.train()
+        else:
+            self.predict()
+
+    # -- modes (ref: DistModel.train/eval/predict) -------------------------
+    def train(self):
+        if self._step is None:
+            raise RuntimeError(
+                "DistModel needs loss and optimizer for train mode "
+                "(pass them to dist.to_static)")
+        self._mode = "train"
+        self.network.train()
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise RuntimeError("DistModel needs a loss for eval mode")
+        self._mode = "eval"
+        self.network.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+        return self
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def __call__(self, *batch):
+        if self._mode == "train":
+            return self._step(*batch)
+        inputs = [b if isinstance(b, Tensor) else Tensor(
+            jax.numpy.asarray(np.asarray(b))) for b in batch]
+        if self._mode == "eval":
+            *xs, label = inputs
+            out = self.network(*xs)
+            return self._loss(out, label)
+        return self.network(*inputs)
+
+    # -- state (ref: DistModel.state_dict / dist_main_program) -------------
+    def state_dict(self, mode: str = "all") -> Dict[str, Tensor]:
+        out = {}
+        if mode in ("all", "param"):
+            out.update(self.network.state_dict())
+        if mode in ("all", "opt") and self._step is not None:
+            out.update(self._step.state_dict())
+        return out
+
+    def set_state_dict(self, state_dict):
+        params = {k: v for k, v in state_dict.items() if "#" not in k}
+        opt = {k: v for k, v in state_dict.items() if "#" in k}
+        if params:
+            self.network.set_state_dict(params)
+        if opt and self._step is not None:
+            self._step.set_state_dict(opt)
+
+    def dist_main_program(self, mode=None):
+        return None  # no Program IR: the program is the jitted step
+
+    def dist_startup_program(self, mode=None):
+        return None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy: Optional[Strategy] = None) -> DistModel:
+    """ref: auto_parallel/api.py to_static -> DistModel."""
+    inner = optimizer._inner if isinstance(optimizer, _ShardOptimizer) \
+        else optimizer
+    return DistModel(layer, loader, loss, inner, strategy)

@@ -1,0 +1,45 @@
+"""paddle.distributed.io: persistable-variable save/load.
+
+ref: python/paddle/distributed/io.py (save_persistables /
+load_persistables / is_persistable over static Programs). Here the
+persistable set is a Layer's parameters + buffers; the on-disk format is
+the framework's .pdparams state-dict, so artifacts interoperate with
+paddle_tpu.save/load.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    """ref: distributed/io.py is_persistable — parameters and buffers
+    persist; activations don't."""
+    from ..core.tensor import Parameter
+    return isinstance(var, Parameter) or getattr(var, "persistable", False)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """ref: distributed/io.py save_persistables. ``main_program`` here is
+    the Layer holding the persistables (the static-Program form has no
+    TPU analog — the jitted step owns no variables)."""
+    import paddle_tpu as paddle
+    layer = main_program if main_program is not None else executor
+    if not hasattr(layer, "state_dict"):
+        raise TypeError(
+            "save_persistables needs a Layer (parameters + buffers); "
+            f"got {type(layer).__name__}")
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "__paddle_tpu_persistables__")
+    paddle.save(layer.state_dict(), path + ".pdparams")
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """ref: distributed/io.py load_persistables."""
+    import paddle_tpu as paddle
+    layer = main_program if main_program is not None else executor
+    path = os.path.join(dirname, filename or "__paddle_tpu_persistables__")
+    state = paddle.load(path + ".pdparams")
+    layer.set_state_dict(state)
+    return layer

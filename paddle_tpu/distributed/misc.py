@@ -1,0 +1,114 @@
+"""Distributed API odds and ends: ParallelMode / ReduceType enums,
+gather, wait, gloo_* CPU-rendezvous helpers.
+
+ref: python/paddle/distributed/fleet/base/topology.py:42 (ParallelMode),
+paddle/phi/core/distributed/auto_parallel/dist_attr.h ReduceType,
+python/paddle/distributed/communication/gather.py, parallel.py
+(gloo_init_parallel_env / gloo_barrier / gloo_release — here the TCPStore
+plays gloo's rendezvous role).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import collective as coll
+
+__all__ = ["ParallelMode", "ReduceType", "gather", "wait",
+           "gloo_init_parallel_env", "gloo_barrier", "gloo_release"]
+
+
+class ParallelMode:
+    """ref: fleet/base/topology.py:42."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """ref: phi ReduceType (dist_attr.h) — partial-placement reductions."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+def gather(tensor, gather_list: Optional[List] = None, dst: int = 0,
+           group=None, sync_op: bool = True):
+    """ref: communication/gather.py — dst collects every rank's tensor
+    into gather_list; other ranks pass gather_list=None."""
+    g = coll._get_group(group)
+    m = coll._mode(g)
+    if m == "local":
+        if gather_list is not None:
+            for _ in range(g.nranks):
+                gather_list.append(Tensor(jnp.asarray(coll._unwrap(tensor))))
+        return coll.Task([])
+    dr = g.get_group_rank(dst)
+    if m == "store":
+        st = coll._comm_store()
+        base = f"c{g.id}/ga/{coll._next_seq(g, 'ga')}"
+        if g.rank == dr:
+            parts = []
+            for i in range(g.nranks):
+                if i == dr:
+                    parts.append(np.asarray(coll._unwrap(tensor)))
+                else:
+                    import pickle
+                    parts.append(pickle.loads(st.take(f"{base}/{i}")))
+            if gather_list is not None:
+                gather_list.extend(Tensor(jnp.asarray(p)) for p in parts)
+        else:
+            st.set(f"{base}/{g.rank}", coll._pack(coll._unwrap(tensor)))
+        return coll.Task([])
+    tmp: List = []
+    coll.all_gather(tmp, tensor, group=g)
+    if g.rank == dr and gather_list is not None:
+        gather_list.extend(tmp)
+    return coll.Task([])
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True):
+    """ref: communication/wait.py — barrier on a tensor's readiness. On
+    TPU a host value fetch is the only trustworthy barrier."""
+    arr = coll._unwrap(tensor)
+    if hasattr(arr, "block_until_ready"):
+        arr.block_until_ready()
+    return None
+
+
+_gloo_ready = False
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str):
+    """ref: parallel.py gloo_init_parallel_env — CPU-only rendezvous; the
+    TCPStore is the gloo-equivalent coordinator here."""
+    import os
+    global _gloo_ready
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("PADDLE_MASTER", server_endpoint)
+    coll._comm_store()  # brings up / connects the store
+    _gloo_ready = True
+
+
+def gloo_barrier():
+    """ref: parallel.py gloo_barrier."""
+    coll.barrier()
+
+
+def gloo_release():
+    """ref: parallel.py gloo_release."""
+    global _gloo_ready
+    coll.destroy_process_group()
+    _gloo_ready = False

@@ -1,0 +1,156 @@
+"""Parameter-server dataset/entry API surface.
+
+ref: python/paddle/distributed/entry_attr.py (ProbabilityEntry,
+CountFilterEntry, ShowClickEntry) and fleet InMemoryDataset/QueueDataset
+(python/paddle/distributed/fleet/dataset/dataset.py). The brpc PS *runtime*
+is a documented non-goal (SURVEY.md §7 — sparse-CTR stack); these classes
+cover the configuration surface and a minimal host-side slot-file
+pipeline so data-side code written against the reference API runs.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry", "ShowClickEntry",
+           "InMemoryDataset", "QueueDataset"]
+
+
+class EntryAttr:
+    """ref: entry_attr.py EntryAttr base."""
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """ref: entry_attr.py ProbabilityEntry(probability)."""
+
+    def __init__(self, probability: float):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """ref: entry_attr.py CountFilterEntry(count_filter)."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    """ref: entry_attr.py ShowClickEntry(show_name, click_name)."""
+
+    def __init__(self, show_name: str, click_name: str):
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._show_name}:{self._click_name}"
+
+
+class _SlotDataset:
+    """Shared minimal slot-file pipeline: whitespace 'slot:value' lines ->
+    per-slot numpy arrays, batched."""
+
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._use_vars: List[str] = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._pipe_command = ""
+        self._samples: List[dict] = []
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command="cat", input_type=0, fs_name="", fs_ugi="",
+             **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_vars = [getattr(v, "name", str(v))
+                          for v in (use_var or [])]
+        self._pipe_command = pipe_command
+        return self
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def get_filelist(self) -> List[str]:
+        return self._filelist
+
+    def _parse(self):
+        self._samples = []
+        for path in self._filelist:
+            if not os.path.exists(path):
+                raise FileNotFoundError(path)
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    sample: dict = {}
+                    for tok in parts:
+                        if ":" in tok:
+                            slot, val = tok.split(":", 1)
+                            sample.setdefault(slot, []).append(float(val))
+                    self._samples.append(sample)
+
+    def __iter__(self):
+        keys = self._use_vars or sorted(
+            {k for s in self._samples for k in s})
+        for i in range(0, len(self._samples), self._batch_size):
+            chunk = self._samples[i:i + self._batch_size]
+            batch = {}
+            for k in keys:
+                rows = [s.get(k, [0.0]) for s in chunk]
+                width = max(len(r) for r in rows)  # pad ragged slots
+                batch[k] = np.asarray(
+                    [r + [0.0] * (width - len(r)) for r in rows],
+                    dtype=np.float32)
+            yield batch
+
+
+class InMemoryDataset(_SlotDataset):
+    """ref: fleet/dataset InMemoryDataset — loads slot files into host
+    memory with shuffle support."""
+
+    def load_into_memory(self):
+        self._parse()
+
+    def get_memory_data_size(self) -> int:
+        return len(self._samples)
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return len(self._samples)
+
+
+class QueueDataset(_SlotDataset):
+    """ref: fleet/dataset QueueDataset — streaming variant (files parsed
+    lazily per epoch)."""
+
+    def __iter__(self):
+        self._parse()
+        return super().__iter__()

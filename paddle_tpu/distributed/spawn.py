@@ -1,0 +1,78 @@
+"""paddle.distributed.spawn: programmatic multi-process launch.
+
+ref: python/paddle/distributed/spawn.py — starts nprocs worker processes
+running ``func(*args)`` with the same rank environment the launch CLI
+injects (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER), and
+joins them. Uses the multiprocessing "spawn" start method (fork is unsafe
+once the XLA runtime is up).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Optional, Sequence
+
+__all__ = ["spawn", "ProcessContext"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(func, args, rank, nprocs, master, env_extra, backend):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+    os.environ["PADDLE_MASTER"] = master
+    os.environ["MASTER_ADDR"], os.environ["MASTER_PORT"] = \
+        master.rsplit(":", 1)
+    for k, v in (env_extra or {}).items():
+        os.environ[k] = str(v)
+    func(*args)
+
+
+class ProcessContext:
+    """ref: spawn.py MultiprocessContext — join()/processes accessors."""
+
+    def __init__(self, processes):
+        self.processes = processes
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        for p in self.processes:
+            p.join(timeout)
+        failed = [p for p in self.processes
+                  if p.exitcode not in (0, None)]
+        if failed:
+            raise RuntimeError(
+                f"{len(failed)} spawned process(es) failed with exit "
+                f"codes {[p.exitcode for p in failed]}")
+        return all(p.exitcode == 0 for p in self.processes)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options):
+    """ref: spawn.py spawn(func, args, nprocs, join, daemon)."""
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if nprocs <= 1:
+            import jax
+            nprocs = max(jax.local_device_count(), 1)
+    master = options.get("master",
+                         f"127.0.0.1:{options.get('port', _free_port())}")
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(
+            target=_worker,
+            args=(func, tuple(args), rank, nprocs, master,
+                  options.get("env"), options.get("backend")),
+            daemon=daemon)
+        p.start()
+        procs.append(p)
+    context = ProcessContext(procs)
+    if join:
+        context.join()
+    return context

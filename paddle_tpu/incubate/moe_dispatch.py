@@ -102,8 +102,7 @@ def moe_forward_indices(tokens, gate_w, w_in, w_out, top_k: int,
     xs = jnp.where(slot_used[..., None], xs, 0).astype(tokens.dtype)
 
     block_t = 128 if c % 128 == 0 else (c if c % 8 == 0 else 0)
-    if block_t and _use_pallas(e * c, h, f, block_t) and f % 128 == 0 \
-            and h % 128 == 0:
+    if block_t and _use_pallas(e * c, h, f, block_t):
         tile_ids = jnp.repeat(jnp.arange(e, dtype=jnp.int32), c // block_t)
         gs = jnp.full((e,), c, jnp.int32)
         hdn = act(grouped_matmul(xs.reshape(e * c, h), w_in, gs,

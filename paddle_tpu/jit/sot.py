@@ -163,18 +163,25 @@ _digest_memo: Dict[int, Tuple[Any, tuple]] = {}
 def _content_digest(a):
     import hashlib
     import weakref
+    # memoize ONLY for jax.Array: device buffers are immutable, so the
+    # digest stays valid for the object's lifetime. Mutable host arrays
+    # (np.ndarray) are re-hashed every call — host sha1 is cheap and a
+    # stale digest would silently replay old constants.
+    memoizable = isinstance(a, jax.Array)
     key = id(a)
-    hit = _digest_memo.get(key)
-    if hit is not None and hit[0]() is a:
-        return hit[1]
+    if memoizable:
+        hit = _digest_memo.get(key)
+        if hit is not None and hit[0]() is a:
+            return hit[1]
     arr = np.asarray(a)
     dig = (arr.shape, str(arr.dtype),
            hashlib.sha1(arr.tobytes()).hexdigest())
-    try:
-        _digest_memo[key] = (weakref.ref(
-            a, lambda _: _digest_memo.pop(key, None)), dig)
-    except TypeError:
-        pass  # object not weakref-able: just skip the memo
+    if memoizable:
+        try:
+            _digest_memo[key] = (weakref.ref(
+                a, lambda _: _digest_memo.pop(key, None)), dig)
+        except TypeError:
+            pass
     return dig
 
 
@@ -479,16 +486,31 @@ class SOTFunction:
         # reference SOT guarding attribute reads.
         from ..nn.layer import Layer
         self._layers = []
-        bound = getattr(fn, "__self__", None)
-        if isinstance(bound, Layer):
-            self._layers.append(bound)
+
+        def note(v):
+            if isinstance(v, Layer) and v not in self._layers:
+                self._layers.append(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, Layer):
+                        note(x)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    if isinstance(x, Layer):
+                        note(x)
+
+        note(getattr(fn, "__self__", None))
         for cell in getattr(fn, "__closure__", None) or ():
             try:
-                v = cell.cell_contents
+                note(cell.cell_contents)
             except ValueError:
                 continue
-            if isinstance(v, Layer):
-                self._layers.append(v)
+        # module-global Layers the code actually references (co_names)
+        code = getattr(fn, "__code__", None)
+        gl = getattr(fn, "__globals__", None)
+        if code is not None and gl is not None:
+            for name in code.co_names:
+                note(gl.get(name))
 
     # -- signature ---------------------------------------------------------
     @staticmethod
@@ -515,8 +537,12 @@ class SOTFunction:
             sub.training for lyr in self._layers
             for sub in lyr.sublayers(include_self=True))
         parts.append(("mode", modes, bool(_amp_state.enabled),
-                      getattr(_amp_state, "dtype", None),
-                      getattr(_amp_state, "level", None)))
+                      str(getattr(_amp_state, "dtype", None)),
+                      getattr(_amp_state, "level", None),
+                      tuple(sorted(getattr(_amp_state, "custom_white",
+                                           ()) or ())),
+                      tuple(sorted(getattr(_amp_state, "custom_black",
+                                           ()) or ()))))
         return tuple(parts)
 
     def _cache_put(self, key, value):

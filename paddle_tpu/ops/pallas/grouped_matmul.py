@@ -181,4 +181,12 @@ def grouped_matmul(lhs, rhs, group_sizes, block_t: int = 128,
             return grouped_matmul_reference(lhs, rhs, jnp.asarray(sizes))
         tile_ids = tile_expert_ids(jnp.asarray(sizes), block_t,
                                    t // block_t)
+        total = int(sizes.sum())
+        if total < t:
+            # padding tiles get expert id E (clamped to the last expert by
+            # the BlockSpec index_map) — zero them to honor the contract
+            out = _gmm_pallas(lhs, rhs, jnp.minimum(tile_ids, e - 1),
+                              block_t)
+            valid = (jnp.arange(t) < total)[:, None]
+            return out * valid.astype(out.dtype)
     return _gmm_pallas(lhs, rhs, tile_ids, block_t)

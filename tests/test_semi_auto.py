@@ -1,0 +1,295 @@
+"""Semi-auto parallel API completion (VERDICT round-1 item 6).
+
+ref contract: python/paddle/distributed/auto_parallel/api.py
+shard_optimizer/:1613, shard_scaler/:2132, shard_dataloader/:2715,
+to_static/DistModel/Strategy.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import ProcessMesh
+
+
+def _mesh1d(n=8, name="x"):
+    return ProcessMesh(np.arange(n), dim_names=[name])
+
+
+class TestDistAllSurface:
+    def test_distributed_all_covered(self):
+        import ast
+        src = open(
+            "/root/reference/python/paddle/distributed/__init__.py").read()
+        tree = ast.parse(src)
+        ref = None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", None) == "__all__":
+                        ref = [ast.literal_eval(e) for e in node.value.elts]
+        missing = [n for n in ref if not hasattr(dist, n)]
+        assert missing == [], missing
+
+
+class TestShardOptimizer:
+    def _model_and_data(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+        x = np.random.default_rng(0).standard_normal((16, 8)).astype(
+            np.float32)
+        return net, x
+
+    def test_default_inherits_param_placements(self):
+        mesh = _mesh1d()
+        net, x = self._model_and_data()
+        for p in net.parameters():
+            dist.shard_tensor(p, mesh, [dist.Replicate()])
+        opt = dist.shard_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=net.parameters()))
+        out = net(paddle.to_tensor(x))
+        (out * out).mean().backward()
+        opt.step()
+        opt.clear_grad()
+        # state slots exist and step ran
+        assert opt._inner._states
+
+    def test_sharding_stage1_places_moments(self):
+        mesh = _mesh1d()
+        net, x = self._model_and_data()
+        from paddle_tpu.distributed.api import shard_parameter
+        for p in net.parameters():
+            # replicated params on the mesh (pure dp)
+            shard_parameter(p, mesh)
+        opt = dist.shard_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=net.parameters()),
+            dist.ShardingStage1(mesh))
+        out = net(paddle.to_tensor(x))
+        (out * out).mean().backward()
+        opt.step()
+        # moment slots must be sharded on the mesh axis (ZeRO-1)
+        some = next(iter(opt._inner._states.values()))
+        m = some.get("m", some.get("moment1"))
+        assert m is not None
+        spec = m.sharding.spec if hasattr(m, "sharding") else None
+        assert spec is not None and any(s is not None for s in spec), spec
+
+    def test_sharded_training_matches_unsharded(self):
+        mesh = _mesh1d()
+
+        def run(shard):
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                                nn.Linear(16, 8))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=net.parameters())
+            if shard:
+                from paddle_tpu.distributed.api import shard_parameter
+                for p in net.parameters():
+                    shard_parameter(p, mesh)
+                opt = dist.shard_optimizer(opt, dist.ShardingStage3(mesh))
+            x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+                (16, 8)).astype(np.float32))
+            losses = []
+            for _ in range(4):
+                out = net(x)
+                loss = (out * out).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            return losses
+
+        np.testing.assert_allclose(run(False), run(True), rtol=2e-5)
+
+    def test_mesh_change_checkpoint_roundtrip(self, tmp_path):
+        """Opt state saved under one mesh restores under another
+        (VERDICT: mesh-change checkpoint test) — reshard-on-load."""
+        from paddle_tpu.distributed.api import shard_parameter
+        from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                       save_state_dict)
+
+        def build(mesh):
+            paddle.seed(0)
+            net = nn.Linear(8, 8)
+            opt = dist.shard_optimizer(
+                paddle.optimizer.AdamW(learning_rate=1e-2,
+                                       parameters=net.parameters()),
+                dist.ShardingStage1(mesh))
+            x = paddle.to_tensor(np.ones((4, 8), np.float32))
+            out = net(x)
+            (out * out).mean().backward()
+            opt.step()
+            return net, opt
+
+        mesh_a = _mesh1d(8, "x")
+        net_a, opt_a = build(mesh_a)
+        state = {}
+        for i, (pid, slots) in enumerate(opt_a._inner._states.items()):
+            for name, v in slots.items():
+                if hasattr(v, "shape") and np.ndim(v) > 0:
+                    state[f"p{i}#{name}"] = paddle.to_tensor(np.asarray(v))
+        save_state_dict(state, str(tmp_path / "ckpt"))
+
+        mesh_b = ProcessMesh(np.arange(8).reshape(2, 4),
+                             dim_names=["a", "b"])
+        net_b, opt_b = build(mesh_b)
+        target = {}
+        for i, (pid, slots) in enumerate(opt_b._inner._states.items()):
+            for name, v in slots.items():
+                if hasattr(v, "shape") and np.ndim(v) > 0:
+                    target[f"p{i}#{name}"] = paddle.to_tensor(np.asarray(v))
+        load_state_dict(target, str(tmp_path / "ckpt"))
+        for k in state:
+            np.testing.assert_allclose(np.asarray(target[k]._data),
+                                       np.asarray(state[k]._data),
+                                       rtol=1e-6)
+
+
+class TestShardScalerAndDataloader:
+    def test_shard_scaler_local_noop(self):
+        net = nn.Linear(4, 4)
+        scaler = dist.shard_scaler(paddle.amp.GradScaler())
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = scaler.scale((net(x) ** 2).mean())
+        loss.backward()
+        scaler.step(opt)
+        scaler.update()
+
+    def test_shard_dataloader(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        mesh = _mesh1d(8, "dp")
+        xs = np.arange(64, dtype=np.float32).reshape(16, 4)
+        ys = np.arange(16, dtype=np.int64)
+        ds = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+        loader = DataLoader(ds, batch_size=8, shuffle=False)
+        sl = dist.shard_dataloader(loader, mesh, shard_dims="dp")
+        assert len(sl) == len(loader)
+        batches = list(sl)
+        assert len(batches) == 2
+        xb, yb = batches[0]
+        assert xb._dist_attr is not None
+        assert isinstance(xb._dist_attr.placements[0], dist.Shard)
+        np.testing.assert_allclose(np.asarray(xb._data), xs[:8])
+
+
+class TestDistModelToStatic:
+    def _setup(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+        loss = nn.MSELoss()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+        return net, loss, opt
+
+    def test_train_eval_predict_modes(self):
+        net, loss, opt = self._setup()
+        model = dist.to_static(net, loss=loss, optimizer=opt,
+                               strategy=dist.Strategy())
+        assert model.mode == "train"
+        x = np.random.default_rng(0).standard_normal((8, 8)).astype(
+            np.float32)
+        y = np.zeros((8, 1), np.float32)
+        losses = [float(model(x, y)) for _ in range(5)]
+        assert losses[-1] < losses[0], losses
+
+        model.eval()
+        ev = float(model(paddle.to_tensor(x), paddle.to_tensor(y)))
+        assert np.isfinite(ev)
+        model.predict()
+        out = model(paddle.to_tensor(x))
+        assert list(out.shape) == [8, 1]
+
+    def test_state_dict_roundtrip(self):
+        net, loss, opt = self._setup()
+        model = dist.to_static(net, loss=loss, optimizer=opt)
+        x = np.ones((4, 8), np.float32)
+        model(x, np.zeros((4, 1), np.float32))
+        sd = model.state_dict()
+        assert any("#" in k for k in sd)       # opt slots included
+        assert any("#" not in k for k in sd)   # params included
+        model.set_state_dict(sd)
+
+    def test_strategy_fields(self):
+        s = dist.Strategy({"sharding": {"enable": True, "stage": 2},
+                           "pipeline": {"enable": True,
+                                        "accumulate_steps": 4}})
+        assert s.sharding.enable and s.sharding.stage == 2
+        assert s.pipeline.accumulate_steps == 4
+        assert s.amp.enable is False
+
+
+class TestMisc:
+    def test_gather_local(self):
+        out = []
+        dist.gather(paddle.to_tensor(np.ones(3, np.float32)), out, dst=0)
+        assert len(out) == 1
+        np.testing.assert_allclose(out[0].numpy(), 1.0)
+
+    def test_wait_and_enums(self):
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        dist.wait(t)
+        assert dist.ParallelMode.DATA_PARALLEL == 0
+        assert dist.ReduceType.kRedSum == 0
+
+    def test_entries_and_datasets(self, tmp_path):
+        assert dist.ProbabilityEntry(0.5)._to_attr() == \
+            "probability_entry:0.5"
+        assert dist.CountFilterEntry(3)._to_attr() == "count_filter_entry:3"
+        assert dist.ShowClickEntry("show", "click")._to_attr() == \
+            "show_click_entry:show:click"
+        f = tmp_path / "slots.txt"
+        f.write_text("a:1 a:2 b:3\na:4 b:5\n")
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2, use_var=["a", "b"])
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 2
+        batches = list(ds)
+        assert set(batches[0].keys()) == {"a", "b"}
+
+    def test_io_persistables(self, tmp_path):
+        net = nn.Linear(3, 3)
+        dist.io.save_persistables(net, str(tmp_path / "persist"))
+        w0 = net.weight.numpy().copy()
+        net.weight.set_value(np.zeros((3, 3), np.float32))
+        dist.io.load_persistables(net, str(tmp_path / "persist"))
+        np.testing.assert_allclose(net.weight.numpy(), w0)
+
+
+class TestSpawn:
+    def test_spawn_two_procs(self, tmp_path):
+        import sys
+        import subprocess
+        import textwrap
+        # spawn pickles func: run in a subprocess script for a clean env
+        script = tmp_path / "sp.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            import paddle_tpu.distributed as dist
+
+            def worker(path):
+                import paddle_tpu.distributed as dist
+                r = int(os.environ["PADDLE_TRAINER_ID"])
+                open(f"{path}/rank{r}", "w").write("ok")
+
+            if __name__ == "__main__":
+                import sys
+                dist.spawn(worker, args=(sys.argv[1],), nprocs=2)
+                print("SPAWN_DONE")
+        """))
+        import os
+        env = dict(os.environ, PYTHONPATH="/root/repo",
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, str(script), str(tmp_path)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert proc.returncode == 0, proc.stderr
+        assert "SPAWN_DONE" in proc.stdout
+        assert (tmp_path / "rank0").exists()
+        assert (tmp_path / "rank1").exists()

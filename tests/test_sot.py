@@ -374,3 +374,45 @@ class TestReviewFixes2:
         r3 = sf(x)                    # compiled pure path must survive
         np.testing.assert_allclose(r3.numpy(), 2.0)
         assert sf.cache_size() == calls  # replayed, not re-recorded
+
+
+_GLOBAL_NET = None
+
+
+class TestReviewFixes3:
+    def test_mutated_numpy_arg_not_stale(self):
+        sf = SOTFunction(lambda t, c: t * paddle.to_tensor(np.asarray(c)))
+        x = paddle.to_tensor(np.full(4, 3.0, np.float32))
+        buf = np.ones(4, np.float32)
+        np.testing.assert_allclose(sf(x, buf).numpy(), 3.0)
+        buf[:] = 2.0                      # in-place mutation
+        np.testing.assert_allclose(sf(x, buf).numpy(), 6.0)
+
+    def test_global_layer_mode_tracked(self):
+        global _GLOBAL_NET
+        _GLOBAL_NET = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+
+        def f(t):
+            return _GLOBAL_NET(t)
+
+        sf = SOTFunction(f)
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        _GLOBAL_NET.eval()
+        e1 = sf(x)
+        _GLOBAL_NET.train()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            t1 = sf(x)
+        assert not np.allclose(t1.numpy(), e1.numpy())
+
+    def test_amp_custom_lists_in_signature(self):
+        net = nn.Linear(16, 16)
+        sf = SOTFunction(lambda t: net(t))
+        x = paddle.to_tensor(np.random.randn(2, 16).astype(np.float32))
+        with paddle.amp.auto_cast(level="O1"):
+            a = sf(x)
+        with paddle.amp.auto_cast(level="O1",
+                                  custom_black_list={"matmul", "linear"}):
+            b = sf(x)
+        # different cast regimes must be distinct cache entries
+        assert sf.cache_size() >= 2
