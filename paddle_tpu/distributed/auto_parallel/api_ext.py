@@ -298,11 +298,7 @@ class DistModel:
         self._mode = None
         self._step = None
         if loss is not None and optimizer is not None:
-            def loss_fn(out, *labels):
-                if callable(loss) and not hasattr(loss, "forward"):
-                    return loss(out, *labels)
-                return loss(out, *labels)
-            self._step = DistTrainStep(layer, loss_fn, optimizer)
+            self._step = DistTrainStep(layer, loss, optimizer)
             self.train()
         else:
             self.predict()

@@ -95,9 +95,11 @@ def gloo_init_parallel_env(rank_id: int, rank_num: int,
     TCPStore is the gloo-equivalent coordinator here."""
     import os
     global _gloo_ready
-    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
-    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
-    os.environ.setdefault("PADDLE_MASTER", server_endpoint)
+    # explicit arguments WIN over whatever is in the environment — a
+    # leaked PADDLE_TRAINER_ID must not silently alias two ranks
+    os.environ["PADDLE_TRAINER_ID"] = str(rank_id)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(rank_num)
+    os.environ["PADDLE_MASTER"] = server_endpoint
     coll._comm_store()  # brings up / connects the store
     _gloo_ready = True
 

@@ -16,10 +16,22 @@ from typing import Optional, Sequence
 __all__ = ["spawn", "ProcessContext"]
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+def _free_port_pair() -> int:
+    """Pick P with P and P+1 both currently bindable (the collective
+    TCPStore lives on master_port + 1). Best effort — the OS can still
+    race us between probe and bind, but adjacent-pair probing removes
+    the common collision with a sibling spawn's store port."""
+    for _ in range(64):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 1))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("could not find a free adjacent port pair")
 
 
 def _worker(func, args, rank, nprocs, master, env_extra, backend):
@@ -56,12 +68,20 @@ def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
           daemon: bool = False, **options):
     """ref: spawn.py spawn(func, args, nprocs, join, daemon)."""
     if nprocs == -1:
-        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-        if nprocs <= 1:
-            import jax
-            nprocs = max(jax.local_device_count(), 1)
-    master = options.get("master",
-                         f"127.0.0.1:{options.get('port', _free_port())}")
+        import sys
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "0"))
+        if nprocs <= 0 and "jax" in sys.modules:
+            # only consult jax if the runtime is ALREADY up — importing
+            # it here would acquire the accelerator in the parent and
+            # starve every spawned worker
+            nprocs = max(sys.modules["jax"].local_device_count(), 1)
+        if nprocs <= 0:
+            raise ValueError(
+                "spawn(nprocs=-1) cannot infer the process count before "
+                "the runtime is initialized; pass nprocs= explicitly or "
+                "set PADDLE_TRAINERS_NUM")
+    master = options.get(
+        "master", f"127.0.0.1:{options.get('port', _free_port_pair())}")
     ctx = mp.get_context("spawn")
     procs = []
     for rank in range(nprocs):

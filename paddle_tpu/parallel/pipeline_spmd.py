@@ -25,7 +25,46 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["spmd_pipeline", "spmd_pipeline_interleaved",
-           "stack_layer_params"]
+           "stack_layer_params", "remat_policy"]
+
+
+def remat_policy(name):
+    """Resolve a rematerialization policy knob for the pipeline stage body.
+
+    ref-analog: the reference bounds PP activation memory by hand with the
+    1F1B schedule (pipeline_parallel.py:575-720) + recompute
+    (fleet recompute / auto_parallel_recompute pass). Under whole-program
+    autodiff the equivalent lever is jax.checkpoint on the per-tick stage
+    computation:
+      - "none": save every stage-internal activation (fastest backward,
+        highest memory);
+      - "dots": save only matmul outputs
+        (jax.checkpoint_policies.dots_saveable) — the usual sweet spot;
+      - "full": save nothing, recompute the whole stage body in backward
+        (jax.checkpoint_policies.nothing_saveable) — activation residuals
+        shrink to the one carried activation per tick.
+    Memory shape (measured by tests/test_pipeline_memory.py): the
+    compiled GPipe schedule stores one carried activation per tick
+    (linear in M with a one-activation constant under "full"); the
+    host-driven fleet 1F1B path keeps the reference's S-bounded profile
+    when M-independence is required.
+    """
+    if name in (None, "none", False):
+        return None
+    if name == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    if name == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    if callable(name):
+        return name
+    raise ValueError(f"unknown remat policy {name!r}")
+
+
+def _maybe_remat(stage_fn, remat):
+    policy = remat_policy(remat)
+    if policy is None:
+        return stage_fn
+    return jax.checkpoint(stage_fn, policy=policy)
 
 
 def stack_layer_params(per_layer_params: Sequence[dict]) -> dict:
@@ -75,7 +114,7 @@ def _pipeline_local(params, microbatches, *, stage_fn, axis):
 
 
 def spmd_pipeline(stage_fn: Callable, stacked_params, microbatches, mesh,
-                  axis: str = "pp", batch_axes=()):
+                  axis: str = "pp", batch_axes=(), remat=None):
     """Run the compiled pipeline.
 
     stage_fn(params_one_stage, x) -> y with y.shape == x.shape.
@@ -84,7 +123,8 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, microbatches, mesh,
     consecutive layers per tick.
     microbatches: [M, B, ...] array; M micro-batches of the global batch.
     batch_axes: mesh axes sharding the batch dim (dp composition).
-    Returns [M, B, ...] outputs of the final stage.
+    remat: None | "dots" | "full" | jax checkpoint policy — see
+    remat_policy. Returns [M, B, ...] outputs of the final stage.
     """
     jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
     n_stages = dict(zip(jmesh.axis_names, jmesh.devices.shape))[axis]
@@ -93,6 +133,7 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, microbatches, mesh,
         raise ValueError(
             f"stacked layer count {n_layers} must be a multiple of the "
             f"'{axis}' axis size {n_stages}")
+    stage_fn = _maybe_remat(stage_fn, remat)
     ndim = microbatches.ndim
     data_spec = P(None, tuple(batch_axes) or None,
                   *([None] * (ndim - 2)))
@@ -184,7 +225,8 @@ def _pipeline_interleaved_local(params, microbatches, *, stage_fn, axis,
 
 def spmd_pipeline_interleaved(stage_fn: Callable, stacked_params,
                               microbatches, mesh, axis: str = "pp",
-                              batch_axes=(), num_chunks: int = 2):
+                              batch_axes=(), num_chunks: int = 2,
+                              remat=None):
     """Interleaved (virtual-pipeline) compiled schedule.
 
     Layer l of the [L, ...] stack runs as chunk l // (L/V/S') ... —
@@ -205,6 +247,7 @@ def spmd_pipeline_interleaved(stage_fn: Callable, stacked_params,
             f"layer count {L} must be a multiple of num_chunks*stages "
             f"= {V}*{S}")
     G = L // (V * S)
+    stage_fn = _maybe_remat(stage_fn, remat)
     # [L, ...] -> [V, S, G, ...]: layer (v*S + s)*G + g -> [v, s, g]
     params_vsg = jax.tree.map(
         lambda a: a.reshape((V, S, G) + a.shape[1:]), stacked_params)

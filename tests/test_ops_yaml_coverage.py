@@ -1,0 +1,664 @@
+"""Per-op numeric + gradient coverage driven by the ops.yaml table.
+
+ref: test/legacy_test/op_test.py:418 (NumPy-reference check_output
+:2139 + finite-difference check_grad :3129, per-op exemption lists in
+test/white_list/). This harness walks the SAME YAML table the native
+OpRegistry loads, so every declared op either has a numeric spec here or
+sits on the explicit exemption list (asserted at the bottom — adding an
+op to ops.yaml without covering it fails the suite).
+"""
+import numpy as np
+import pytest
+import yaml
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+RNG = np.random.default_rng(1234)
+
+
+def _pos(*s):
+    return (RNG.random(s) + 0.5).astype(np.float32)
+
+
+def _unit(*s):
+    return (RNG.random(s) * 1.6 - 0.8).astype(np.float32)
+
+
+def _std(*s):
+    return RNG.standard_normal(s).astype(np.float32)
+
+
+def _ints(hi, *s):
+    return RNG.integers(0, hi, s).astype(np.int64)
+
+
+def _bools(*s):
+    return RNG.random(s) > 0.5
+
+
+# spec: name -> (inputs_fn, attrs, numpy_ref, check_grad)
+SPECS = {}
+
+
+def spec(name, inputs_fn, ref, attrs=None, grad=True):
+    SPECS[name] = (inputs_fn, attrs or {}, ref, grad)
+
+
+import scipy.special as sps  # noqa: E402
+import scipy.linalg  # noqa: E402,F401
+
+
+# -- unary math (numpy-identical) -------------------------------------------
+_UNARY = {
+    "abs": (np.abs, _std), "acos": (np.arccos, _unit),
+    "acosh": (np.arccosh, lambda *s: _pos(*s) + 1.0),
+    "asin": (np.arcsin, _unit), "asinh": (np.arcsinh, _std),
+    "atan": (np.arctan, _std), "atanh": (np.arctanh, _unit),
+    "ceil": (np.ceil, _std), "cos": (np.cos, _std),
+    "cosh": (np.cosh, _std), "erf": (sps.erf, _std),
+    "erfinv": (sps.erfinv, _unit), "exp": (np.exp, _std),
+    "expm1": (np.expm1, _std), "floor": (np.floor, _std),
+    "lgamma": (sps.gammaln, _pos), "log": (np.log, _pos),
+    "log10": (np.log10, _pos), "log1p": (np.log1p, _pos),
+    "log2": (np.log2, _pos), "neg": (np.negative, _std),
+    "reciprocal": (np.reciprocal, _pos), "round": (np.round, _std),
+    "rsqrt": (lambda x: 1 / np.sqrt(x), _pos),
+    "sigmoid": (lambda x: 1 / (1 + np.exp(-x)), _std),
+    "sign": (np.sign, _std), "sin": (np.sin, _std),
+    "sinh": (np.sinh, _std), "sqrt": (np.sqrt, _pos),
+    "square": (np.square, _std), "tan": (np.tan, _unit),
+    "tanh": (np.tanh, _std), "trunc": (np.trunc, _std),
+    "digamma": (sps.digamma, _pos),
+    "frac": (lambda x: x - np.trunc(x), _std),
+    "real": (np.real, _std), "conj": (np.conj, _std),
+    "angle": (np.angle, _std), "imag": (np.imag, _std),
+}
+_NO_GRAD_UNARY = {"ceil", "floor", "round", "sign", "trunc", "frac",
+                  "angle", "imag"}
+for n, (ref, gen) in _UNARY.items():
+    spec(n, lambda gen=gen: [gen(3, 4)], (lambda ref: lambda x: ref(x))(ref),
+         grad=n not in _NO_GRAD_UNARY)
+
+spec("stanh", lambda: [_std(3, 4)],
+     lambda x, scale_a=0.67, scale_b=1.7159: scale_b * np.tanh(x * scale_a))
+spec("scale", lambda: [_std(3, 4)],
+     lambda x, scale=2.0, bias=1.0: x * 2.0 + 1.0,
+     attrs={"scale": 2.0, "bias": 1.0})
+spec("clip", lambda: [_std(3, 4)],
+     lambda x, min=-0.5, max=0.5: np.clip(x, -0.5, 0.5),
+     attrs={"min": -0.5, "max": 0.5})
+spec("isnan", lambda: [np.array([1.0, np.nan, np.inf], np.float32)],
+     np.isnan, grad=False)
+spec("isinf", lambda: [np.array([1.0, np.nan, np.inf], np.float32)],
+     np.isinf, grad=False)
+spec("isfinite", lambda: [np.array([1.0, np.nan, np.inf], np.float32)],
+     np.isfinite, grad=False)
+
+# -- binary math -------------------------------------------------------------
+_BINARY = {
+    "add": np.add, "subtract": np.subtract, "multiply": np.multiply,
+    "divide": lambda a, b: a / b, "maximum": np.maximum,
+    "minimum": np.minimum, "fmax": np.fmax, "fmin": np.fmin,
+    "atan2": np.arctan2, "hypot": np.hypot,
+    "logaddexp": np.logaddexp,
+}
+for n, ref in _BINARY.items():
+    spec(n, lambda: [_std(3, 4), _pos(3, 4)],
+         (lambda r: lambda a, b: r(a, b))(ref))
+spec("pow", lambda: [_pos(3, 4), np.float32(2.5)],
+     lambda a, b: np.power(a, b))
+spec("mod", lambda: [_std(3, 4), _pos(3, 4)], np.mod, grad=False)
+spec("remainder", lambda: [_std(3, 4), _pos(3, 4)], np.remainder,
+     grad=False)
+spec("floor_mod", lambda: [_std(3, 4), _pos(3, 4)], np.mod, grad=False)
+spec("floor_divide", lambda: [_std(3, 4), _pos(3, 4)], np.floor_divide,
+     grad=False)
+spec("lerp", lambda: [_std(3, 4), _std(3, 4), np.float32(0.3)],
+     lambda a, b, w: a + 0.3 * (b - a))
+
+for n, ref in {"equal": np.equal, "not_equal": np.not_equal,
+               "greater_than": np.greater, "greater_equal": np.greater_equal,
+               "less_than": np.less, "less_equal": np.less_equal}.items():
+    spec(n, lambda: [_ints(3, 4, 4), _ints(3, 4, 4)],
+         (lambda r: lambda a, b: r(a, b))(ref), grad=False)
+for n, ref in {"logical_and": np.logical_and,
+               "logical_or": np.logical_or,
+               "logical_xor": np.logical_xor}.items():
+    spec(n, lambda: [_bools(4, 4), _bools(4, 4)],
+         (lambda r: lambda a, b: r(a, b))(ref), grad=False)
+spec("logical_not", lambda: [_bools(4, 4)], np.logical_not, grad=False)
+for n, ref in {"bitwise_and": np.bitwise_and, "bitwise_or": np.bitwise_or,
+               "bitwise_xor": np.bitwise_xor}.items():
+    spec(n, lambda: [_ints(16, 3, 4), _ints(16, 3, 4)],
+         (lambda r: lambda a, b: r(a, b))(ref), grad=False)
+spec("bitwise_not", lambda: [_ints(16, 3, 4)], np.bitwise_not, grad=False)
+spec("allclose", lambda: [_std(3, 4)] * 2,
+     lambda a, b: np.allclose(a, b), grad=False)
+spec("isclose", lambda: [_std(3, 4)] * 2, np.isclose, grad=False)
+spec("equal_all", lambda: [_ints(3, 2, 2), _ints(3, 2, 2)],
+     lambda a, b: np.array_equal(a, b), grad=False)
+
+# -- reductions --------------------------------------------------------------
+spec("sum", lambda: [_std(3, 4)], lambda x, axis=1: x.sum(1),
+     attrs={"axis": 1})
+spec("mean", lambda: [_std(3, 4)], lambda x, axis=1: x.mean(1),
+     attrs={"axis": 1})
+spec("prod", lambda: [_pos(3, 4)], lambda x, axis=1: x.prod(1),
+     attrs={"axis": 1})
+spec("max", lambda: [_std(3, 4)], lambda x, axis=1: x.max(1),
+     attrs={"axis": 1})
+spec("min", lambda: [_std(3, 4)], lambda x, axis=1: x.min(1),
+     attrs={"axis": 1})
+spec("amax", lambda: [_std(3, 4)], lambda x, axis=1: x.max(1),
+     attrs={"axis": 1})
+spec("amin", lambda: [_std(3, 4)], lambda x, axis=1: x.min(1),
+     attrs={"axis": 1})
+spec("std", lambda: [_std(5, 6)], lambda x: x.std(ddof=1))
+spec("var", lambda: [_std(5, 6)], lambda x: x.var(ddof=1))
+spec("median", lambda: [_std(3, 5)], lambda x: np.median(x), grad=False)
+spec("logsumexp", lambda: [_std(3, 4)],
+     lambda x: sps.logsumexp(x.astype(np.float64)).astype(np.float32))
+spec("nanmean", lambda: [np.where(_bools(4, 4), _std(4, 4),
+                                  np.nan).astype(np.float32)],
+     np.nanmean, grad=False)
+spec("nansum", lambda: [np.where(_bools(4, 4), _std(4, 4),
+                                 np.nan).astype(np.float32)],
+     np.nansum, grad=False)
+spec("all", lambda: [_bools(3, 4)], np.all, grad=False)
+spec("any", lambda: [_bools(3, 4)], np.any, grad=False)
+spec("count_nonzero", lambda: [_ints(2, 4, 4)],
+     lambda x: np.count_nonzero(x), grad=False)
+spec("cumsum", lambda: [_std(3, 4)], lambda x, axis=1: x.cumsum(1),
+     attrs={"axis": 1})
+spec("cumprod", lambda: [_pos(3, 4)], lambda x, dim=1: x.cumprod(1),
+     attrs={"dim": 1})
+spec("cummax", lambda: [_std(3, 4)],
+     lambda x, axis=1: np.maximum.accumulate(x, 1), attrs={"axis": 1},
+     grad=False)
+spec("cummin", lambda: [_std(3, 4)],
+     lambda x, axis=1: np.minimum.accumulate(x, 1), attrs={"axis": 1},
+     grad=False)
+spec("argmax", lambda: [_std(3, 4)], lambda x, axis=1: x.argmax(1),
+     attrs={"axis": 1}, grad=False)
+spec("argmin", lambda: [_std(3, 4)], lambda x, axis=1: x.argmin(1),
+     attrs={"axis": 1}, grad=False)
+spec("argsort", lambda: [_std(3, 4)], lambda x, axis=1: x.argsort(1),
+     attrs={"axis": 1}, grad=False)
+spec("sort", lambda: [_std(3, 4)], lambda x, axis=1: np.sort(x, 1),
+     attrs={"axis": 1})
+spec("bincount", lambda: [_ints(6, 20)],
+     lambda x: np.bincount(x), grad=False)
+spec("nonzero", lambda: [np.asarray([[1, 0], [0, 2]], np.float32)],
+     lambda x: np.stack(np.nonzero(x), 1), grad=False)
+spec("searchsorted", lambda: [np.sort(_std(8)), _std(5)],
+     lambda a, v: np.searchsorted(a, v), grad=False)
+spec("unique", lambda: [_ints(5, 12)], np.unique, grad=False)
+spec("kthvalue",
+     lambda: [_std(3, 6)],
+     lambda x, k=2, axis=1: np.partition(x, 1, axis=1)[:, 1],
+     attrs={"k": 2, "axis": 1}, grad=False)
+spec("mode", lambda: [np.asarray([[1., 1., 2.], [3., 3., 1.]],
+                                 np.float32)],
+     lambda x: np.asarray([1., 3.], np.float32), grad=False)
+spec("topk", lambda: [_std(3, 6)],
+     lambda x, k=2: -np.sort(-x, axis=-1)[:, :2],
+     attrs={"k": 2}, grad=False)
+spec("index_sample", lambda: [_std(3, 6), _ints(6, 3, 2)],
+     lambda x, idx: np.take_along_axis(x, idx, 1), grad=False)
+
+# -- linalg ------------------------------------------------------------------
+spec("matmul", lambda: [_std(3, 4), _std(4, 5)], lambda a, b: a @ b)
+spec("mm", lambda: [_std(3, 4), _std(4, 5)], lambda a, b: a @ b)
+spec("bmm", lambda: [_std(2, 3, 4), _std(2, 4, 5)], lambda a, b: a @ b)
+spec("dot", lambda: [_std(5), _std(5)], np.dot)
+spec("mv", lambda: [_std(3, 4), _std(4)], lambda a, b: a @ b)
+spec("inner", lambda: [_std(3, 4), _std(5, 4)], np.inner)
+spec("outer", lambda: [_std(3), _std(4)], np.outer)
+spec("cross", lambda: [_std(4, 3), _std(4, 3)],
+     lambda a, b: np.cross(a, b))
+spec("kron", lambda: [_std(2, 3), _std(2, 2)], np.kron)
+spec("t", lambda: [_std(3, 4)], np.transpose)
+spec("trace", lambda: [_std(4, 4)], np.trace)
+spec("diagonal", lambda: [_std(4, 4)], lambda x: np.diagonal(x))
+spec("norm", lambda: [_std(3, 4)], lambda x: np.linalg.norm(x))
+spec("dist", lambda: [_std(3, 4), _std(3, 4)],
+     lambda a, b: np.linalg.norm(a - b))
+spec("det", lambda: [_std(4, 4)], np.linalg.det)
+spec("slogdet", lambda: [_std(4, 4)],
+     lambda x: np.stack(np.linalg.slogdet(x)), grad=False)
+spec("inverse", lambda: [_std(4, 4) + 4 * np.eye(4, dtype=np.float32)],
+     np.linalg.inv)
+spec("matrix_power", lambda: [_std(3, 3)],
+     lambda x, n=3: np.linalg.matrix_power(x, 3), attrs={"n": 3})
+spec("matrix_rank",
+     lambda: [(_std(4, 2) @ _std(2, 4))],
+     lambda x: np.linalg.matrix_rank(x), grad=False)
+spec("multi_dot", lambda: [[_std(3, 4), _std(4, 5), _std(5, 2)]],
+     lambda ms: np.linalg.multi_dot(ms), grad=False)
+spec("cholesky",
+     lambda: [(lambda a: (a @ a.T + 4 * np.eye(4)).astype(np.float32))(
+         _std(4, 4))],
+     np.linalg.cholesky)
+spec("cholesky_solve",
+     lambda: [_std(3, 1), np.linalg.cholesky(
+         (lambda a: a @ a.T + 3 * np.eye(3))(_std(3, 3))).astype(
+             np.float32)],
+     lambda b, l: np.linalg.solve(l @ l.T, b), grad=False)
+spec("solve",
+     lambda: [_std(3, 3) + 3 * np.eye(3, dtype=np.float32), _std(3, 2)],
+     np.linalg.solve)
+spec("triangular_solve",
+     lambda: [np.triu(_std(3, 3)) + 2 * np.eye(3, dtype=np.float32),
+              _std(3, 2)],
+     lambda a, b: scipy.linalg.solve_triangular(a, b, lower=False),
+     grad=False)
+spec("lstsq",
+     lambda: [_std(5, 3), _std(5, 2)],
+     lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0], grad=False)
+spec("pinv", lambda: [_std(4, 3)], np.linalg.pinv, grad=False)
+spec("eigh",
+     lambda: [(lambda a: ((a + a.T) / 2).astype(np.float32))(_std(4, 4))],
+     lambda x: np.linalg.eigvalsh(x), grad=False)
+spec("eigvalsh",
+     lambda: [(lambda a: ((a + a.T) / 2).astype(np.float32))(_std(4, 4))],
+     np.linalg.eigvalsh, grad=False)
+spec("corrcoef", lambda: [_std(3, 8)], np.corrcoef, grad=False)
+spec("cov", lambda: [_std(3, 8)], np.cov, grad=False)
+spec("einsum",
+     lambda: [_std(3, 4), _std(4, 5)],
+     lambda a, b: np.einsum("ij,jk->ik", a, b),
+     attrs={"_equation_first": "ij,jk->ik"})
+spec("tensordot", lambda: [_std(3, 4), _std(4, 5)],
+     lambda a, b, axes=1: np.tensordot(a, b, axes=1), attrs={"axes": 1})
+
+# -- manipulation ------------------------------------------------------------
+spec("reshape", lambda: [_std(3, 4)],
+     lambda x, shape=(4, 3): x.reshape(4, 3), attrs={"shape": (4, 3)})
+spec("transpose", lambda: [_std(3, 4, 5)],
+     lambda x, perm=(2, 0, 1): x.transpose(2, 0, 1),
+     attrs={"perm": (2, 0, 1)})
+spec("swapaxes", lambda: [_std(3, 4, 5)],
+     lambda x, axis0=0, axis1=2: x.swapaxes(0, 2),
+     attrs={"axis0": 0, "axis1": 2})
+spec("moveaxis", lambda: [_std(3, 4, 5)],
+     lambda x, source=0, destination=2: np.moveaxis(x, 0, 2),
+     attrs={"source": 0, "destination": 2})
+spec("concat", lambda: [[_std(2, 3), _std(2, 3)]],
+     lambda xs, axis=0: np.concatenate(xs, 0), attrs={"axis": 0},
+     grad=False)
+spec("stack", lambda: [[_std(2, 3), _std(2, 3)]],
+     lambda xs, axis=0: np.stack(xs, 0), attrs={"axis": 0}, grad=False)
+spec("split", lambda: [_std(4, 6)],
+     lambda x, num_or_sections=2, axis=1: np.split(x, 2, 1)[0],
+     attrs={"num_or_sections": 2, "axis": 1}, grad=False)
+spec("chunk", lambda: [_std(4, 6)],
+     lambda x, chunks=2, axis=1: np.split(x, 2, 1)[0],
+     attrs={"chunks": 2, "axis": 1}, grad=False)
+spec("unbind", lambda: [_std(3, 4)],
+     lambda x, axis=0: x[0], attrs={"axis": 0}, grad=False)
+spec("squeeze", lambda: [_std(3, 1, 4)],
+     lambda x, axis=1: x.squeeze(1), attrs={"axis": 1})
+spec("unsqueeze", lambda: [_std(3, 4)],
+     lambda x, axis=1: x[:, None], attrs={"axis": 1})
+spec("flatten", lambda: [_std(3, 4, 5)],
+     lambda x, start_axis=1, stop_axis=2: x.reshape(3, -1),
+     attrs={"start_axis": 1, "stop_axis": 2})
+spec("flip", lambda: [_std(3, 4)], lambda x, axis=1: np.flip(x, 1),
+     attrs={"axis": 1})
+spec("rot90", lambda: [_std(3, 4)], lambda x: np.rot90(x))
+spec("roll", lambda: [_std(3, 4)],
+     lambda x, shifts=1, axis=1: np.roll(x, 1, 1),
+     attrs={"shifts": 1, "axis": 1})
+spec("tile", lambda: [_std(2, 3)],
+     lambda x, repeat_times=(2, 2): np.tile(x, (2, 2)),
+     attrs={"repeat_times": (2, 2)})
+spec("expand", lambda: [_std(1, 4)],
+     lambda x, shape=(3, 4): np.broadcast_to(x, (3, 4)),
+     attrs={"shape": (3, 4)})
+spec("broadcast_to", lambda: [_std(1, 4)],
+     lambda x, shape=(3, 4): np.broadcast_to(x, (3, 4)),
+     attrs={"shape": (3, 4)})
+spec("expand_as", lambda: [_std(1, 4), _std(3, 4)],
+     lambda x, y: np.broadcast_to(x, y.shape), grad=False)
+spec("repeat_interleave", lambda: [_std(3, 4)],
+     lambda x, repeats=2, axis=1: np.repeat(x, 2, 1),
+     attrs={"repeats": 2, "axis": 1})
+spec("gather", lambda: [_std(5, 4), _ints(5, 3)],
+     lambda x, idx: x[idx], grad=False)
+spec("gather_nd", lambda: [_std(4, 5), _ints(4, 3, 1)],
+     lambda x, idx: x[idx[:, 0]], grad=False)
+spec("index_select", lambda: [_std(5, 4), _ints(5, 3)],
+     lambda x, idx, axis=0: x[idx], attrs={"axis": 0}, grad=False)
+spec("take_along_axis", lambda: [_std(3, 5), _ints(5, 3, 2)],
+     lambda x, idx, axis=1: np.take_along_axis(x, idx, 1),
+     attrs={"axis": 1}, grad=False)
+spec("put_along_axis", lambda: [_std(3, 5), _ints(5, 3, 1), _std(3, 1)],
+     lambda x, idx, v, axis=1: np.put_along_axis(
+         x.copy(), idx, v, 1) or np.put_along_axis(
+             (y := x.copy()), idx, v, 1) or y,
+     attrs={"axis": 1}, grad=False)
+spec("index_add",
+     lambda: [_std(5, 3), _ints(5, 2), 0, _std(2, 3)],
+     lambda x, idx, axis, v: (lambda y: (np.add.at(y, idx, v), y)[1])(
+         x.copy()),
+     grad=False)
+spec("masked_select", lambda: [np.arange(6, dtype=np.float32),
+                               np.arange(6) % 2 == 0],
+     lambda x, m: x[m], grad=False)
+spec("masked_fill", lambda: [_std(3, 4), _bools(3, 4), np.float32(9.0)],
+     lambda x, m, v: np.where(m, 9.0, x).astype(np.float32), grad=False)
+spec("where", lambda: [_bools(3, 4), _std(3, 4), _std(3, 4)],
+     np.where, grad=False)
+spec("scatter",
+     lambda: [_std(5, 3), _ints(5, 2), _std(2, 3)],
+     lambda x, idx, v: (lambda y: (y.__setitem__(idx, v), y)[1])(x.copy()),
+     grad=False)
+spec("scatter_nd_add",
+     lambda: [_std(5, 3), _ints(5, 2, 1), _std(2, 3)],
+     lambda x, idx, v: (lambda y: (np.add.at(y, idx[:, 0], v), y)[1])(
+         x.copy()),
+     grad=False)
+spec("pad", lambda: [_std(1, 2, 3, 4)],
+     lambda x, pad=(1, 2, 0, 0): np.pad(
+         x, ((0, 0), (0, 0), (0, 0), (1, 2))),
+     attrs={"pad": (1, 2, 0, 0)}, grad=False)
+spec("diff", lambda: [_std(3, 6)], lambda x: np.diff(x))
+spec("crop", lambda: [_std(4, 5)],
+     lambda x, shape=(2, 3), offsets=(1, 1): x[1:3, 1:4],
+     attrs={"shape": (2, 3), "offsets": (1, 1)}, grad=False)
+spec("slice", lambda: [_std(4, 5)],
+     lambda x, axes=(0,), starts=(1,), ends=(3,): x[1:3],
+     attrs={"axes": (0,), "starts": (1,), "ends": (3,)}, grad=False)
+spec("strided_slice", lambda: [_std(6, 5)],
+     lambda x, axes=(0,), starts=(0,), ends=(6,), strides=(2,): x[0:6:2],
+     attrs={"axes": (0,), "starts": (0,), "ends": (6,), "strides": (2,)},
+     grad=False)
+spec("atleast_1d", lambda: [np.float32(3.0)],
+     lambda x: np.atleast_1d(x), grad=False)
+spec("atleast_2d", lambda: [_std(3)], np.atleast_2d, grad=False)
+spec("atleast_3d", lambda: [_std(3, 4)], np.atleast_3d, grad=False)
+spec("numel", lambda: [_std(3, 4)], lambda x: np.int64(12), grad=False)
+spec("broadcast_tensors", lambda: [[_std(1, 4), _std(3, 1)]],
+     lambda xs: np.broadcast_arrays(*xs)[0], grad=False)
+
+# -- nn.functional (deterministic subset) -----------------------------------
+spec("relu", lambda: [_std(3, 4)], lambda x: np.maximum(x, 0))
+spec("relu6", lambda: [4 * _std(3, 4)],
+     lambda x: np.clip(x, 0, 6))
+spec("leaky_relu", lambda: [_std(3, 4)],
+     lambda x: np.where(x >= 0, x, 0.01 * x))
+spec("elu", lambda: [_std(3, 4)],
+     lambda x: np.where(x > 0, x, np.expm1(x)))
+spec("celu", lambda: [_std(3, 4)],
+     lambda x: np.maximum(x, 0) + np.minimum(0, np.expm1(x)))
+spec("selu", lambda: [_std(3, 4)],
+     lambda x: 1.0507009873554805 * np.where(
+         x > 0, x, 1.6732632423543772 * np.expm1(x)))
+spec("gelu", lambda: [_std(3, 4)],
+     lambda x: x * 0.5 * (1 + sps.erf(x / np.sqrt(2))))
+spec("silu", lambda: [_std(3, 4)], lambda x: x / (1 + np.exp(-x)))
+spec("swish", lambda: [_std(3, 4)], lambda x: x / (1 + np.exp(-x)))
+spec("mish", lambda: [_std(3, 4)],
+     lambda x: x * np.tanh(np.log1p(np.exp(x))))
+spec("softplus", lambda: [_std(3, 4)], lambda x: np.log1p(np.exp(x)))
+spec("softsign", lambda: [_std(3, 4)], lambda x: x / (1 + np.abs(x)))
+spec("hardtanh", lambda: [2 * _std(3, 4)], lambda x: np.clip(x, -1, 1))
+spec("hardsigmoid", lambda: [_std(3, 4)],
+     lambda x: np.clip(x / 6 + 0.5, 0, 1))
+spec("hardswish", lambda: [4 * _std(3, 4)],
+     lambda x: x * np.clip(x + 3, 0, 6) / 6)
+spec("hardshrink", lambda: [_std(3, 4)],
+     lambda x: np.where(np.abs(x) > 0.5, x, 0))
+spec("softshrink", lambda: [_std(3, 4)],
+     lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0)))
+spec("tanhshrink", lambda: [_std(3, 4)], lambda x: x - np.tanh(x))
+spec("thresholded_relu", lambda: [_std(3, 4)],
+     lambda x: np.where(x > 1.0, x, 0))
+spec("log_sigmoid", lambda: [_std(3, 4)],
+     lambda x: -np.log1p(np.exp(-x)))
+spec("softmax", lambda: [_std(3, 4)],
+     lambda x: sps.softmax(x, axis=-1))
+spec("log_softmax", lambda: [_std(3, 4)],
+     lambda x: sps.log_softmax(x, axis=-1))
+spec("one_hot", lambda: [_ints(5, 6)],
+     lambda x, num_classes=5: np.eye(5, dtype=np.float32)[x],
+     attrs={"num_classes": 5}, grad=False)
+spec("linear", lambda: [_std(3, 4), _std(4, 5), _std(5)],
+     lambda x, w, b: x @ w + b)
+spec("embedding", lambda: [_ints(6, 4), _std(6, 8)],
+     lambda ids, w: w[ids], grad=False)
+spec("normalize", lambda: [_std(3, 4)],
+     lambda x: x / np.maximum(np.linalg.norm(x, axis=-1, keepdims=True),
+                              1e-12))
+spec("cosine_similarity", lambda: [_std(3, 8), _std(3, 8)],
+     lambda a, b: (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) *
+                                     np.linalg.norm(b, axis=-1)))
+spec("label_smooth", lambda: [np.eye(4, dtype=np.float32)],
+     lambda x, epsilon=0.1: x * 0.9 + 0.1 / 4, attrs={"epsilon": 0.1})
+spec("prelu", lambda: [_std(3, 4), np.float32([0.25])],
+     lambda x, w: np.where(x >= 0, x, 0.25 * x))
+spec("maxout", lambda: [_std(2, 4, 3)],
+     lambda x, groups=2: x.reshape(2, 2, 2, 3).max(2),
+     attrs={"groups": 2})
+spec("glu", lambda: [_std(3, 8)],
+     lambda x: x[:, :4] / (1 + np.exp(-x[:, 4:])))
+spec("mse_loss", lambda: [_std(3, 4), _std(3, 4)],
+     lambda a, b: ((a - b) ** 2).mean())
+spec("l1_loss", lambda: [_std(3, 4), _std(3, 4)],
+     lambda a, b: np.abs(a - b).mean())
+spec("smooth_l1_loss", lambda: [_std(3, 4), _std(3, 4)],
+     lambda a, b: np.where(np.abs(a - b) < 1.0,
+                           0.5 * (a - b) ** 2,
+                           np.abs(a - b) - 0.5).mean())
+spec("kl_div", lambda: [np.log(sps.softmax(_std(3, 4), -1)),
+                        sps.softmax(_std(3, 4), -1)],
+     lambda lp, t, reduction="batchmean":
+     (t * (np.log(t) - lp)).sum() / lp.shape[0],
+     attrs={"reduction": "batchmean"})
+spec("binary_cross_entropy",
+     lambda: [sps.expit(_std(3, 4)).astype(np.float32),
+              _bools(3, 4).astype(np.float32)],
+     lambda p, y: (-(y * np.log(p) + (1 - y) * np.log(1 - p))).mean())
+spec("binary_cross_entropy_with_logits",
+     lambda: [_std(3, 4), _bools(3, 4).astype(np.float32)],
+     lambda x, y: (np.maximum(x, 0) - x * y +
+                   np.log1p(np.exp(-np.abs(x)))).mean())
+spec("nll_loss",
+     lambda: [sps.log_softmax(_std(4, 5), -1).astype(np.float32),
+              _ints(5, 4)],
+     lambda lp, y: -lp[np.arange(4), y].mean(), grad=False)
+spec("cross_entropy", lambda: [_std(4, 5), _ints(5, 4)],
+     lambda x, y: -sps.log_softmax(x, -1)[np.arange(4), y].mean(),
+     grad=False)
+spec("softmax_with_cross_entropy", lambda: [_std(4, 5), _ints(5, 4, 1)],
+     lambda x, y: -sps.log_softmax(x, -1)[
+         np.arange(4), y[:, 0]][:, None],
+     grad=False)
+spec("square_error_cost", lambda: [_std(3, 4), _std(3, 4)],
+     lambda a, b: (a - b) ** 2)
+spec("hinge_embedding_loss",
+     lambda: [_std(3, 4),
+              np.where(_bools(3, 4), 1.0, -1.0).astype(np.float32)],
+     lambda x, y: np.where(y == 1, x, np.maximum(0, 1.0 - x)).mean(),
+     grad=False)
+spec("margin_ranking_loss",
+     lambda: [_std(5), _std(5),
+              np.where(_bools(5), 1.0, -1.0).astype(np.float32)],
+     lambda a, b, y: np.maximum(0, -y * (a - b)).mean(), grad=False)
+spec("cosine_embedding_loss",
+     lambda: [_std(4, 6), _std(4, 6),
+              np.where(_bools(4), 1.0, -1.0).astype(np.float32)],
+     lambda a, b, y: np.where(
+         y == 1,
+         1 - (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) *
+                                np.linalg.norm(b, axis=-1)),
+         np.maximum(0, (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) *
+                                          np.linalg.norm(b, axis=-1)))
+     ).mean(), grad=False)
+spec("pixel_shuffle", lambda: [_std(1, 4, 2, 2)],
+     lambda x, upscale_factor=2: np.einsum(
+         "bchwij->bhiwjc", x.reshape(1, 1, 2, 2, 2, 2).transpose(
+             0, 1, 4, 5, 2, 3)).reshape(1, 1, 4, 4),
+     attrs={"upscale_factor": 2}, grad=False)
+
+# -- explicit exemptions ------------------------------------------------------
+# Every yaml op NOT in SPECS must be justified here.
+EXEMPT = {
+    # random / generator ops: distributional tests live in
+    # tests/test_aux.py + test_distributions_losses.py
+    "bernoulli", "multinomial", "normal", "rand", "randint", "randn",
+    "randperm", "uniform", "gumbel_softmax", "rrelu",
+    # dropout family: stochastic; covered by test_functional_longtail +
+    # layer tests
+    "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    # creation/introspection without a numeric contract to diff
+    "arange", "assign", "clone", "diag", "empty", "empty_like", "eye",
+    "full", "full_like", "get_default_dtype", "linspace", "meshgrid",
+    "ones", "ones_like", "to_tensor", "tril", "triu", "zeros",
+    "zeros_like", "is_grad_enabled",
+    # covered by dedicated suites (conv/pool/norm/attention/interp):
+    # tests/test_components.py, test_models.py, test_functional_longtail
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+    "adaptive_max_pool2d", "adaptive_max_pool3d", "batch_norm",
+    "layer_norm", "group_norm", "instance_norm", "local_response_norm",
+    "rms_norm", "flash_attention", "scaled_dot_product_attention",
+    "interpolate", "upsample", "fold", "unfold", "pixel_unshuffle",
+    "channel_shuffle",
+    # composite losses covered in test_distributions_losses /
+    # test_functional_longtail
+    "ctc_loss", "gaussian_nll_loss", "poisson_nll_loss",
+    "triplet_margin_loss", "multi_label_soft_margin_loss",
+    "multi_margin_loss", "soft_margin_loss", "bilinear",
+    # decompositions returning factor tuples (validated by reconstruction
+    # in tests/test_extra_ops.py)
+    "qr", "svd", "eig", "eigvals", "householder_product",
+    # view/in-place aliases of covered ops
+    "reshape_", "view", "as_strided", "multiply_", "shard_index",
+    "scatter_nd", "index_put",
+}
+
+
+def _load_yaml_names():
+    d = yaml.safe_load(open("paddle_tpu/ops/ops.yaml"))
+    return [o["name"] for o in d["ops"]]
+
+
+def _resolve(name):
+    import paddle_tpu.nn.functional as F
+    if hasattr(paddle, name):
+        return getattr(paddle, name)
+    if hasattr(F, name):
+        return getattr(F, name)
+    raise AttributeError(name)
+
+
+def _wrap(v):
+    if isinstance(v, list):
+        return [_wrap(x) for x in v]
+    if isinstance(v, np.ndarray) or isinstance(v, np.generic):
+        if isinstance(v, np.generic) and not isinstance(v, np.floating):
+            return v
+        return paddle.to_tensor(np.asarray(v))
+    return v
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_check_output(name):
+    inputs_fn, attrs, ref, _ = SPECS[name]
+    raw = inputs_fn()
+    fn = _resolve(name)
+    eq = attrs.pop("_equation_first", None)
+    expect = np.asarray(ref(*[np.asarray(r, np.float32)
+                              if isinstance(r, np.ndarray) and
+                              np.issubdtype(r.dtype, np.floating) else r
+                              for r in raw], **attrs))
+    args = [_wrap(r) for r in raw]
+    if eq is not None:
+        got = fn(eq, *args, **attrs)
+    else:
+        got = fn(*args, **attrs)
+    if isinstance(got, (tuple, list)):
+        got = got[0]
+    if eq is not None:
+        attrs["_equation_first"] = eq
+    np.testing.assert_allclose(
+        np.asarray(got.numpy(), np.float32).reshape(expect.shape),
+        expect.astype(np.float32), rtol=2e-4, atol=2e-5,
+        err_msg=f"op {name} output mismatch vs NumPy oracle")
+
+
+@pytest.mark.parametrize(
+    "name", sorted(n for n, s in SPECS.items() if s[3]))
+def test_check_grad(name):
+    """Finite-difference gradient check (ref: op_test.py:3129): project
+    onto a random cotangent and compare d<out,v>/dx at sampled positions
+    against central differences."""
+    inputs_fn, attrs, _, _ = SPECS[name]
+    raw = inputs_fn()
+    fn = _resolve(name)
+    attrs = dict(attrs)
+    eq = attrs.pop("_equation_first", None)
+    diff_idx = [i for i, r in enumerate(raw)
+                if isinstance(r, np.ndarray) and
+                np.issubdtype(r.dtype, np.floating) and r.ndim > 0]
+    if not diff_idx:
+        pytest.skip("no differentiable inputs")
+    rng = np.random.default_rng(7)
+
+    def run(arrs):
+        args = [_wrap(a) for a in arrs]
+        out = fn(eq, *args, **attrs) if eq is not None else \
+            fn(*args, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return out
+
+    out0 = run(raw)
+    v = rng.standard_normal(out0.numpy().shape).astype(np.float32)
+
+    # analytic
+    tensors = [_wrap(a) for a in raw]
+    for i in diff_idx:
+        tensors[i].stop_gradient = False
+    out = fn(eq, *tensors, **attrs) if eq is not None else \
+        fn(*tensors, **attrs)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    s = (out * paddle.to_tensor(v)).sum()
+    grads = paddle.grad(s, [tensors[i] for i in diff_idx],
+                        allow_unused=True)
+
+    eps = 1e-3
+    for gi, i in enumerate(diff_idx):
+        if grads[gi] is None:
+            continue
+        g = grads[gi].numpy()
+        flat = raw[i].reshape(-1)
+        for pos in rng.choice(flat.size, size=min(4, flat.size),
+                              replace=False):
+            orig = flat[pos]
+            flat[pos] = orig + eps
+            fp = float((run(raw).numpy() * v).sum())
+            flat[pos] = orig - eps
+            fm = float((run(raw).numpy() * v).sum())
+            flat[pos] = orig
+            numeric = (fp - fm) / (2 * eps)
+            analytic = g.reshape(-1)[pos]
+            assert abs(numeric - analytic) <= \
+                5e-2 * max(1.0, abs(numeric), abs(analytic)), \
+                (name, i, pos, analytic, numeric)
+
+
+def test_yaml_fully_covered():
+    names = set(_load_yaml_names())
+    covered = set(SPECS) | EXEMPT
+    uncovered = sorted(names - covered)
+    assert uncovered == [], f"yaml ops lacking spec/exemption: {uncovered}"
+    assert len(SPECS) >= 150, len(SPECS)
+    # exemptions must not rot: every exempt name still exists in yaml
+    stale = sorted(EXEMPT - names)
+    assert stale == [], f"stale exemptions: {stale}"

@@ -1,0 +1,106 @@
+"""Pipeline memory gate (VERDICT round-1 item 7).
+
+Live-measures the compiled pipeline's memory via XLA's
+compile-time memory analysis (the CPU-mesh analog of
+jax.device_memory_profile): remat must cut peak temps, and with
+remat="full" the per-extra-micro-batch growth must be a single carried
+activation, not the stage-internal residual footprint.
+ref: fleet/meta_parallel/pipeline_parallel.py:575-720 (what 1F1B buys)
++ the recompute pass (auto_parallel_recompute).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.parallel.pipeline_spmd import (remat_policy, spmd_pipeline,
+                                               stack_layer_params)
+
+S = 4          # pipeline stages
+B, H = 8, 64   # micro-batch rows, hidden
+DEPTH = 6      # matmuls per stage -> fat stage-internal residuals
+
+
+def _mesh():
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(jax.devices()[:S]), ("pp",))
+
+
+def _stage_fn(p, x):
+    y = x
+    for i in range(DEPTH):
+        y = jnp.tanh(y @ p[f"w{i}"])
+    return y
+
+
+def _params():
+    rng = np.random.default_rng(0)
+    per_layer = [{f"w{i}": jnp.asarray(
+        rng.standard_normal((H, H)).astype(np.float32) * 0.1)
+        for i in range(DEPTH)} for _ in range(S)]
+    return stack_layer_params(per_layer)
+
+
+def _peak_temp_bytes(m_micro, remat):
+    mesh = _mesh()
+    params = _params()
+
+    def loss(p, mb):
+        out = spmd_pipeline(_stage_fn, p, mb, mesh, remat=remat)
+        return jnp.sum(out ** 2)
+
+    grad_fn = jax.jit(jax.grad(loss))
+    mb = jnp.zeros((m_micro, B, H), jnp.float32)
+    c = grad_fn.lower(params, mb).compile()
+    return c.memory_analysis().temp_size_in_bytes
+
+
+class TestPipelineRemat:
+    def test_remat_policies_resolve(self):
+        assert remat_policy("none") is None
+        assert remat_policy("full") is not None
+        assert remat_policy("dots") is not None
+        with pytest.raises(ValueError):
+            remat_policy("bogus")
+
+    def test_numerics_unchanged_by_remat(self):
+        mesh = _mesh()
+        params = _params()
+        mb = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (8, B, H)).astype(np.float32))
+
+        def loss(p, mb, remat):
+            return jnp.sum(spmd_pipeline(_stage_fn, p, mb, mesh,
+                                         remat=remat) ** 2)
+
+        base = jax.grad(functools.partial(loss, remat=None))(params, mb)
+        for mode in ("dots", "full"):
+            got = jax.grad(functools.partial(loss, remat=mode))(params, mb)
+            for k in base:
+                np.testing.assert_allclose(np.asarray(got[k]),
+                                           np.asarray(base[k]),
+                                           rtol=2e-5, atol=1e-6)
+
+    def test_remat_cuts_peak_memory(self):
+        m = 8
+        peak_none = _peak_temp_bytes(m, None)
+        peak_full = _peak_temp_bytes(m, "full")
+        assert peak_full < peak_none, (peak_full, peak_none)
+
+    def test_full_remat_growth_is_one_activation_per_tick(self):
+        """Doubling M must grow the remat='full' footprint by ~one carried
+        activation per extra tick — NOT by the stage-internal residual
+        set (DEPTH activations per tick without remat)."""
+        act_bytes = B * H * 4
+        m1, m2 = 8, 16
+        g_full = _peak_temp_bytes(m2, "full") - _peak_temp_bytes(m1, "full")
+        g_none = _peak_temp_bytes(m2, None) - _peak_temp_bytes(m1, None)
+        ticks = m2 - m1
+        # without remat each extra tick stores the DEPTH tanh outputs too
+        assert g_none >= ticks * act_bytes * (DEPTH * 0.8)
+        # with full remat: the carried activation + the [M,B,H] outs
+        # buffer slot + small bookkeeping (measured 3.01x act/tick)
+        assert g_full <= ticks * act_bytes * 3.5, (g_full, g_none)
+        assert g_full < g_none / 2
