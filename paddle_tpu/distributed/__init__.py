@@ -45,7 +45,11 @@ from .ps_compat import (  # noqa: F401
 )
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore  # noqa: F401
-from .watchdog import Watchdog, WatchdogBusy, WatchdogTimeout  # noqa: F401
+from .watchdog import (  # noqa: F401
+    Watchdog, WatchdogBusy, WatchdogTimeout, install_watchdog,
+    uninstall_watchdog,
+)
+from .elastic import ElasticManager  # noqa: F401
 from .ring_attention import ring_attention, ring_self_attention  # noqa: F401
 from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: F401
 from .dist_train import DistTrainStep  # noqa: F401

@@ -708,6 +708,41 @@ def destroy_process_group(group: Optional[Group] = None):
         _group_map.pop(group.id, None)
 
 
+# -- watchdog instrumentation -------------------------------------------------
+# every eager collective runs inside a named span so an installed watchdog
+# (watchdog.install_watchdog) attributes hangs to the exact operation —
+# the reference's per-CommTask start/end tracking
+# (ref: comm_task_manager.h:37-57). Free when no watchdog is installed.
+
+def _spanned(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from .watchdog import collective_span
+        g = kwargs.get("group")
+        if not isinstance(g, Group):  # group may be passed positionally
+            g = next((a for a in args if isinstance(a, Group)), None)
+        gid = g.id if isinstance(g, Group) else 0
+        with collective_span(f"{fn.__name__}(group={gid})"):
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+all_reduce = _spanned(all_reduce)
+all_gather = _spanned(all_gather)
+all_gather_object = _spanned(all_gather_object)
+broadcast = _spanned(broadcast)
+broadcast_object_list = _spanned(broadcast_object_list)
+reduce = _spanned(reduce)
+scatter = _spanned(scatter)
+scatter_object_list = _spanned(scatter_object_list)
+reduce_scatter = _spanned(reduce_scatter)
+alltoall = _spanned(alltoall)
+alltoall_single = _spanned(alltoall_single)
+send = _spanned(send)
+recv = _spanned(recv)
+barrier = _spanned(barrier)
+
+
 class stream:
     """paddle.distributed.stream.* namespace parity (sync/calc-stream
     variants collapse on TPU: XLA owns scheduling)."""

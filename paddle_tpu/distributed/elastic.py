@@ -1,0 +1,167 @@
+"""Elastic node membership: TTL heartbeats over the TCPStore driving
+rank rewrite + restart.
+
+ref: python/paddle/distributed/fleet/elastic/manager.py:125 — the
+reference keeps an etcd registry with TTL leases and watchers; node
+join/leave rewrites the rank environment and restarts training through
+the exit-code protocol (101 restart / 102 stop, manager.py:33-34). Here
+the registry is the rank-0 TCPStore (the same coordinator that
+bootstraps collectives): each node heartbeats a key, a watcher computes
+the alive set from heartbeat ages, and a stable membership change fires
+the rewrite callback. The launcher consumes this with --elastic to kill
+and respawn its workers under the new (world_size, rank_offset); the
+TPU deployment note from SURVEY §5 — preemption-aware restart — is this
+watcher plus resharded checkpoint restore on the training side
+(dist.load_state_dict reshard-on-load).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["ElasticManager", "ELASTIC_RESTART_CODE", "ELASTIC_EXIT_CODE"]
+
+ELASTIC_RESTART_CODE = 101  # ref: elastic/manager.py:33
+ELASTIC_EXIT_CODE = 102     # ref: elastic/manager.py:34
+
+
+class ElasticManager:
+    """Store-backed node registry.
+
+    node_id: stable identity of this node (e.g. "host:port" or node_rank).
+    on_membership_change(alive_ids: sorted list, my_index: int) is called
+    from the watcher thread when the alive set changes and stays stable
+    for `stability_ticks` scan intervals (debounces flapping nodes).
+    """
+
+    PREFIX = "elastic/hb"
+
+    def __init__(self, store, node_id: str, ttl: float = 6.0,
+                 interval: float = 1.5, stability_ticks: int = 2,
+                 on_membership_change: Optional[Callable] = None,
+                 max_nodes: int = 64):
+        self._store = store
+        self.node_id = str(node_id)
+        self.ttl = ttl
+        self.interval = interval
+        self.stability_ticks = stability_ticks
+        self.on_membership_change = on_membership_change
+        self.max_nodes = max_nodes
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._known: Optional[List[str]] = None
+        self._pending: Optional[List[str]] = None
+        self._pending_ticks = 0
+        # nid -> (last beat value, monotonic time the value last changed)
+        self._beat_seen: dict = {}
+
+    # -- registry ----------------------------------------------------------
+    def _register(self):
+        # the id joins a roster enumerable by slot index (the store has no
+        # key listing, mirroring etcd prefix watches with one entry per
+        # node); slots are allocated with the store's ATOMIC add so two
+        # nodes starting together can never claim the same slot
+        for nid in self.roster():
+            if nid == self.node_id:
+                return  # restart of a known node keeps its slot
+        idx = self._store.add(f"{self.PREFIX}/roster_next", 1) - 1
+        if idx >= self.max_nodes:
+            raise RuntimeError(
+                f"elastic roster full (max_nodes={self.max_nodes})")
+        self._store.set(f"{self.PREFIX}/roster/{idx}",
+                        self.node_id.encode())
+
+    def _heartbeat_once(self):
+        # heartbeat = atomic counter bump: liveness is judged by whether
+        # the VALUE changed recently as observed on the watcher's own
+        # monotonic clock — no cross-host wall-clock comparison, so clock
+        # skew/NTP steps cannot fake a death
+        self._store.add(f"{self.PREFIX}/beat/{self.node_id}", 1)
+
+    def roster(self) -> List[str]:
+        out = []
+        for i in range(self.max_nodes):
+            v = self._store.get_nowait(f"{self.PREFIX}/roster/{i}")
+            if v is None:
+                break
+            if v.decode() not in out:
+                out.append(v.decode())
+        return out
+
+    @staticmethod
+    def _sort(ids: List[str]) -> List[str]:
+        try:
+            return sorted(ids, key=int)  # numeric node ranks keep their
+        except ValueError:               # numeric order past 10 nodes
+            return sorted(ids)
+
+    def alive_nodes(self) -> List[str]:
+        now = time.monotonic()
+        alive = []
+        for nid in self.roster():
+            v = self._store.get_nowait(f"{self.PREFIX}/beat/{nid}")
+            if v is None:
+                self._beat_seen.pop(nid, None)  # graceful leave
+                continue
+            last_val, last_change = self._beat_seen.get(nid, (None, None))
+            if v != last_val:
+                self._beat_seen[nid] = (v, now)
+                alive.append(nid)
+            elif now - last_change <= self.ttl:
+                alive.append(nid)
+        return self._sort(alive)
+
+    # -- threads -----------------------------------------------------------
+    def start(self):
+        self._register()
+        self._heartbeat_once()
+        self._known = self.alive_nodes()
+
+        def beat():
+            while not self._stop.wait(self.interval):
+                try:
+                    self._heartbeat_once()
+                except Exception:
+                    return  # store gone: the job is ending
+
+        def watch():
+            while not self._stop.wait(self.interval):
+                try:
+                    alive = self.alive_nodes()
+                except Exception:
+                    return
+                if alive == self._known:
+                    self._pending = None
+                    self._pending_ticks = 0
+                    continue
+                if alive == self._pending:
+                    self._pending_ticks += 1
+                else:
+                    self._pending = alive
+                    self._pending_ticks = 1
+                if self._pending_ticks >= self.stability_ticks:
+                    old, self._known = self._known, alive
+                    self._pending = None
+                    self._pending_ticks = 0
+                    if self.on_membership_change is not None:
+                        my = alive.index(self.node_id) \
+                            if self.node_id in alive else -1
+                        self.on_membership_change(alive, my)
+
+        for target in (beat, watch):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+
+    def leave(self):
+        """Graceful departure: drop the heartbeat so peers rebalance."""
+        self.stop()
+        self._store.delete(f"{self.PREFIX}/beat/{self.node_id}")

@@ -20,8 +20,9 @@ import sys
 import time
 from typing import List, Optional
 
-ELASTIC_RESTART_CODE = 101  # ref: fleet/elastic/manager.py:33-34
-ELASTIC_EXIT_CODE = 102
+from ..elastic import ELASTIC_EXIT_CODE, ELASTIC_RESTART_CODE  # noqa: F401
+# (single source of truth for the 101/102 restart protocol —
+# ref: fleet/elastic/manager.py:33-34)
 
 
 def _parse(argv):
@@ -39,6 +40,11 @@ def _parse(argv):
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--elastic_retries", type=int, default=0,
                    help="restarts allowed on exit code 101")
+    p.add_argument("--elastic", action="store_true",
+                   help="store-backed node membership: TTL heartbeats to "
+                        "the master, rank rewrite + worker restart on "
+                        "node join/leave (ref: fleet/elastic/manager.py)")
+    p.add_argument("--elastic_ttl", type=float, default=6.0)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -77,23 +83,85 @@ def launch(argv: Optional[List[str]] = None) -> int:
 
     retries = {i: args.elastic_retries for i in range(nproc)}
     procs: List[Optional[subprocess.Popen]] = [None] * nproc
-    logs = []
+    logs: dict = {}  # worker index -> open log handle (reused on respawn)
+    # elastic membership state: (world_nodes, my_node_index) — rewrites the
+    # rank env on change (ref: fleet/elastic/manager.py rank rewrite)
+    membership = {"nodes": args.nnodes, "index": args.node_rank,
+                  "restart": False, "exit": False}
 
     def spawn(i):
+        if i in logs:
+            logs[i].close()
         log = open(os.path.join(args.log_dir, f"workerlog.{i}"), "ab")
-        logs.append(log)
+        logs[i] = log
+        env = _worker_env(args, i, nproc)
+        if args.elastic:
+            world = membership["nodes"] * nproc
+            rank = membership["index"] * nproc + i
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "JAX_NUM_PROCESSES": str(world),
+                "JAX_PROCESS_ID": str(rank),
+            })
         procs[i] = subprocess.Popen(
             [sys.executable, args.training_script,
              *args.training_script_args],
-            env=_worker_env(args, i, nproc), stdout=log, stderr=log)
+            env=env, stdout=log, stderr=log)
+
+    manager = None
+    if args.elastic:
+        from ..elastic import ElasticManager
+        from ..store import TCPStore
+        host, port = args.master.rsplit(":", 1)
+        store = TCPStore(host, int(port) + 2,
+                         is_master=args.node_rank == 0,
+                         world_size=args.nnodes, timeout=60.0)
+
+        def on_change(alive, my_index):
+            if my_index < 0:
+                membership["exit"] = True
+            else:
+                membership["nodes"] = len(alive)
+                membership["index"] = my_index
+                membership["restart"] = True
+            sys.stderr.write(
+                f"[elastic] membership now {alive}, my_index={my_index}; "
+                f"{'exiting' if my_index < 0 else 'restarting workers'}\n")
+
+        manager = ElasticManager(
+            store, str(args.node_rank), ttl=args.elastic_ttl,
+            on_membership_change=on_change).start()
 
     for i in range(nproc):
         spawn(i)
+
+    def _kill_workers():
+        for i, p in enumerate(procs):
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in procs:
+            if p is not None:
+                while p.poll() is None and time.time() < deadline:
+                    time.sleep(0.1)
+                if p.poll() is None:
+                    p.kill()
 
     exit_code = 0
     try:
         while any(p is not None for p in procs):
             time.sleep(0.2)
+            if membership["exit"]:
+                raise RuntimeError(
+                    "elastic: this node left the alive set (heartbeat "
+                    "lost); stopping workers")
+            if membership["restart"]:
+                membership["restart"] = False
+                _kill_workers()
+                for i in range(nproc):
+                    spawn(i)  # rewritten rank env (elastic scale event)
+                continue
             for i, p in enumerate(procs):
                 if p is None:
                     continue
@@ -112,12 +180,12 @@ def launch(argv: Optional[List[str]] = None) -> int:
                         f"(log: {args.log_dir}/workerlog.{i})")
     except RuntimeError as e:
         sys.stderr.write(str(e) + "\n")
-        for p in procs:
-            if p is not None and p.poll() is None:
-                p.send_signal(signal.SIGTERM)
+        _kill_workers()
         exit_code = exit_code or 1
     finally:
-        for log in logs:
+        if manager is not None:
+            manager.stop()
+        for log in logs.values():
             log.close()
     return exit_code
 

@@ -11,11 +11,14 @@ reference fills by aborting NCCL comms.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
-__all__ = ["Watchdog", "WatchdogTimeout", "WatchdogBusy"]
+__all__ = ["Watchdog", "WatchdogTimeout", "WatchdogBusy",
+           "collective_span", "install_watchdog", "uninstall_watchdog"]
 
 
 class WatchdogTimeout(RuntimeError):
@@ -45,6 +48,94 @@ class Watchdog:
         self.trace_path = trace_path
         self._task_counter = 0
         self._stuck_thread: Optional[threading.Thread] = None
+        # named spans (ref: comm_task_manager.h CommTask start/end events):
+        # open spans keyed by id, completed spans in a ring for attribution
+        self._span_lock = threading.Lock()
+        self._open_spans: dict = {}
+        self._span_counter = 0
+        self._recent_spans: deque = deque(maxlen=32)
+        self._monitor: Optional[threading.Thread] = None
+        self._monitor_stop = threading.Event()
+        self.timed_out_spans: list = []
+
+    # -- named spans --------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str):
+        """Track one named operation (a collective, a step). On timeout
+        the monitor names it, dumps the host trace, and fires on_timeout
+        — the reference's per-CommTask attribution
+        (ref: comm_task_manager.h:37-57)."""
+        with self._span_lock:
+            self._span_counter += 1
+            sid = self._span_counter
+            # [name, start, timed_out_flag] — a timed-out span stays OPEN
+            # (the thread is still blocked) and is merely flagged, so
+            # open_span_report keeps showing the hang until it resolves
+            self._open_spans[sid] = [name, time.monotonic(), False]
+        try:
+            yield
+        finally:
+            with self._span_lock:
+                entry = self._open_spans.pop(sid, None)
+                if entry is not None:
+                    name_, t0, flagged = entry
+                    self._recent_spans.append(
+                        (name_ + (" [timed out]" if flagged else ""),
+                         time.monotonic() - t0))
+
+    def open_span_report(self) -> str:
+        with self._span_lock:
+            now = time.monotonic()
+            opens = [f"{n}{' [TIMED OUT]' if flagged else ''} "
+                     f"({now - t0:.1f}s open)"
+                     for n, t0, flagged in self._open_spans.values()]
+            recent = [f"{n} ({dt * 1e3:.0f}ms)"
+                      for n, dt in list(self._recent_spans)[-5:]]
+        return (f"open spans: {opens or ['<none>']}; "
+                f"recent: {recent or ['<none>']}")
+
+    def start_monitor(self, interval: float = 1.0):
+        """Background loop that attributes hangs to the oldest open span
+        (a blocked collective cannot raise for itself)."""
+        if self._monitor is not None:
+            return self
+        self._monitor_stop.clear()
+
+        def loop():
+            while not self._monitor_stop.wait(interval):
+                with self._span_lock:
+                    now = time.monotonic()
+                    expired = [(sid, e[0], now - e[1]) for sid, e
+                               in self._open_spans.items()
+                               if now - e[1] > self.timeout and not e[2]]
+                for sid, name, age in expired:
+                    with self._span_lock:
+                        entry = self._open_spans.get(sid)
+                        if entry is None or entry[2]:
+                            continue
+                        entry[2] = True  # flag in place; span stays open
+                    dump = self._dump_trace()
+                    self.timed_out_spans.append((name, age, dump))
+                    import sys
+                    sys.stderr.write(
+                        f"[watchdog] operation {name!r} exceeded "
+                        f"{self.timeout:.0f}s (open {age:.0f}s)"
+                        + (f"; trace dumped to {dump}" if dump else "")
+                        + "\n")
+                    if self.on_timeout is not None:
+                        try:
+                            self.on_timeout()
+                        except BaseException:
+                            pass
+        self._monitor = threading.Thread(target=loop, daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop_monitor(self):
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
 
     def _dump_trace(self):
         """Async trace dump on failure (ref: FLAGS_enable_async_trace)."""
@@ -106,3 +197,36 @@ class Watchdog:
         if "error" in result:
             raise result["error"]
         return result["value"]
+
+
+# -- global collective instrumentation ---------------------------------------
+# collective.py wraps every eager collective in collective_span(); with no
+# installed watchdog the wrapper is free (nullcontext).
+
+_installed: Optional[Watchdog] = None
+
+
+def install_watchdog(timeout: float = 600.0,
+                     on_timeout: Optional[Callable[[], None]] = None,
+                     trace_path: Optional[str] = None) -> Watchdog:
+    """Install a process-wide watchdog whose monitor attributes hangs to
+    the named collective/step spans (ref: FLAGS_enable_async_trace +
+    CommTaskManager background loop)."""
+    global _installed
+    if _installed is not None:
+        _installed.stop_monitor()
+    _installed = Watchdog(timeout, on_timeout, trace_path).start_monitor()
+    return _installed
+
+
+def uninstall_watchdog():
+    global _installed
+    if _installed is not None:
+        _installed.stop_monitor()
+        _installed = None
+
+
+def collective_span(name: str):
+    if _installed is None:
+        return contextlib.nullcontext()
+    return _installed.span(name)
