@@ -255,6 +255,8 @@ def _ensure_jnp(g, aval):
     from .tensor import Tensor
     if isinstance(g, Tensor):
         g = g._data
+    if not isinstance(g, (jax.Array, np.ndarray, int, float)):
+        return g  # structured cotangent (e.g. sparse BCOO): pass through
     return jnp.asarray(g, aval.dtype) if jnp.issubdtype(
         aval.dtype, jnp.inexact) else g
 

@@ -151,8 +151,11 @@ class DistTrainStep:
             self._build()
         if self._opt_state is None:
             self._opt_state = self._init_opt_state()
-        raw = [b._data if isinstance(b, Tensor) else jnp.asarray(
-            np.asarray(b)) for b in batch_and_labels]
+        # device arrays pass through untouched — np.asarray on a jax.Array
+        # would round-trip the whole batch through the host every step
+        raw = [b._data if isinstance(b, Tensor)
+               else b if isinstance(b, jax.Array)
+               else jnp.asarray(np.asarray(b)) for b in batch_and_labels]
         if self.data_sharding is not None:
             raw = [jax.device_put(r, self.data_sharding) for r in raw]
         if len(raw) <= num_labels:

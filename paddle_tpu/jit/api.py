@@ -303,8 +303,12 @@ class TrainStep:
             self._build()
         if self._opt_state is None:
             self._opt_state = self._init_opt_state()
-        raw = tuple(_tree_unwrap(b) if isinstance(b, Tensor)
-                    else jnp.asarray(np.asarray(b)) for b in batch)
+        # device arrays pass through untouched — np.asarray on a jax.Array
+        # would round-trip the whole batch through the host every step
+        raw = tuple(
+            _tree_unwrap(b) if isinstance(b, Tensor)
+            else b if isinstance(b, jax.Array)
+            else jnp.asarray(np.asarray(b)) for b in batch)
         params = {k: t._data for k, t in self._params.items()}
         buffers = {k: t._data for k, t in self._swap.buffers.items()}
         lr = jnp.float32(self.optimizer.get_lr())

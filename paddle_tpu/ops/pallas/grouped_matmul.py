@@ -68,10 +68,11 @@ def _gmm_kernel(ids_ref, lhs_ref, rhs_ref, out_ref):
 
 
 def _gmm_drhs_kernel(ids_ref, lhs_ref, g_ref, out_ref):
-    """drhs[e] = sum over e's token tiles of lhs_tileᵀ @ g_tile. Tiles of
-    one expert are consecutive (tokens sorted by expert), so the output
-    block stays resident across those grid steps and accumulates."""
-    i = pl.program_id(0)
+    """drhs[e] = sum over e's token tiles of lhs_tileᵀ @ g_tile. The grid
+    is (n_tile MAJOR, token_tile minor) so for a fixed n tile every
+    token tile of one expert is consecutive — the output block stays
+    resident in VMEM across those steps and accumulates."""
+    i = pl.program_id(1)  # token tile (minor/fastest)
     is_first = (i == 0) | (ids_ref[i] != ids_ref[jnp.maximum(i - 1, 0)])
     contrib = jnp.dot(
         lhs_ref[...].astype(jnp.float32).T,
@@ -92,19 +93,36 @@ def _gmm_pallas(lhs, rhs, tile_ids, block_t):
     return _gmm_fwd_impl(lhs, rhs, tile_ids, block_t)
 
 
+def _pick_block_n(n: int, k: int, block_t: int) -> int:
+    """Tile the output/N dim so the working set — the [1, K, block_n]
+    weight tile (double-buffered), the [block_t, K] lhs tile, and the
+    [block_t, block_n] out tile — fits the ~16MB scoped VMEM limit (a
+    full [1, K, N] tile blows it at real FFN widths)."""
+    # empirical model (validated against the compiler's scoped-stack
+    # accounting at K=4096): ~3x the naive tile sum covers double
+    # buffering of every ref plus in-kernel f32 temporaries
+    budget = int(13.5 * 1024 * 1024) // 4  # fp32 words under the 16MB cap
+    for b in (512, 256, 128):
+        if n % b == 0 and \
+                3 * (k * b + block_t * k + block_t * b) <= budget:
+            return b
+    return 128 if n % 128 == 0 else n
+
+
 @functools.partial(jax.jit, static_argnames=("block_t",))
 def _gmm_fwd_impl(lhs, rhs, tile_ids, block_t):
     t, k = lhs.shape
     e, _, n = rhs.shape
-    num_tiles = t // block_t
+    block_n = _pick_block_n(n, k, block_t)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(num_tiles,),
+        grid=(t // block_t, n // block_n),
         in_specs=[
-            pl.BlockSpec((block_t, k), lambda i, ids: (i, 0)),
-            pl.BlockSpec((1, k, n), lambda i, ids: (ids[i], 0, 0)),
+            pl.BlockSpec((block_t, k), lambda i, j, ids: (i, 0)),
+            pl.BlockSpec((1, k, block_n), lambda i, j, ids: (ids[i], 0, j)),
         ],
-        out_specs=pl.BlockSpec((block_t, n), lambda i, ids: (i, 0)),
+        out_specs=pl.BlockSpec((block_t, block_n),
+                               lambda i, j, ids: (i, j)),
     )
     return pl.pallas_call(
         _gmm_kernel,
@@ -117,15 +135,16 @@ def _gmm_fwd_impl(lhs, rhs, tile_ids, block_t):
 def _gmm_drhs_impl(lhs, g, tile_ids, e, block_t):
     t, k = lhs.shape
     n = g.shape[1]
-    num_tiles = t // block_t
+    block_n = _pick_block_n(n, k, block_t)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(num_tiles,),
+        grid=(n // block_n, t // block_t),  # n MAJOR: see kernel docstring
         in_specs=[
-            pl.BlockSpec((block_t, k), lambda i, ids: (i, 0)),
-            pl.BlockSpec((block_t, n), lambda i, ids: (i, 0)),
+            pl.BlockSpec((block_t, k), lambda j, i, ids: (i, 0)),
+            pl.BlockSpec((block_t, block_n), lambda j, i, ids: (i, j)),
         ],
-        out_specs=pl.BlockSpec((1, k, n), lambda i, ids: (ids[i], 0, 0)),
+        out_specs=pl.BlockSpec((1, k, block_n),
+                               lambda j, i, ids: (ids[i], 0, j)),
     )
     out = pl.pallas_call(
         _gmm_drhs_kernel,

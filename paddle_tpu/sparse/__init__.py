@@ -1,0 +1,321 @@
+"""paddle.sparse equivalent: COO/CSR tensors over jax.experimental.sparse.
+
+ref: python/paddle/sparse/ (creation.py sparse_coo_tensor/sparse_csr_tensor,
+unary/binary ops, nn.functional) + phi/core/sparse_coo_tensor.h. The BCOO
+format is XLA's sparse representation; matmul/elementwise dispatch through
+it, densifying where the TPU path prefers dense compute (small nnz ratio
+decisions belong to the caller, as in the reference).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+# NOTE: __all__ is defined ONCE at the bottom of this module, after the
+# full op surface exists.
+
+
+class SparseCooTensor(Tensor):
+    """Tensor whose _data is a BCOO array (ref: sparse_coo_tensor.h:49 —
+    indices + values + dims). Dense Tensor methods that densify go through
+    .to_dense()."""
+
+    @property
+    def nnz(self):
+        return int(self._data.nse)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._data.indices, 0, 1))
+
+    def values(self):
+        # through the tape so grads flow back into the sparse graph
+        return apply_op(lambda a: a.data, self, op_name="coo_values")
+
+    def to_dense(self):
+        return apply_op(lambda d: d.todense(), self, op_name="coo_to_dense")
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """ref: sparse/creation.py sparse_coo_tensor(indices [ndim, nnz],
+    values [nnz])."""
+    idx = np.asarray(indices._data if isinstance(indices, Tensor)
+                     else indices)
+    val = values._data if isinstance(values, Tensor) else jnp.asarray(
+        np.asarray(values))
+    if dtype is not None:
+        val = val.astype(dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    coo = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(coo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """ref: sparse/creation.py sparse_csr_tensor — stored as BCOO
+    internally (csr -> coo expansion), same API surface."""
+    crows_np = np.asarray(crows._data if isinstance(crows, Tensor)
+                          else crows)
+    cols_np = np.asarray(cols._data if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1),
+                     np.diff(crows_np))
+    idx = np.stack([rows, cols_np])
+    return sparse_coo_tensor(idx, values, shape, dtype,
+                             stop_gradient=stop_gradient)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def _coo(x):
+    if isinstance(x, SparseCooTensor):
+        return x
+    raise TypeError(f"expected SparseCooTensor, got {type(x).__name__}")
+
+
+def add(x, y):
+    """ref: sparse/binary.py add."""
+    def f(a, b):
+        return (a.todense() if isinstance(a, jsparse.BCOO) else a) + \
+               (b.todense() if isinstance(b, jsparse.BCOO) else b)
+    out = apply_op(f, x, y, op_name="sparse_add")
+    return out
+
+
+def multiply(x, y):
+    def f(a, b):
+        return (a.todense() if isinstance(a, jsparse.BCOO) else a) * \
+               (b.todense() if isinstance(b, jsparse.BCOO) else b)
+    return apply_op(f, x, y, op_name="sparse_multiply")
+
+
+def matmul(x, y):
+    """Sparse @ dense (ref: sparse/matmul.py) — BCOO dot_general keeps the
+    sparse operand sparse through XLA."""
+    def f(a, b):
+        if isinstance(a, jsparse.BCOO):
+            return jsparse.bcoo_dot_general(
+                a, b, dimension_numbers=(([a.ndim - 1], [0]), ([], [])))
+        return a @ b
+    return apply_op(f, x, y, op_name="sparse_matmul")
+
+
+def masked_matmul(x, y, mask):
+    """Dense @ dense with sparse output mask (ref: sparse/matmul.py
+    masked_matmul)."""
+    def f(a, b, m):
+        dense = a @ b
+        return jnp.where(m.todense() != 0, dense, 0.0)
+    return apply_op(f, x, y, mask, op_name="masked_matmul")
+
+
+# relu defined below via _unary_on_values (same pattern as sin/tanh/...)
+
+
+# ---------------------------------------------------------------------------
+# round-2 completion: the full reference surface (ref:
+# python/paddle/sparse/__init__.py __all__ — unary ops on values, binary
+# ops, matmul family, layout utilities) + the sparse.nn subpackage.
+# ---------------------------------------------------------------------------
+
+def _unary_on_values(name, np_safe_fn):
+    """Sparse unary ops act on the stored values; the zero pattern is
+    preserved for zero-preserving fns (the reference's contract — these
+    ops are only registered for f(0)=0 functions)."""
+    def op(x):
+        def f(a):
+            if isinstance(a, jsparse.BCOO):
+                return jsparse.BCOO((np_safe_fn(a.data), a.indices),
+                                    shape=a.shape,
+                                    indices_sorted=a.indices_sorted,
+                                    unique_indices=a.unique_indices)
+            return np_safe_fn(a)
+        out = apply_op(f, x, op_name=f"sparse_{name}")
+        return _rewrap(out, x)
+    op.__name__ = name
+    return op
+
+
+def _rewrap(out, like):
+    if isinstance(like, SparseCooTensor) and isinstance(
+            out._data, jsparse.BCOO):
+        return SparseCooTensor(out._data, stop_gradient=out.stop_gradient,
+                               node=out._node, out_index=out._out_index)
+    return out
+
+
+sin = _unary_on_values("sin", jnp.sin)
+tan = _unary_on_values("tan", jnp.tan)
+asin = _unary_on_values("asin", jnp.arcsin)
+atan = _unary_on_values("atan", jnp.arctan)
+sinh = _unary_on_values("sinh", jnp.sinh)
+tanh = _unary_on_values("tanh", jnp.tanh)
+asinh = _unary_on_values("asinh", jnp.arcsinh)
+atanh = _unary_on_values("atanh", jnp.arctanh)
+sqrt = _unary_on_values("sqrt", jnp.sqrt)
+square = _unary_on_values("square", jnp.square)
+log1p = _unary_on_values("log1p", jnp.log1p)
+abs = _unary_on_values("abs", jnp.abs)  # noqa: A001 (reference name)
+neg = _unary_on_values("neg", jnp.negative)
+expm1 = _unary_on_values("expm1", jnp.expm1)
+deg2rad = _unary_on_values("deg2rad", jnp.deg2rad)
+rad2deg = _unary_on_values("rad2deg", jnp.rad2deg)
+relu = _unary_on_values("relu", jax.nn.relu)
+
+
+def pow(x, factor):  # noqa: A001 (reference name)
+    return _rewrap(apply_op(
+        lambda a: jsparse.BCOO((jnp.power(a.data, factor), a.indices),
+                               shape=a.shape)
+        if isinstance(a, jsparse.BCOO) else jnp.power(a, factor),
+        x, op_name="sparse_pow"), x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    def f(a):
+        if isinstance(a, jsparse.BCOO):
+            idx = a.indices.astype(index_dtype) if index_dtype else \
+                a.indices
+            val = a.data.astype(value_dtype) if value_dtype else a.data
+            return jsparse.BCOO((val, idx), shape=a.shape)
+        return a.astype(value_dtype) if value_dtype else a
+    return _rewrap(apply_op(f, x, op_name="sparse_cast"), x)
+
+
+def isnan(x):
+    return _rewrap(apply_op(
+        lambda a: jsparse.BCOO((jnp.isnan(a.data), a.indices),
+                               shape=a.shape)
+        if isinstance(a, jsparse.BCOO) else jnp.isnan(a),
+        x, op_name="sparse_isnan"), x)
+
+
+def subtract(x, y):
+    def f(a, b):
+        da = a.todense() if isinstance(a, jsparse.BCOO) else a
+        db = b.todense() if isinstance(b, jsparse.BCOO) else b
+        return da - db
+    return apply_op(f, x, y, op_name="sparse_subtract")
+
+
+def divide(x, y):
+    def f(a, b):
+        da = a.todense() if isinstance(a, jsparse.BCOO) else a
+        db = b.todense() if isinstance(b, jsparse.BCOO) else b
+        return da / db
+    return apply_op(f, x, y, op_name="sparse_divide")
+
+
+def mv(x, vec):
+    """Sparse matrix @ dense vector (ref: sparse/matmul.py mv)."""
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """ref: sparse/matmul.py addmm: beta*input + alpha*(x@y)."""
+    def f(inp, a, b):
+        di = inp.todense() if isinstance(inp, jsparse.BCOO) else inp
+        if isinstance(a, jsparse.BCOO):
+            prod = jsparse.bcoo_dot_general(
+                a, b, dimension_numbers=(([a.ndim - 1], [0]), ([], [])))
+        else:
+            prod = a @ b
+        return beta * di + alpha * prod
+    return apply_op(f, input, x, y, op_name="sparse_addmm")
+
+
+def mask_as(x, mask):
+    """Keep x's entries at mask's sparsity pattern
+    (ref: sparse/multiary.py mask_as)."""
+    def f(a, m):
+        da = a.todense() if isinstance(a, jsparse.BCOO) else a
+        vals = da[tuple(m.indices[:, i] for i in range(m.indices.shape[1]))]
+        return jsparse.BCOO((vals, m.indices), shape=m.shape)
+    out = apply_op(f, x, mask, op_name="sparse_mask_as")
+    return SparseCooTensor(out._data, stop_gradient=out.stop_gradient,
+                           node=out._node, out_index=out._out_index)
+
+
+def coalesce(x):
+    """Merge duplicate indices (ref: sparse/unary.py coalesce)."""
+    def f(a):
+        return jsparse.bcoo_sum_duplicates(a)
+    out = apply_op(f, x, op_name="sparse_coalesce")
+    return _rewrap(out, x)
+
+
+def transpose(x, perm):
+    def f(a):
+        if isinstance(a, jsparse.BCOO):
+            return jsparse.bcoo_transpose(a, permutation=tuple(perm))
+        return jnp.transpose(a, perm)
+    return _rewrap(apply_op(f, x, op_name="sparse_transpose"), x)
+
+
+def reshape(x, shape):
+    def f(a):
+        if isinstance(a, jsparse.BCOO):
+            return jsparse.bcoo_reshape(a, new_sizes=tuple(shape))
+        return jnp.reshape(a, shape)
+    return _rewrap(apply_op(f, x, op_name="sparse_reshape"), x)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    def f(a):
+        da = a.todense() if isinstance(a, jsparse.BCOO) else a
+        out = jnp.sum(da, axis=axis, keepdims=keepdim)
+        return out.astype(dtype) if dtype else out
+    return apply_op(f, x, op_name="sparse_sum")
+
+
+_py_slice = slice  # captured before the op below shadows the builtin
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    def f(a):
+        da = a.todense() if isinstance(a, jsparse.BCOO) else a
+        sl = [_py_slice(None)] * da.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            sl[ax] = _py_slice(st, en)
+        return da[tuple(sl)]
+    return apply_op(f, x, op_name="sparse_slice")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    """ref: sparse/unary.py pca_lowrank — dense SVD on the densified
+    matrix (the reference likewise densifies for the factorization)."""
+    def f(a):
+        da = a.todense() if isinstance(a, jsparse.BCOO) else a
+        m, n = da.shape
+        k = q if q is not None else min(6, m, n)
+        if center:
+            da = da - da.mean(axis=0, keepdims=True)
+        u, s, vt = jnp.linalg.svd(da, full_matrices=False)
+        return u[:, :k], s[:k], vt[:k].T
+    return apply_op(f, x, op_name="sparse_pca_lowrank")
+
+
+from . import nn  # noqa: E402,F401
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "pow", "pca_lowrank", "cast",
+    "neg", "deg2rad", "rad2deg", "expm1", "mv", "matmul", "mask_as",
+    "masked_matmul", "addmm", "add", "subtract", "transpose", "sum",
+    "multiply", "divide", "coalesce", "is_same_shape", "reshape",
+    "isnan", "slice", "relu", "nn",
+]

@@ -1,0 +1,230 @@
+"""paddle.sparse.nn: layers over sparse COO activations.
+
+ref: python/paddle/sparse/nn/__init__.py (ReLU/ReLU6/LeakyReLU/Softmax/
+BatchNorm/SyncBatchNorm/Conv2D/Conv3D/SubmConv2D/SubmConv3D/MaxPool3D,
+kernels under paddle/phi/kernels/sparse/). TPU-native stance: activations
+keep the COO (indices, values) pair; pointwise ops act on values, conv/
+pool densify through XLA's conv (which the MXU wants anyway) and
+re-sparsify — SubmConv masks the output to the input's active sites, the
+submanifold contract. A gather/scatter Pallas kernel is the future perf
+path for very low densities; these implementations are the numeric
+contract.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ...core.autograd import apply_op
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from .. import SparseCooTensor, sparse_coo_tensor
+from . import functional  # noqa: F401
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D",
+           "SubmConv3D", "MaxPool3D"]
+
+
+def _values_layer(name, fn):
+    class _L(Layer):
+        def forward(self, x):
+            return functional._apply_values(x, fn, name)
+    _L.__name__ = name
+    _L.__qualname__ = name
+    _L.__doc__ = f"ref: sparse/nn/layer/activation.py {name}."
+    return _L
+
+
+ReLU = _values_layer("ReLU", jax.nn.relu)
+ReLU6 = _values_layer("ReLU6", lambda v: jnp.clip(v, 0, 6))
+
+
+class LeakyReLU(Layer):
+    """ref: sparse/nn/layer/activation.py LeakyReLU(negative_slope)."""
+
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = float(negative_slope)
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    """ref: sparse/nn/layer/activation.py Softmax — softmax over the last
+    dense dim, computed per row across the ACTIVE entries only."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    """ref: sparse/nn/layer/norm.py BatchNorm — normalizes the values
+    table [nnz, C] over active sites (channels-last sparse layout)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.register_buffer("_mean", self._zeros(num_features))
+        self.register_buffer("_variance", self._ones(num_features))
+
+    @staticmethod
+    def _zeros(n):
+        from ...core.tensor import Tensor
+        return Tensor(jnp.zeros((n,), jnp.float32))
+
+    @staticmethod
+    def _ones(n):
+        from ...core.tensor import Tensor
+        return Tensor(jnp.ones((n,), jnp.float32))
+
+    def forward(self, x):
+        training = self.training
+        vals = x.values()
+
+        def f(v, w, b, m, var):
+            if training:
+                mean = v.mean(axis=0)
+                vvar = v.var(axis=0)
+            else:
+                mean, vvar = m, var
+            out = (v - mean) / jnp.sqrt(vvar + self.epsilon) * w + b
+            return out, mean, vvar
+
+        out, mean, vvar = apply_op(f, vals, self.weight, self.bias,
+                                   self._mean, self._variance,
+                                   op_name="sparse_batch_norm")
+        if training:
+            mom = self.momentum
+            self._mean._data = mom * self._mean._data + \
+                (1 - mom) * mean._data
+            self._variance._data = mom * self._variance._data + \
+                (1 - mom) * vvar._data
+        coo = x._data
+        new = jsparse.BCOO((out._data.astype(coo.data.dtype), coo.indices),
+                           shape=coo.shape)
+        res = SparseCooTensor(new, stop_gradient=out.stop_gradient,
+                              node=out._node, out_index=out._out_index)
+        return res
+
+
+class SyncBatchNorm(BatchNorm):
+    """ref: sparse/nn/layer/norm.py SyncBatchNorm — on a single controller
+    the compiled mesh program already sees the global batch; cross-process
+    eager sync rides the collective API when installed."""
+
+
+class _SparseConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd,
+                 stride=1, padding=0, dilation=1, groups=1, subm=False,
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 name=None):
+        super().__init__()
+        if groups != 1:
+            raise NotImplementedError("sparse conv groups != 1")
+        self.nd = nd
+        self.subm = subm
+        ks = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size,) * nd
+        self.kernel_size = tuple(int(k) for k in ks)
+        self.stride = stride if isinstance(stride, (tuple, list)) \
+            else (stride,) * nd
+        self.padding = padding if isinstance(padding, (tuple, list)) \
+            else (padding,) * nd
+        self.dilation = dilation if isinstance(dilation, (tuple, list)) \
+            else (dilation,) * nd
+        # reference layout: kernel [*ks, in, out] (sparse convs are
+        # channels-last, ref sparse/nn/layer/conv.py)
+        self.weight = self.create_parameter(
+            list(self.kernel_size) + [in_channels, out_channels],
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        return functional._sparse_conv(
+            x, self.weight, self.bias, self.nd, self.stride, self.padding,
+            self.dilation, self.subm)
+
+
+class Conv2D(_SparseConvNd):
+    """ref: sparse/nn/layer/conv.py Conv2D (NHWC sparse input)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2,
+                         stride, padding, dilation, groups, False,
+                         weight_attr, bias_attr, data_format)
+
+
+class Conv3D(_SparseConvNd):
+    """ref: sparse/nn/layer/conv.py Conv3D (NDHWC sparse input)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3,
+                         stride, padding, dilation, groups, False,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv2D(_SparseConvNd):
+    """Submanifold conv: output active set == input active set
+    (ref: sparse/nn/layer/conv.py SubmConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2,
+                         stride, padding, dilation, groups, True,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv3D(_SparseConvNd):
+    """ref: sparse/nn/layer/conv.py SubmConv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3,
+                         stride, padding, dilation, groups, True,
+                         weight_attr, bias_attr, data_format)
+
+
+class MaxPool3D(Layer):
+    """ref: sparse/nn/layer/pooling.py MaxPool3D (NDHWC sparse input)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride if stride is not None else kernel_size
+        self.padding = padding
+
+    def forward(self, x):
+        return functional.max_pool3d(x, self.kernel_size, self.stride,
+                                     self.padding)

@@ -1,0 +1,211 @@
+"""paddle.sparse.nn.functional.
+
+ref: python/paddle/sparse/nn/functional/ (activation.py, conv.py,
+pooling.py, transformer.py attention). Conv/pool densify through XLA's
+conv/reduce_window (MXU path) and re-sparsify; attention is the CSR-
+masked softmax(QK^T)V contract of the reference's sparse attention
+kernel (phi/kernels/sparse/gpu/fused_attention_kernel.cu).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+__all__ = ["relu", "relu6", "leaky_relu", "softmax", "conv2d", "conv3d",
+           "subm_conv2d", "subm_conv3d", "max_pool3d", "attention"]
+
+
+def _rewrap(out, like):
+    from .. import SparseCooTensor
+    if isinstance(out._data, jsparse.BCOO):
+        return SparseCooTensor(out._data, stop_gradient=out.stop_gradient,
+                               node=out._node, out_index=out._out_index)
+    return out
+
+
+def _apply_values(x, fn, name):
+    def f(a):
+        if isinstance(a, jsparse.BCOO):
+            return jsparse.BCOO((fn(a.data), a.indices), shape=a.shape)
+        return fn(a)
+    return _rewrap(apply_op(f, x, op_name=f"sparse_{name}"), x)
+
+
+def relu(x, name=None):
+    return _apply_values(x, jax.nn.relu, "relu")
+
+
+def relu6(x, name=None):
+    return _apply_values(x, lambda v: jnp.clip(v, 0, 6), "relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _apply_values(
+        x, lambda v: jnp.where(v >= 0, v, negative_slope * v),
+        "leaky_relu")
+
+
+def softmax(x, axis=-1, name=None):
+    """Row softmax over ACTIVE entries only (ref: sparse softmax kernel:
+    zeros do not participate)."""
+    def f(a):
+        if not isinstance(a, jsparse.BCOO):
+            return jax.nn.softmax(a, axis=axis)
+        if axis not in (-1, a.ndim - 1):
+            raise ValueError("sparse softmax supports the last axis")
+        # segment-softmax keyed by the row (= all index dims but last)
+        idx = a.indices
+        strides = np.cumprod([1] + list(a.shape[-2::-1]))[::-1]
+        row = jnp.zeros((idx.shape[0],), jnp.int32)
+        for d in range(idx.shape[1] - 1):
+            row = row + idx[:, d].astype(jnp.int32) * int(strides[d + 1])
+        nrows = int(np.prod(a.shape[:-1]))
+        mx = jax.ops.segment_max(a.data, row, num_segments=nrows)
+        e = jnp.exp(a.data - mx[row])
+        denom = jax.ops.segment_sum(e, row, num_segments=nrows)
+        return jsparse.BCOO((e / denom[row], a.indices), shape=a.shape)
+    return _rewrap(apply_op(f, x, op_name="sparse_softmax"), x)
+
+
+def _sparse_conv(x, weight, bias, nd, stride, padding, dilation, subm):
+    """Densify -> lax conv (channels-last) -> re-sparsify; submanifold
+    masks outputs to the input active set
+    (ref: phi/kernels/sparse/conv_kernel)."""
+    def f(a, w, *rest):
+        b = rest[0] if rest else None
+        dense = a.todense() if isinstance(a, jsparse.BCOO) else a
+        n = dense.shape[0]
+        cin = dense.shape[-1]
+        spatial = dense.shape[1:-1]
+        dn = jax.lax.conv_dimension_numbers(
+            dense.shape, w.shape,
+            ("NHWC", "HWIO", "NHWC") if nd == 2 else
+            ("NDHWC", "DHWIO", "NDHWC"))
+        pad = [(int(p), int(p)) for p in
+               (padding if isinstance(padding, (tuple, list))
+                else (padding,) * nd)]
+        out = jax.lax.conv_general_dilated(
+            dense.astype(jnp.float32), w.astype(jnp.float32),
+            window_strides=tuple(int(s) for s in (
+                stride if isinstance(stride, (tuple, list))
+                else (stride,) * nd)),
+            padding=pad,
+            rhs_dilation=tuple(int(d) for d in (
+                dilation if isinstance(dilation, (tuple, list))
+                else (dilation,) * nd)),
+            dimension_numbers=dn)
+        if b is not None:
+            out = out + b
+        if subm and isinstance(a, jsparse.BCOO):
+            # submanifold: only the input's active sites stay active
+            active = jnp.any(dense != 0, axis=-1, keepdims=True)
+            out = jnp.where(active, out, 0.0)
+        return out.astype(dense.dtype)
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    dense_out = apply_op(f, *args, op_name="sparse_conv")
+    return _densify_to_coo(dense_out)
+
+
+def _densify_to_coo(dense_t):
+    from .. import SparseCooTensor
+    out = apply_op(
+        lambda d: jsparse.bcoo_fromdense(d, n_batch=0, n_dense=1),
+        dense_t, op_name="dense_to_coo")
+    return SparseCooTensor(out._data, stop_gradient=out.stop_gradient,
+                           node=out._node, out_index=out._out_index)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NHWC", name=None):
+    """ref: sparse/nn/functional/conv.py conv2d."""
+    return _sparse_conv(x, weight, bias, 2, stride, padding, dilation,
+                        False)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """ref: sparse/nn/functional/conv.py conv3d."""
+    return _sparse_conv(x, weight, bias, 3, stride, padding, dilation,
+                        False)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    """ref: sparse/nn/functional/conv.py subm_conv2d."""
+    return _sparse_conv(x, weight, bias, 2, stride, padding, dilation,
+                        True)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """ref: sparse/nn/functional/conv.py subm_conv3d."""
+    return _sparse_conv(x, weight, bias, 3, stride, padding, dilation,
+                        True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """ref: sparse/nn/functional/pooling.py max_pool3d (NDHWC)."""
+    ks = kernel_size if isinstance(kernel_size, (tuple, list)) \
+        else (kernel_size,) * 3
+    st = stride if stride is not None else ks
+    st = st if isinstance(st, (tuple, list)) else (st,) * 3
+    pd = padding if isinstance(padding, (tuple, list)) else (padding,) * 3
+
+    def f(a):
+        window = (1,) + tuple(int(k) for k in ks) + (1,)
+        strides = (1,) + tuple(int(s) for s in st) + (1,)
+        pads = [(0, 0)] + [(int(p), int(p)) for p in pd] + [(0, 0)]
+        if isinstance(a, jsparse.BCOO):
+            # max over ACTIVE sites only (the reference sparse maxpool
+            # contract): inactive positions become -inf so an
+            # all-negative active window still returns its active max
+            dense = a.todense()
+            ones = jsparse.BCOO(
+                (jnp.ones_like(a.data), a.indices), shape=a.shape)
+            active = ones.todense() > 0
+            dense = jnp.where(active, dense, -jnp.inf)
+        else:
+            dense = a
+        out = jax.lax.reduce_window(
+            dense, -jnp.inf, jax.lax.max, window, strides, pads)
+        return jnp.where(jnp.isfinite(out), out, 0.0)  # empty windows
+
+    return _densify_to_coo(apply_op(f, x, op_name="sparse_max_pool3d"))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-masked attention (ref: sparse/nn/functional/transformer.py
+    attention — softmax over the CSR pattern of sparse_mask, then @ V).
+    q/k/v: [B, H, L, D]; sparse_mask: SparseCsrTensor/CooTensor with
+    shape [B*H, L, L]."""
+    def f(q, k, v, m, *rest):
+        b, h, l, d = q.shape
+        mask_dense = (m.todense() if isinstance(m, jsparse.BCOO)
+                      else m).reshape(b, h, l, l)
+        logits = jnp.einsum("bhld,bhmd->bhlm", q, k) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32))
+        neg = jnp.asarray(-1e30, logits.dtype)
+        logits = jnp.where(mask_dense != 0, logits, neg)
+        i = 0
+        if key_padding_mask is not None:
+            kpm = rest[i]; i += 1
+            logits = jnp.where(kpm[:, None, None, :] != 0, logits, neg)
+        if attn_mask is not None:
+            am = rest[i]; i += 1
+            logits = jnp.where(am != 0, logits, neg)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(jnp.any(mask_dense != 0, -1, keepdims=True),
+                          probs, 0.0)
+        return jnp.einsum("bhlm,bhmd->bhld", probs, v)
+
+    extra = [t for t in (key_padding_mask, attn_mask) if t is not None]
+    return apply_op(f, query, key, value, sparse_mask, *extra,
+                    op_name="sparse_attention")

@@ -1,0 +1,230 @@
+"""Sparse depth (VERDICT round-1 missing item 9): full paddle.sparse op
+surface + sparse.nn conv/pool/norm/attention.
+
+ref: python/paddle/sparse/ + phi/kernels/sparse/; oracles are the dense
+equivalents (the submanifold contract checked explicitly).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+from paddle_tpu.sparse import SparseCooTensor
+
+
+def _coo_from_dense(x, n_dense=0):
+    return SparseCooTensor(jsparse.bcoo_fromdense(jnp.asarray(x),
+                                                  n_dense=n_dense))
+
+
+class TestSurface:
+    def _ref_all(self, p):
+        import ast
+        t = ast.parse(open(p).read())
+        for n in ast.walk(t):
+            if isinstance(n, ast.Assign):
+                for tg in n.targets:
+                    if getattr(tg, "id", None) == "__all__":
+                        return [ast.literal_eval(e) for e in n.value.elts]
+
+    def test_sparse_all_covered(self):
+        ref = self._ref_all(
+            "/root/reference/python/paddle/sparse/__init__.py")
+        assert [n for n in ref if not hasattr(sparse, n)] == []
+
+    def test_sparse_nn_all_covered(self):
+        ref = self._ref_all(
+            "/root/reference/python/paddle/sparse/nn/__init__.py")
+        assert [n for n in ref if not hasattr(sparse.nn, n)] == []
+
+
+class TestOps:
+    def _t(self):
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([0.5, -0.25, 0.75], np.float32)
+        return sparse.sparse_coo_tensor(idx, vals, (3, 3)), idx, vals
+
+    def test_unary_preserves_pattern(self):
+        t, idx, vals = self._t()
+        for name in ("sin", "tanh", "sqrt", "square", "abs", "neg",
+                     "expm1", "log1p", "asinh", "atan"):
+            fn = getattr(sparse, name)
+            v = np.abs(vals) if name in ("sqrt", "log1p") else vals
+            tt = sparse.sparse_coo_tensor(idx, v, (3, 3))
+            out = fn(tt)
+            assert out.nnz == 3
+            ref = getattr(np, {"neg": "negative", "asinh": "arcsinh",
+                               "atan": "arctan"}.get(name, name))(v)
+            np.testing.assert_allclose(np.asarray(out.values()._data),
+                                       ref, rtol=1e-5)
+
+    def test_matmul_and_addmm(self):
+        t, idx, vals = self._t()
+        d = np.asarray(t.to_dense()._data)
+        y = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sparse.matmul(t, paddle.to_tensor(y))._data),
+            d @ y, rtol=1e-5)
+        inp = np.random.randn(3, 4).astype(np.float32)
+        out = sparse.addmm(paddle.to_tensor(inp), t, paddle.to_tensor(y),
+                           beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   0.5 * inp + 2.0 * (d @ y), rtol=1e-5)
+
+    def test_mask_as_and_coalesce(self):
+        t, idx, vals = self._t()
+        dense = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(
+            3, 3))
+        masked = sparse.mask_as(dense, t)
+        assert masked.nnz == 3
+        got = np.asarray(masked.to_dense()._data)
+        exp = np.zeros((3, 3), np.float32)
+        exp[0, 1], exp[1, 0], exp[2, 2] = 1, 3, 8
+        np.testing.assert_allclose(got, exp)
+        # duplicate indices merge
+        dup = sparse.sparse_coo_tensor(
+            np.array([[0, 0], [1, 1]]), np.array([1.0, 2.0], np.float32),
+            (2, 2))
+        merged = sparse.coalesce(dup)
+        np.testing.assert_allclose(
+            np.asarray(merged.to_dense()._data)[0, 1], 3.0)
+
+    def test_softmax_active_only(self):
+        idx = np.array([[0, 0, 1], [0, 2, 1]])
+        vals = np.array([1.0, 1.0, 5.0], np.float32)
+        t = sparse.sparse_coo_tensor(idx, vals, (2, 3))
+        sm = sparse.nn.functional.softmax(t)
+        d = np.asarray(sm.to_dense()._data)
+        np.testing.assert_allclose(d[0, 0], 0.5, rtol=1e-5)
+        np.testing.assert_allclose(d[0, 2], 0.5, rtol=1e-5)
+        np.testing.assert_allclose(d[1, 1], 1.0, rtol=1e-5)
+        assert d[0, 1] == 0.0
+
+
+class TestSparseNN:
+    def test_conv3d_matches_dense_oracle(self):
+        rng = np.random.default_rng(0)
+        x = (rng.standard_normal((1, 4, 4, 4, 2)) *
+             (rng.random((1, 4, 4, 4, 1)) > 0.6)).astype(np.float32)
+        conv = sparse.nn.Conv3D(2, 3, 2)
+        out = conv(_coo_from_dense(x, n_dense=1))
+        w = np.asarray(conv.weight._data)
+        b = np.asarray(conv.bias._data)
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+        exp = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1, 1), [(0, 0)] * 3,
+            dimension_numbers=dn)) + b
+        np.testing.assert_allclose(np.asarray(out.to_dense()._data), exp,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv_preserves_active_set(self):
+        x = np.zeros((1, 5, 5, 2), np.float32)
+        x[0, 1, 1] = [1.0, 2.0]
+        x[0, 3, 2] = [3.0, -1.0]
+        conv = sparse.nn.SubmConv2D(2, 4, 3, padding=1)
+        out = conv(_coo_from_dense(x, n_dense=1))
+        od = np.asarray(out.to_dense()._data)
+        active = np.broadcast_to(x.any(-1)[..., None], od.shape)
+        assert (od[~active] == 0).all()
+        assert np.abs(od[0, 1, 1]).sum() > 0
+
+    def test_batchnorm_normalizes_values(self):
+        rng = np.random.default_rng(0)
+        x = (rng.standard_normal((1, 4, 4, 4, 3)) * 5 + 2).astype(
+            np.float32) * (rng.random((1, 4, 4, 4, 1)) > 0.5)
+        bn = sparse.nn.BatchNorm(3)
+        out = bn(_coo_from_dense(x.astype(np.float32), n_dense=1))
+        v = np.asarray(out.values()._data)
+        np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(v.std(0), 1.0, atol=1e-2)
+
+    def test_maxpool3d(self):
+        rng = np.random.default_rng(1)
+        active = rng.random((1, 4, 4, 4, 1)) > 0.5
+        x = (rng.standard_normal((1, 4, 4, 4, 2)) * active).astype(
+            np.float32)
+        out = sparse.nn.MaxPool3D(2)(_coo_from_dense(x, n_dense=1))
+        # oracle: max over ACTIVE sites only (-inf elsewhere), empty
+        # windows -> 0 (dropped from the sparse result)
+        masked = np.where(np.broadcast_to(active, x.shape), x, -np.inf)
+        exp = np.asarray(jax.lax.reduce_window(
+            jnp.asarray(masked), -jnp.inf, jax.lax.max,
+            (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), "VALID"))
+        exp = np.where(np.isfinite(exp), exp, 0.0)
+        np.testing.assert_allclose(np.asarray(out.to_dense()._data), exp)
+
+    def test_activation_layers(self):
+        x = np.array([[-1.0, 0.0], [0.0, 7.0]], np.float32)
+        t = _coo_from_dense(x)
+        np.testing.assert_allclose(
+            np.asarray(sparse.nn.ReLU()(t).to_dense()._data),
+            np.maximum(x, 0))
+        np.testing.assert_allclose(
+            np.asarray(sparse.nn.ReLU6()(t).to_dense()._data),
+            np.clip(x, 0, 6))
+
+    def test_attention_matches_dense_oracle(self):
+        rng = np.random.default_rng(0)
+        B, H, L, D = 1, 2, 4, 8
+        q = rng.standard_normal((B, H, L, D)).astype(np.float32)
+        k = rng.standard_normal((B, H, L, D)).astype(np.float32)
+        v = rng.standard_normal((B, H, L, D)).astype(np.float32)
+        mask = (rng.random((B * H, L, L)) > 0.3).astype(np.float32)
+        mask[:, 0, :] = 1.0  # no fully-masked rows
+        out = sparse.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            _coo_from_dense(mask))
+        logits = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(D)
+        logits = np.where(mask.reshape(B, H, L, L) != 0, logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        p = np.where(mask.reshape(B, H, L, L).any(-1, keepdims=True),
+                     p, 0.0)
+        np.testing.assert_allclose(np.asarray(out._data), p @ v,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_training_through_sparse_conv(self):
+        rng = np.random.default_rng(0)
+        x = (rng.standard_normal((1, 5, 5, 2)) *
+             (rng.random((1, 5, 5, 1)) > 0.5)).astype(np.float32)
+        net = sparse.nn.SubmConv2D(2, 2, 3, padding=1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        losses = []
+        for _ in range(5):
+            out = net(_coo_from_dense(x, n_dense=1))
+            loss = (out.values() ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
+class TestReviewRegressions:
+    def test_leaky_relu_slope_respected(self):
+        x = np.array([[-1.0, 0.0], [0.0, 2.0]], np.float32)
+        out = sparse.nn.LeakyReLU(0.2)(_coo_from_dense(x))
+        np.testing.assert_allclose(
+            np.asarray(out.to_dense()._data),
+            np.where(x >= 0, x, 0.2 * x), rtol=1e-6)
+
+    def test_maxpool_all_negative_active_window(self):
+        """Active-sites-only max: implicit zeros must NOT win over
+        negative active values (reference sparse maxpool contract)."""
+        x = np.zeros((1, 2, 2, 2, 1), np.float32)
+        x[0, 0, 0, 0, 0] = -3.0
+        out = sparse.nn.MaxPool3D(2)(_coo_from_dense(x, n_dense=1))
+        np.testing.assert_allclose(
+            np.asarray(out.to_dense()._data).reshape(-1), [-3.0])
+
+    def test_relu_preserves_layout_flags(self):
+        idx = np.array([[0, 1], [0, 1]])
+        t = sparse.sparse_coo_tensor(idx, np.array([-1.0, 2.0], np.float32),
+                                     (2, 2))
+        out = sparse.relu(t)
+        assert out._data.indices_sorted == t._data.indices_sorted
