@@ -169,6 +169,44 @@ def _maybe_check_nan_inf(name: str, outs) -> None:
         flush_nan_checks()
 
 
+# Per-op dispatch gate backed by the native OpRegistry (the KernelFactory
+# analog — ref: phi/core/kernel_factory.cc:267 SelectKernelOrThrowError):
+# first dispatch of each op name looks up its descriptor (arity bounds,
+# has_vjp) and validates the call; later dispatches are one dict hit.
+# has_vjp=False ops (samplers) skip the tape entirely — their outputs are
+# not differentiable by contract.
+# name -> [has_vjp: bool, dispatch_count: int] (mutated in place)
+_op_gate_cache: Dict[str, list] = {}
+
+
+def _op_gate(name: str, n_args: int) -> bool:
+    """Returns has_vjp for the op; validates arity on first dispatch and
+    counts dispatches (introspection via op_registry.dispatch_counts)."""
+    hit = _op_gate_cache.get(name)
+    if hit is not None:
+        hit[1] += 1
+        return hit[0]
+    has_vjp = True
+    try:
+        from ..ops.op_registry import get_op_info
+        info = get_op_info(name)
+    except Exception:
+        info = None
+    if info:
+        has_vjp = bool(info.get("has_vjp", True))
+        # the descriptor's nargs caps the POSITIONAL surface; attrs may
+        # also ride the kernel closure, so there is no lower bound here,
+        # and variadic ops (one positional per tensor) have no cap
+        hi = max(int(info.get("nargs", 1)), int(info.get("nin", 0)))
+        if n_args > hi and not info.get("variadic", False):
+            raise TypeError(
+                f"op {name!r} dispatched with {n_args} positional args "
+                f"but its registry descriptor allows at most {hi} "
+                f"(ops.yaml contract)")
+    _op_gate_cache[name] = [has_vjp, 1]
+    return has_vjp
+
+
 # When paddle_tpu.static is recording (enable_static / program_guard), this
 # holds a callable(fn, args, kwargs, outs, name) appending to the Program
 # tape; None in the (default) eager mode — one global check per op.
@@ -203,12 +241,13 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
         def record_fn(*a, _fn=fn, _name=name, **kw):
             return _fn(*maybe_cast_inputs(_name, list(a)), **kw)
 
+    has_vjp = _op_gate(name, len(args))
     diff_idx = [
         i for i, a in enumerate(args)
         if isinstance(a, Tensor) and not a.stop_gradient
         and _is_diff_dtype(a._data)
     ]
-    record = _state.enabled and bool(diff_idx)
+    record = _state.enabled and bool(diff_idx) and has_vjp
 
     if not record:
         out = fn(*datas, **kwargs)

@@ -25,10 +25,55 @@ from .framework.io import load as _load, save as _save
 from .jit.api import functionalize
 
 __all__ = ["Config", "Predictor", "create_predictor", "save_inference_model",
-           "load_inference_model"]
+           "load_inference_model", "serve"]
 
 
-def save_inference_model(path: str, model, input_spec=None):
+def _forced_eval_fwd(model, apply):
+    """Forward that serves in eval semantics without disturbing the
+    caller's per-sublayer modes."""
+    def fwd(params, buffers, *args):
+        layers = model.sublayers(include_self=True)
+        snapshot = [(l, l.training) for l in layers]
+        try:
+            for l in layers:
+                l.training = False
+            out, _ = apply(params, buffers, *args)
+        finally:
+            for l, t in snapshot:
+                l.training = t
+        return out
+    return fwd
+
+
+def _export_aot(model, input_spec):
+    """AOT-serialize the compiled eval forward via jax.export — the
+    StableHLO travels inside the artifact, so a serving process can run
+    it WITHOUT the model's Python class being importable
+    (ref: AnalysisPredictor loads a self-contained program+params;
+    the reference never needs the training script either)."""
+    apply, params, buffers = functionalize(model)
+    jitted = jax.jit(_forced_eval_fwd(model, apply))
+    arg_avals = []
+    for s in input_spec:
+        shape = tuple(int(d) for d in s.shape)
+        if any(d <= 0 for d in shape):
+            raise ValueError(
+                f"AOT export needs fully-static input shapes, got "
+                f"{s.shape} (use bucketing for varlen serving)")
+        arg_avals.append(jax.ShapeDtypeStruct(shape, jnp.dtype(s.dtype)))
+    p_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in params.items()}
+    b_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in buffers.items()}
+    exported = jax.export.export(jitted)(p_avals, b_avals, *arg_avals)
+    return {
+        "blob": exported.serialize(),
+        "param_keys": sorted(params),
+        "buffer_keys": sorted(buffers),
+    }
+
+
+def save_inference_model(path: str, model, input_spec=None, aot=False):
     """ref: paddle.static.save_inference_model / jit.save — persist params
     plus the importable factory so inference can rebuild the module.
     input_spec (shapes/dtypes) is stored for consumers that pre-compile.
@@ -65,14 +110,21 @@ def save_inference_model(path: str, model, input_spec=None):
             for s in (input_spec or [])
         ],
     }
+    if aot:
+        if not input_spec:
+            raise ValueError(
+                "save_inference_model(aot=True) needs input_spec to fix "
+                "the exported program's signature")
+        payload["aot"] = _export_aot(model, input_spec)
     _save(payload, path + ".pdmodel")
 
 
-def load_inference_model(path: str):
+def load_inference_model(path: str, _payload=None):
     """Rebuild the Layer from a save_inference_model artifact. Raises if
     the reconstructed module's parameters don't match the checkpoint —
     serving silently-random weights is the worst failure mode."""
-    payload = _load(path + ".pdmodel", return_numpy=False)
+    payload = _payload if _payload is not None else _load(
+        path + ".pdmodel", return_numpy=False)
     mod = importlib.import_module(payload["module"])
     cls = mod
     for part in payload["class_name"].split("."):
@@ -121,13 +173,26 @@ class Predictor:
 
     def __init__(self, model_or_config):
         self._cache_key_base = None
+        self._aot = None
         if isinstance(model_or_config, Config):
             cfg = model_or_config
             if cfg.model_path is None:
                 raise ValueError(
                     "Config has no model_path; pass Config(path) pointing "
                     "at a save_inference_model artifact")
-            model = load_inference_model(cfg.model_path)
+            payload = _load(cfg.model_path + ".pdmodel",
+                            return_numpy=False)
+            if payload.get("aot"):
+                if cfg._bf16:
+                    raise ValueError(
+                        "enable_bf16() cannot re-cast an AOT artifact "
+                        "(its compiled signature is fixed at export); "
+                        "save with a bf16 model instead")
+                # AOT warm start: the serialized StableHLO serves without
+                # the model class being importable in this process
+                self._init_aot(payload)
+                return
+            model = load_inference_model(cfg.model_path, _payload=payload)
             if cfg._bf16:
                 model.bfloat16()
             # artifact-backed predictors share compiled executables
@@ -148,20 +213,7 @@ class Predictor:
         self._params = params
         self._buffers = buffers
 
-        def fwd(params, buffers, *args):
-            # serve in eval semantics without disturbing the caller's
-            # (possibly per-sublayer) modes: snapshot every training flag,
-            # force eval for the trace, restore exactly
-            layers = model.sublayers(include_self=True)
-            snapshot = [(l, l.training) for l in layers]
-            try:
-                for l in layers:
-                    l.training = False
-                out, _ = apply(params, buffers, *args)
-            finally:
-                for l, t in snapshot:
-                    l.training = t
-            return out
+        fwd = _forced_eval_fwd(model, apply)
 
         from ._native import lib as _nlib
         use_cache = self._cache_key_base is not None and _nlib is not None
@@ -178,11 +230,28 @@ class Predictor:
             _nlib.exec_cache_evict_prefix(prefix)
             _nlib.exec_cache_put(self._cache_key_base, self._jitted)
 
+    def _init_aot(self, payload):
+        exported = jax.export.deserialize(payload["aot"]["blob"])
+        sd = payload["state_dict"]
+
+        def arr(v):
+            return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+        self._params = {k: arr(sd[k]) for k in payload["aot"]["param_keys"]}
+        self._buffers = {k: arr(sd[k])
+                         for k in payload["aot"]["buffer_keys"]}
+        self._aot = exported
+        self.model = None
+        self._input_spec = payload.get("input_spec", [])
+
     def run(self, *inputs):
         """numpy/Tensor/jax-array inputs -> list of numpy outputs."""
         raw = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
                for i in inputs]
-        out = self._jitted(self._params, self._buffers, *raw)
+        if self._aot is not None:
+            out = self._aot.call(self._params, self._buffers, *raw)
+        else:
+            out = self._jitted(self._params, self._buffers, *raw)
         if isinstance(out, (tuple, list)):
             return [np.asarray(o) for o in out]
         return [np.asarray(out)]
@@ -190,6 +259,8 @@ class Predictor:
     # reference-style named-handle API: names come from the model's
     # forward signature
     def get_input_names(self) -> Sequence[str]:
+        if self._aot is not None:
+            return [f"input_{i}" for i in range(len(self._input_spec))]
         sig = inspect.signature(self.model.forward)
         return [n for n, p in sig.parameters.items()
                 if p.default is inspect.Parameter.empty
@@ -202,3 +273,68 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """ref: paddle.inference.create_predictor."""
     return Predictor(config)
+
+
+def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
+          block: bool = True):
+    """Minimal predictor server (ref: the reference ships its predictor
+    behind paddle_serving / the C API server loop; this is the
+    batteries-included analog).
+
+    Protocol: POST /run with an .npz body holding arrays input_0..N;
+    response is an .npz of output_0..M. GET /health returns 200.
+    Returns the HTTPServer (started in a daemon thread) when block=False.
+    """
+    import io
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    predictor = Predictor(Config(model_path))
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def do_GET(self):
+            if self.path == "/health":
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"ok")
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def do_POST(self):
+            if self.path != "/run":
+                self.send_response(404)
+                self.end_headers()
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                data = np.load(io.BytesIO(self.rfile.read(n)),
+                               allow_pickle=False)
+                inputs = [data[f"input_{i}"] for i in range(len(data))]
+                outs = predictor.run(*inputs)
+                buf = io.BytesIO()
+                np.savez(buf, **{f"output_{i}": o
+                                 for i, o in enumerate(outs)})
+                body = buf.getvalue()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/npz")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except Exception as e:  # surface the error to the client
+                msg = repr(e).encode()
+                self.send_response(500)
+                self.send_header("Content-Length", str(len(msg)))
+                self.end_headers()
+                self.wfile.write(msg)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    if block:
+        server.serve_forever()
+        return None
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

