@@ -93,6 +93,9 @@ def flag_value(name: str):
 
 # Core flags (subset of the reference's ~180; ref: paddle/common/flags.cc)
 define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode")
+define_flag("pallas_autotune", False,
+            "Measure Pallas block-size candidates at first use per shape "
+            "and cache the winner (ref: kernels/autotune/cache.h)")
 define_flag("check_nan_inf_stride", 1,
             "Ops between host fetches of the batched NaN-check flags. "
             "1 (default) = synchronous per-op raise, reference parity; "

@@ -7,3 +7,4 @@ rank. The TPU build reuses the same harness shape with a mesh-aware
 candidate space; trials are callables so tests can stub the runner.
 """
 from .tuner import AutoTuner, Prune, Recorder, SearchSpace  # noqa: F401
+from .runner import MemoryBudgetExceeded, build_trial_runner  # noqa: F401

@@ -146,6 +146,39 @@ class DistTrainStep:
                 f"unmatched keys {unmatched[:5]}, "
                 f"missing slots {missing[:5]}")
 
+    def compile_stats(self, *batch_and_labels, num_labels: int = 1,
+                      return_compiled: bool = False):
+        """Compile the step for these batch shapes WITHOUT running it and
+        return XLA's memory analysis (argument/output/temp bytes). The
+        auto-tuner's memory model prunes configs on this before paying
+        for a trial run (ref: auto_tuner/prune.py's OOM-signature
+        pruning, done here ahead of time from the compiled program).
+        With return_compiled=True also returns the AOT executable so the
+        caller can time steps without a second compile."""
+        if self._jitted is None:
+            self._build()
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        raw = [b._data if isinstance(b, Tensor)
+               else b if isinstance(b, jax.Array)
+               else jnp.asarray(np.asarray(b)) for b in batch_and_labels]
+        if self.data_sharding is not None:
+            raw = [jax.device_put(r, self.data_sharding) for r in raw]
+        batch = tuple(raw[:len(raw) - num_labels])
+        labels = tuple(raw[len(raw) - num_labels:]) if num_labels else ()
+        params = {k: t._data for k, t in self._params.items()}
+        buffers = {k: t._data for k, t in self._swap.buffers.items()}
+        # fixed probe key: a diagnostic must not advance the global RNG
+        # stream (seed-fixed training after a stats query stays identical)
+        probe_key = jax.random.key(0)
+        compiled = self._jitted.lower(
+            params, buffers, self._opt_state, jnp.float32(0.0),
+            probe_key, batch, labels).compile()
+        mem = compiled.memory_analysis()
+        if return_compiled:
+            return mem, compiled, (params, buffers, batch, labels)
+        return mem
+
     def __call__(self, *batch_and_labels, num_labels: int = 1):
         if self._jitted is None:
             self._build()

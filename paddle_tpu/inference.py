@@ -55,11 +55,11 @@ def _export_aot(model, input_spec):
     jitted = jax.jit(_forced_eval_fwd(model, apply))
     arg_avals = []
     for s in input_spec:
-        shape = tuple(int(d) for d in s.shape)
-        if any(d <= 0 for d in shape):
+        if any(d is None or int(d) <= 0 for d in s.shape):
             raise ValueError(
                 f"AOT export needs fully-static input shapes, got "
-                f"{s.shape} (use bucketing for varlen serving)")
+                f"{list(s.shape)} (use bucketing for varlen serving)")
+        shape = tuple(int(d) for d in s.shape)
         arg_avals.append(jax.ShapeDtypeStruct(shape, jnp.dtype(s.dtype)))
     p_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                for k, v in params.items()}

@@ -298,15 +298,55 @@ def _tiles_ok(seq_len, d, block_q, block_k) -> bool:
             and d % 128 == 0 and seq_len >= block_q)
 
 
-def _pick_block(seq_len: int) -> int:
-    """Measured on v5e (1.17B Llama, seq 2048, whole train step):
-    512 tiles ~7% faster than 256, 256 ~15% faster than 128; 1024
-    exceeds VMEM. Fall back down the ladder when the sequence doesn't
-    tile."""
-    for blk in (512, 256):
-        if seq_len % blk == 0:
-            return blk
-    return 128
+_block_tune_cache: dict = {}
+
+
+def _pick_block(seq_len: int, d: int = 128, sample=None) -> int:
+    """Block-size choice. Default: the ladder measured on v5e (1.17B
+    Llama, seq 2048, whole train step): 512 tiles ~7% faster than 256,
+    256 ~15% faster than 128; 1024 exceeds VMEM.
+
+    FLAGS_pallas_autotune=1 switches to a runtime tuner (the analog of
+    the reference's kernels/autotune/cache.h): the first call per
+    (seq_len, d) times each candidate on the live arrays and caches the
+    winner for the process."""
+    from ...core.flags import flag_value
+    candidates = [b for b in (512, 256, 128) if seq_len % b == 0]
+    if not candidates:
+        return 128
+    key = ("flash", seq_len, d)
+    hit = _block_tune_cache.get(key)
+    if hit is not None:
+        return hit  # backward reuses the forward's tuned choice
+    if sample is None or not flag_value("pallas_autotune"):
+        return candidates[0]
+    q, k, v = sample
+    if isinstance(q, jax.core.Tracer):
+        # inside a jit trace there is nothing to measure; do NOT cache —
+        # a later eager call can still tune this shape
+        return candidates[0]
+    import time as _time
+    best, best_t = None, float("inf")
+    for blk in candidates:
+        try:
+            out, _ = _flash_fwd_pallas(q, k, v, False, 1.0 / math.sqrt(d),
+                                       block_q=blk, block_k=blk)
+            float(jnp.sum(out))  # warm; value fetch = the real barrier
+            t0 = _time.perf_counter()
+            for _ in range(3):
+                out, _ = _flash_fwd_pallas(q, k, v, False,
+                                           1.0 / math.sqrt(d),
+                                           block_q=blk, block_k=blk)
+            float(jnp.sum(out))
+            dt = _time.perf_counter() - t0
+        except Exception:
+            continue
+        if dt < best_t:
+            best, best_t = blk, dt
+    if best is None:
+        return candidates[0]  # nothing measured: stay untuned, uncached
+    _block_tune_cache[key] = best
+    return best
 
 
 def _use_pallas(l, d) -> bool:
@@ -335,10 +375,10 @@ def _flash_fwd_res(q, k, v, causal, scale):
     b, l, h, d = q.shape
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     if _use_pallas(l, d):
-        blk = _pick_block(l)
+        qb, kb, vb = _to_bhld(q), _to_bhld(k), _to_bhld(v)
+        blk = _pick_block(l, d, sample=(qb, kb, vb))
         out_bhld, lse = _flash_fwd_pallas(
-            _to_bhld(q), _to_bhld(k), _to_bhld(v), causal, s,
-            block_q=blk, block_k=blk)
+            qb, kb, vb, causal, s, block_q=blk, block_k=blk)
         out = _from_bhld(out_bhld, b, h)
         # residual keeps the blhd output (the array the caller holds
         # anyway); bwd re-derives the bhld layout transiently — avoids
@@ -358,7 +398,7 @@ def _flash_vjp_bwd(causal, scale, residuals, g):
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     if res is not None:  # pallas path: res = (out in blhd, lse)
         out, lse = res
-        blk = _pick_block(l)
+        blk = _pick_block(l, d)
         dq, dk, dv = _flash_bwd_pallas(
             _to_bhld(q), _to_bhld(k), _to_bhld(v), _to_bhld(out), lse,
             _to_bhld(g), causal, s, block_q=blk, block_k=blk)
@@ -485,7 +525,7 @@ def _flash_seg_fwd_res(q, k, v, seg, causal, scale):
     b, l, h, d = q.shape
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     if _use_pallas(l, d):
-        blk = _pick_block(l)
+        blk = _pick_block(l, d)
         seg3 = jnp.repeat(seg[:, None, :], h, axis=1).reshape(b * h, l, 1)
         seg3 = seg3.astype(jnp.int32)
         out_bhld, lse = _flash_fwd_pallas_seg(
@@ -506,7 +546,7 @@ def _flash_seg_vjp_bwd(causal, scale, residuals, g):
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     if res is not None:
         out_bhld, lse, seg3 = res
-        blk = _pick_block(l)
+        blk = _pick_block(l, d)
         dq, dk, dv = _flash_bwd_pallas_seg(
             _to_bhld(q), _to_bhld(k), _to_bhld(v), out_bhld, lse,
             _to_bhld(g), seg3, causal, s, block_q=blk, block_k=blk)
