@@ -28,6 +28,7 @@ __all__ = [
     "fused_linear", "fused_dropout_add", "fused_rms_norm",
     "fused_layer_norm", "fused_bias_act", "swiglu",
     "fused_rotary_position_embedding",
+    "fused_layernorm_residual_dropout",
 ]
 
 
@@ -59,12 +60,14 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
                         op_name="fused_dropout_add")
     key_t = _rng_key_tensor()
 
+    if p >= 1.0:  # everything dropped; where()-vjp at p=1 would NaN
+        return apply_op(lambda a, b: (a * 0 + b).astype(b.dtype), x, y,
+                        op_name="fused_dropout_add")
+
     def f(a, b, key):
         keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
-        if mode == "upscale_in_train":
-            a = jnp.where(keep, a / (1.0 - p), 0.0)
-        else:
-            a = jnp.where(keep, a, 0.0)
+        scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+        a = a * keep.astype(a.dtype) * scale
         return (a + b).astype(b.dtype)
     return apply_op(f, x, y, key_t, op_name="fused_dropout_add")
 
@@ -174,3 +177,53 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     outs = [apply_op(f, t, op_name="fused_rope") if t is not None else None
             for t in (q, k, v)]
     return tuple(outs)
+
+
+def fused_layernorm_residual_dropout(x, residual, norm_weight=None,
+                                     norm_bias=None, p=0.0, epsilon=1e-5,
+                                     training=True, name=None):
+    """dropout(x) + residual, then layer_norm — ONE traced op, so XLA
+    emits a single fused HBM pass (ref: phi/kernels/fusion/gpu/
+    fused_layernorm_residual_dropout_bias — the reference hand-fuses this
+    because its eager path pays a kernel launch per piece; here fusion is
+    the compiler's job and this op just guarantees one dispatch).
+    Returns (out, dropout_plus_residual) like the reference kernel."""
+    from ...nn.functional.common import _rng_key_tensor
+    drop = p if training else 0.0
+    extras = []
+    if 0.0 < drop < 1.0:  # p>=1 drops everything, no rng needed
+        extras.append(_rng_key_tensor())
+    if norm_weight is not None:
+        extras.append(norm_weight)
+    if norm_bias is not None:
+        extras.append(norm_bias)
+
+    def f(a, res, *rest):
+        i = 0
+        if drop >= 1.0:
+            a = jnp.zeros_like(a)  # not a mask: p=1 drops everything
+        elif drop > 0.0:
+            key = rest[i]
+            i += 1
+            keep = jax.random.bernoulli(key, 1.0 - drop, a.shape)
+            # multiply by the (static) inverse keep-prob instead of
+            # dividing under where(): the where-vjp would emit 0/0=NaN
+            # grads at p->1
+            a = (a * keep.astype(a.dtype) *
+                 (1.0 / (1.0 - drop))).astype(res.dtype)
+        w = rest[i] if norm_weight is not None else None
+        if norm_weight is not None:
+            i += 1
+        b = rest[i] if norm_bias is not None else None
+        summed = a + res
+        mu = summed.mean(-1, keepdims=True)
+        var = summed.var(-1, keepdims=True)
+        out = (summed - mu) / jnp.sqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out.astype(summed.dtype), summed
+
+    return apply_op(f, x, residual, *extras,
+                    op_name="fused_layernorm_residual_dropout")
