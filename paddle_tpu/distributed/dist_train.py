@@ -34,7 +34,8 @@ class DistTrainStep:
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer,
-                 data_sharding=None, donate: bool = True):
+                 data_sharding=None, donate: bool = True,
+                 accumulate_steps: int = 1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -44,6 +45,11 @@ class DistTrainStep:
         self._opt_state = None
         self._jitted = None
         self._donate = donate
+        # gradient merge (ref: passes/auto_parallel_gradient_merge.py):
+        # the global batch is split into accumulate_steps micro-batches,
+        # grads averaged inside ONE compiled step via lax.scan, then a
+        # single optimizer update — the whole merge stays on-device
+        self.accumulate_steps = max(int(accumulate_steps), 1)
 
     def _init_opt_state(self):
         """Optimizer state co-sharded with its parameter — the ZeRO contract
@@ -70,23 +76,62 @@ class DistTrainStep:
         trainable = {k for k, p in self._params.items()
                      if not p.stop_gradient}
 
+        acc = self.accumulate_steps
+
         def step_fn(params, buffers, opt_state, lr, key, batch, labels):
             train_p = {k: v for k, v in params.items() if k in trainable}
             frozen_p = {k: v for k, v in params.items()
                         if k not in trainable}
 
-            def loss_of(tp):
+            def loss_of(tp, bufs, mb, lbls, k_):
                 full = {**tp, **frozen_p}
                 from ..core.autograd import no_grad
-                with no_grad(), random_mod.key_stream(key):
+                with no_grad(), random_mod.key_stream(k_):
                     out, new_buffers = swap.run(
-                        full, buffers, model.__call__,
-                        *[Tensor(b) for b in batch])
-                    loss_t = loss_fn(out, *[Tensor(x) for x in labels])
+                        full, bufs, model.__call__,
+                        *[Tensor(b) for b in mb])
+                    loss_t = loss_fn(out, *[Tensor(x) for x in lbls])
                 return loss_t._data.astype(jnp.float32), new_buffers
 
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_p)
+            if acc <= 1:
+                (loss, new_buffers), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_p, buffers, batch, labels,
+                                           key)
+            else:
+                # split dim0 into [acc, -1] micro-batches and scan,
+                # averaging grads (gradient merge, fully on-device)
+                for arr in (*batch, *labels):
+                    if arr.shape[0] % acc:
+                        raise ValueError(
+                            f"gradient merge: batch dim {arr.shape[0]} "
+                            f"is not divisible by accumulate_steps="
+                            f"{acc}; drop or pad the tail batch")
+                micro_b = tuple(
+                    b.reshape((acc, b.shape[0] // acc) + b.shape[1:])
+                    for b in batch)
+                micro_l = tuple(
+                    x.reshape((acc, x.shape[0] // acc) + x.shape[1:])
+                    for x in labels)
+                keys = jax.random.split(key, acc)
+
+                def scan_body(carry, xs):
+                    loss_sum, gsum, bufs = carry
+                    mb, lbls, k_ = xs
+                    (l, nb), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(train_p, bufs, mb, lbls, k_)
+                    gsum = jax.tree.map(
+                        lambda a, b_: a + b_.astype(jnp.float32), gsum, g)
+                    return (loss_sum + l, gsum, nb), None
+
+                # fp32 accumulators: merging k bf16 micro-grads in bf16
+                # would lose the low bits the merge exists to keep
+                g0 = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), train_p)
+                (loss_sum, grads, new_buffers), _ = jax.lax.scan(
+                    scan_body, (jnp.float32(0.0), g0, buffers),
+                    (micro_b, micro_l, keys))
+                loss = loss_sum / acc
+                grads = jax.tree.map(lambda g: g / acc, grads)
             new_params = dict(params)
             new_opt = dict(opt_state)
             for k in trainable:

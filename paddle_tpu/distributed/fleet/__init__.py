@@ -7,6 +7,7 @@ from .fleet import (  # noqa: F401
     DistributedStrategy, init, fleet, distributed_model,
     distributed_optimizer, get_hybrid_communicate_group,
 )
+from . import utils  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from . import mp_ops  # noqa: F401
 from .mp_layers import (  # noqa: F401

@@ -1,0 +1,8 @@
+"""fleet.utils: recompute + hybrid-parallel helpers.
+
+ref: python/paddle/distributed/fleet/utils/__init__.py (recompute,
+hybrid_parallel_util helpers).
+"""
+from .recompute import recompute  # noqa: F401
+
+__all__ = ["recompute"]

@@ -1,0 +1,63 @@
+"""Activation recomputation for eager/taped training.
+
+ref: python/paddle/distributed/fleet/utils/recompute (recompute(function,
+*args) — forward runs normally, activations inside are re-computed in
+backward instead of stored). TPU-native: the segment's functionalized
+forward is wrapped in jax.checkpoint and dispatched through apply_op —
+the tape's jax.vjp then stores only the segment INPUTS as residuals and
+re-runs the forward during backward. Inside a compiled train step
+(DistTrainStep / jit) the same wrapper lowers to XLA remat.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core.autograd import apply_op
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
+    """Run ``function(*args)`` with recompute-in-backward semantics.
+
+    function: a Layer (its parameters keep receiving gradients — they are
+    threaded through the checkpointed program, not captured as constants)
+    or a pure callable over Tensors.
+    """
+    if isinstance(function, Layer):
+        from ....jit.api import functionalize
+        apply, params0, buffers0 = functionalize(function)
+        names = list(params0)
+        named = dict(function.named_parameters())
+        param_tensors = [named[n] for n in names]
+        buffer_names = list(buffers0)
+        buffer_tensors = dict(function.named_buffers())
+
+        def fn(*flat):
+            ps = dict(zip(names, flat[:len(names)]))
+            out, new_buffers = apply(ps, buffers0, *flat[len(names):],
+                                     **kwargs)
+            if isinstance(out, (tuple, list)):
+                raise NotImplementedError(
+                    "recompute over a multi-output segment: wrap the "
+                    "segment so it returns one tensor")
+            # thread buffer updates (e.g. BN running stats) out as extra
+            # outputs so they are not lost to the recompute wrapper
+            return (out, *[new_buffers[n] for n in buffer_names])
+
+        ck = jax.checkpoint(fn)
+        res = apply_op(ck, *param_tensors, *args, op_name="recompute")
+        if buffer_names:
+            out = res[0]
+            for n, new_b in zip(buffer_names, res[1:]):
+                buffer_tensors[n]._data = new_b._data
+            return out
+        return res if not isinstance(res, tuple) else res[0]
+
+    def fn(*flat):
+        out = function(*[Tensor(a) for a in flat], **kwargs)
+        return out._data if isinstance(out, Tensor) else out
+
+    return apply_op(jax.checkpoint(fn), *args, op_name="recompute")

@@ -58,11 +58,10 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
                             x, y, op_name="fused_dropout_add")
         return apply_op(lambda a, b: a + b, x, y,
                         op_name="fused_dropout_add")
-    key_t = _rng_key_tensor()
-
     if p >= 1.0:  # everything dropped; where()-vjp at p=1 would NaN
         return apply_op(lambda a, b: (a * 0 + b).astype(b.dtype), x, y,
                         op_name="fused_dropout_add")
+    key_t = _rng_key_tensor()  # drawn only when 0 < p < 1
 
     def f(a, b, key):
         keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
@@ -216,9 +215,12 @@ def fused_layernorm_residual_dropout(x, residual, norm_weight=None,
             i += 1
         b = rest[i] if norm_bias is not None else None
         summed = a + res
-        mu = summed.mean(-1, keepdims=True)
-        var = summed.var(-1, keepdims=True)
-        out = (summed - mu) / jnp.sqrt(var + epsilon)
+        # stats in fp32 (bf16 mantissa is too short at real hidden dims;
+        # same contract as nn.functional.layer_norm and the ref kernel)
+        s32 = summed.astype(jnp.float32)
+        mu = s32.mean(-1, keepdims=True)
+        var = s32.var(-1, keepdims=True)
+        out = (s32 - mu) / jnp.sqrt(var + epsilon)
         if w is not None:
             out = out * w
         if b is not None:
