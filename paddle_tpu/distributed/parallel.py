@@ -15,6 +15,7 @@ import os
 from typing import Optional
 
 import jax
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
@@ -99,9 +100,13 @@ class ParallelEnv:
 
 class DataParallel(Layer):
     """ref: parallel.py:396 DataParallel. Gradient allreduce over the dp
-    group after backward; bucketing (EagerReducer, reducer.cc) is left to
-    XLA's collective combiner when the step is jitted — eager path does a
-    straight per-param allreduce on apply_collective_grads."""
+    group after backward with size-bucketed FUSION (ref: EagerReducer,
+    fluid/distributed/collective/reducer.cc Eager_AssignGroupBySize +
+    FusedAllReduceSchedule): grads are packed into ~comm_buffer_size-MB
+    flat buffers so the eager path issues one collective per bucket —
+    over the store transport that's one round-trip per bucket instead of
+    one per parameter; in compiled steps XLA's collective combiner plays
+    this role."""
 
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
@@ -109,8 +114,38 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self._group = group
+        self.comm_buffer_size = comm_buffer_size
+        self.last_comm_buffer_size = last_comm_buffer_size
         self.find_unused_parameters = find_unused_parameters
         init_parallel_env()
+
+    def _grad_buckets(self):
+        """Group parameters by accumulated byte size (ref: reducer.h:41
+        Eager_AssignGroupBySize with group limits [last_comm_buffer_size,
+        comm_buffer_size] — the first bucket stays small so its fused
+        allreduce launches early). Buckets cover EVERY trainable param in
+        a deterministic order — a rank whose control flow skipped some
+        param contributes zeros rather than shifting the flat layout
+        (rank-divergent layouts would sum unrelated slices together)."""
+        first_limit = max(int(self.last_comm_buffer_size), 1) * 1024 * 1024
+        limit = max(int(self.comm_buffer_size), 1) * 1024 * 1024
+        buckets = []
+        cur, cur_bytes, cur_dtype = [], 0, None
+        for p in self._layers.parameters():
+            if p.stop_gradient:
+                continue
+            nbytes = p._data.nbytes
+            cap = first_limit if not buckets else limit
+            if cur and (cur_bytes + nbytes > cap or
+                        p._data.dtype != cur_dtype):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes += nbytes
+            cur_dtype = p._data.dtype
+        if cur:
+            buckets.append(cur)
+        return buckets
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -122,14 +157,41 @@ class DataParallel(Layer):
         return self._layers.set_state_dict(state_dict, *args, **kwargs)
 
     def apply_collective_grads(self):
-        """ref: hybrid_parallel_util.py fused_allreduce_gradients."""
+        """ref: hybrid_parallel_util.py fused_allreduce_gradients +
+        reducer.cc FusedAllReduceSchedule — one flat AVG allreduce per
+        size bucket, then unpack back into each param's grad. Params with
+        no local grad contribute zeros (keeps the flat layout identical
+        on every rank) and do not get a grad written back."""
+        import jax.numpy as jnp
+
         n = get_world_size(self._group)
         if n <= 1:
             return
-        for p in self._layers.parameters():
-            if p.grad is not None:
-                all_reduce(p.grad, ReduceOp.SUM, self._group)
-                p.grad._data = p.grad._data / n
+        for bucket in self._grad_buckets():
+            # every rank joins every bucket's collective, even with no
+            # local grads (zeros) — skipping would desequence the store
+            # transport / deadlock the ring on ranks that do have grads
+            if len(bucket) == 1:
+                p = bucket[0]
+                if p.grad is None:
+                    all_reduce(Tensor(jnp.zeros_like(p._data)),
+                               ReduceOp.AVG, self._group)
+                else:
+                    all_reduce(p.grad, ReduceOp.AVG, self._group)
+                continue
+            flat = jnp.concatenate([
+                (p.grad._data if p.grad is not None
+                 else jnp.zeros_like(p._data)).reshape(-1)
+                for p in bucket])
+            fused = Tensor(flat)
+            all_reduce(fused, ReduceOp.AVG, self._group)
+            off = 0
+            for p in bucket:
+                size = p._data.size
+                if p.grad is not None:
+                    p.grad._data = fused._data[off:off + size].reshape(
+                        p.grad._data.shape)
+                off += size
 
     def scale_loss(self, loss):
         return loss
