@@ -62,13 +62,28 @@ class Engine:
         amp = s.amp if isinstance(s.amp, dict) else vars(s.amp)
         if amp.get("enable"):
             dtype = str(amp.get("dtype", "bfloat16"))
-            if dtype in ("bfloat16", "bf16"):
-                self.model.bfloat16()
-            else:
+            if dtype not in ("bfloat16", "bf16"):
                 raise ValueError(
                     f"Engine amp dtype {dtype!r} is not supported on "
                     f"TPU — bfloat16 is the native fast dtype (fp16 "
                     f"has no hardware advantage here)")
+            level = str(amp.get("level", "O1")).upper()
+            if level == "O2":
+                # O2 = master-weight cast (ref: passes/auto_parallel_fp16)
+                self.model.bfloat16()
+            else:
+                # O1 keeps fp32 weights and autocasts per-op through the
+                # white/black lists (ref: passes/auto_parallel_amp.py) —
+                # the autocast context wraps forward so it applies both
+                # eagerly and while the compiled step traces
+                from ...amp import auto_cast
+                inner_forward = self.model.forward
+
+                def _amp_forward(*a, **kw):
+                    with auto_cast(True, level="O1", dtype="bfloat16"):
+                        return inner_forward(*a, **kw)
+
+                self.model.forward = _amp_forward
         sh = s.sharding if isinstance(s.sharding, dict) else vars(s.sharding)
         if sh.get("enable") and self.mesh is not None:
             from ..api import shard_parameter

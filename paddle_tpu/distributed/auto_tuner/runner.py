@@ -95,6 +95,14 @@ def build_trial_runner(make_model: Callable[[], object],
                                                    opt_state)
         float(loss)
         dt = (time.perf_counter() - t0) / steps
+        # donation consumed the step's original param/buffer/opt-state
+        # buffers — re-sync the threaded-through state so the step (and
+        # the model it wraps) stays usable after the trial
+        step._opt_state = opt_state
+        for k, t in step._params.items():
+            t._data = params[k]
+        for k, t in step._swap.buffers.items():
+            t._data = buffers[k]
         items = int(np.asarray(batch[0]).shape[0])
         return items / dt
 

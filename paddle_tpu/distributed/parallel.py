@@ -171,16 +171,27 @@ class DataParallel(Layer):
             # every rank joins every bucket's collective, even with no
             # local grads (zeros) — skipping would desequence the store
             # transport / deadlock the ring on ranks that do have grads
+            # the flat layout is bucketed by PARAM dtype (deterministic
+            # across ranks even when some rank has no grad); a grad whose
+            # dtype differs (e.g. fp32 grads on bf16 params) is packed in
+            # the param dtype and restored to its own dtype after — never
+            # let jnp.concatenate promote the whole buffer
             if len(bucket) == 1:
                 p = bucket[0]
                 if p.grad is None:
                     all_reduce(Tensor(jnp.zeros_like(p._data)),
                                ReduceOp.AVG, self._group)
-                else:
+                elif p.grad._data.dtype == p._data.dtype:
                     all_reduce(p.grad, ReduceOp.AVG, self._group)
+                else:
+                    gdt = p.grad._data.dtype
+                    t = Tensor(p.grad._data.astype(p._data.dtype))
+                    all_reduce(t, ReduceOp.AVG, self._group)
+                    p.grad._data = t._data.astype(gdt)
                 continue
             flat = jnp.concatenate([
-                (p.grad._data if p.grad is not None
+                (p.grad._data.astype(p._data.dtype)
+                 if p.grad is not None
                  else jnp.zeros_like(p._data)).reshape(-1)
                 for p in bucket])
             fused = Tensor(flat)
@@ -189,8 +200,8 @@ class DataParallel(Layer):
             for p in bucket:
                 size = p._data.size
                 if p.grad is not None:
-                    p.grad._data = fused._data[off:off + size].reshape(
-                        p.grad._data.shape)
+                    p.grad._data = fused._data[off:off + size].astype(
+                        p.grad._data.dtype).reshape(p.grad._data.shape)
                 off += size
 
     def scale_loss(self, loss):

@@ -102,8 +102,33 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
     flash_attention_segmented) — tiles where seg_q != seg_k contribute
     nothing, so packing costs no extra FLOPs materialization.
     qkv: [total_tokens, 3, H, D]; returns [total_tokens, H, D].
+
+    For packed qkv the q and k boundaries coincide, so segment ids derive
+    from cu_seqlens_q alone; max_seqlen_q/k and varlen_padded are accepted
+    for signature parity but unused (the segment mask makes them moot).
+    A cu_seqlens_k that differs from cu_seqlens_q is rejected — silently
+    masking with q boundaries would be wrong for that caller.
     """
     from ...ops.pallas.flash_attention import flash_attention_segmented
+
+    if cu_seqlens_k is not None and cu_seqlens_k is not cu_seqlens_q:
+        import jax as _jax
+        import numpy as _np
+        cq = (cu_seqlens_q._data if hasattr(cu_seqlens_q, "_data")
+              else cu_seqlens_q)
+        ck = (cu_seqlens_k._data if hasattr(cu_seqlens_k, "_data")
+              else cu_seqlens_k)
+        # traced values can't be compared on the host — trust the caller
+        # under jit (eager use, the common path, is still validated)
+        if not (isinstance(cq, _jax.core.Tracer)
+                or isinstance(ck, _jax.core.Tracer)):
+            cq, ck = _np.asarray(cq), _np.asarray(ck)
+            if cq.shape != ck.shape or (cq != ck).any():
+                raise ValueError(
+                    "flash_attn_varlen_qkvpacked: cu_seqlens_k differs "
+                    "from cu_seqlens_q, but packed qkv shares one set of "
+                    "sequence boundaries — masking would be wrong. Use "
+                    "the unpacked varlen API for cross-attention layouts.")
 
     def f(p, cu_arr):
         total = p.shape[0]

@@ -183,11 +183,24 @@ def grouped_matmul(lhs, rhs, group_sizes, block_t: int = 128,
     back to the dense reference (correct, slower). ``tile_ids`` may be
     passed when the caller already knows the per-tile expert map (e.g. the
     fixed-capacity MoE layout where every group is exactly C rows).
+    ``tile_ids`` MUST be non-decreasing: the dRHS backward accumulates
+    into one resident VMEM block per expert and decides init-vs-accumulate
+    by comparing adjacent ids, so a non-sorted map would silently produce
+    wrong weight gradients (forward would still be right).
     """
     t, k = lhs.shape
     e, k2, n = rhs.shape
     if k2 != k:
         raise ValueError(f"lhs K {k} != rhs K {k2}")
+    if tile_ids is not None and not isinstance(tile_ids, jax.core.Tracer):
+        ids_np = np.asarray(tile_ids)
+        if (np.diff(ids_np) < 0).any():
+            raise ValueError(
+                "grouped_matmul tile_ids must be non-decreasing (tokens "
+                "pre-sorted by expert): the dRHS backward accumulates "
+                "per-expert output tiles in VMEM and a scattered map "
+                "yields wrong weight grads. Sort tokens by expert or use "
+                "grouped_matmul_reference.")
     if not _use_pallas(t, k, n, block_t):
         return grouped_matmul_reference(lhs, rhs, group_sizes)
     if tile_ids is None:

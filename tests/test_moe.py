@@ -210,6 +210,21 @@ class TestIndexDispatch:
                 np.asarray(lhs)[r] @ np.asarray(rhs)[row_expert[r]],
                 rtol=1e-4, atol=1e-5)
 
+    def test_grouped_matmul_rejects_unsorted_tile_ids(self, rng):
+        """dRHS backward requires contiguous per-expert tiles — a
+        non-monotonic caller-supplied tile map must fail loudly, not
+        silently corrupt weight grads (ADVICE r2)."""
+        import jax.numpy as jnp
+        import pytest
+        from paddle_tpu.ops.pallas.grouped_matmul import grouped_matmul
+        T, K, N, E = 256, 128, 128, 2
+        lhs = jnp.zeros((T, K), jnp.float32)
+        rhs = jnp.zeros((E, K, N), jnp.float32)
+        bad_ids = jnp.asarray([1, 0], jnp.int32)  # scattered map
+        with pytest.raises(ValueError, match="non-decreasing"):
+            grouped_matmul(lhs, rhs, jnp.asarray([128, 128], jnp.int32),
+                           tile_ids=bad_ids)
+
     def test_ep_sharded_index_dispatch_lowers_to_alltoall(self, rng):
         """The ep-sharded index-dispatch program must contain all-to-all
         (or equivalent resharding collectives) in the compiled HLO —

@@ -326,7 +326,7 @@ class TestEngineStrategyPasses:
         opt = paddle.optimizer.AdamW(learning_rate=1e-2,
                                      parameters=net.parameters())
         strat = Strategy()
-        strat.amp = {"enable": True, "dtype": "bfloat16"}
+        strat.amp = {"enable": True, "dtype": "bfloat16", "level": "O2"}
         strat.sharding = {"enable": True, "stage": 1}
         strat.gradient_merge = {"enable": True, "k_steps": 2}
         mesh = _mesh1d(8, "dp")
@@ -339,6 +339,26 @@ class TestEngineStrategyPasses:
         assert eng.history["loss"][-1] < eng.history["loss"][0]
         assert str(eng.model[0].weight.dtype) == "bfloat16"
         assert eng._step.accumulate_steps == 2
+
+    def test_engine_amp_o1_keeps_fp32_weights(self):
+        """O1 autocasts per-op but must NOT cast weights (the reference's
+        O1 amp pass keeps fp32 masters; only O2 casts)."""
+        from paddle_tpu.distributed.auto_parallel.engine import (Engine,
+                                                                 Strategy)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+        strat = Strategy()
+        strat.amp = {"enable": True, "dtype": "bfloat16"}  # default O1
+        eng = Engine(net, lambda o, l: ((o - l) ** 2).mean(), opt,
+                     strategy=strat)
+        rng = np.random.default_rng(0)
+        data = [(rng.standard_normal((8, 8)).astype(np.float32),
+                 np.zeros((8, 8), np.float32)) for _ in range(2)]
+        eng.fit(data, epochs=1)
+        assert str(eng.model[0].weight.dtype) == "float32"
+        assert np.isfinite(eng.history["loss"]).all()
 
     def test_recompute_util(self):
         from paddle_tpu.distributed.fleet.utils import recompute
