@@ -103,6 +103,17 @@ def next_key():
     return _default_generator.next_key()
 
 
+def derive_seed(key, dtype=None):
+    """Fold a PRNG key down to one 32-bit scalar for kernels that take a
+    raw seed (Pallas PRNG, hash dropout). Single definition so every
+    call site picks the same key word and bitcast; works on concrete and
+    traced keys alike."""
+    import jax.numpy as jnp
+    kd = jax.random.key_data(key)
+    return jax.lax.bitcast_convert_type(
+        kd.reshape(-1)[-1], dtype or jnp.int32)
+
+
 def get_rng_state():
     return _default_generator.get_state()
 

@@ -7,7 +7,6 @@ here is plain jnp, used on CPU and as the numeric oracle in tests.
 """
 from __future__ import annotations
 
-import math
 
 import jax
 import jax.numpy as jnp
@@ -19,26 +18,12 @@ from ...core import random as random_mod
 
 def _sdpa_reference(q, k, v, mask=None, causal=False, scale=None,
                     dropout_p=0.0, dropout_key=None):
-    # q,k,v: [B, L, H, D] (paddle flash-attention layout)
-    d = q.shape[-1]
-    s = scale if scale is not None else 1.0 / math.sqrt(d)
-    qt = jnp.swapaxes(q, 1, 2)  # [B, H, L, D]
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt).astype(jnp.float32) * s
-    if causal:
-        ql, kl = logits.shape[-2], logits.shape[-1]
-        cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
-        logits = jnp.where(cm, logits, -1e30)
-    if mask is not None:
-        logits = logits + mask.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    if dropout_p > 0.0 and dropout_key is not None:
-        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
-                                    probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
-    return jnp.swapaxes(out, 1, 2)  # back to [B, L, H, D]
+    """Thin delegate to the single sdpa oracle in ops.pallas.flash_attention
+    (one copy of the softmax+dropout algebra to keep in sync)."""
+    from ...ops.pallas.flash_attention import _sdpa_xla
+    m = mask.astype(jnp.float32) if mask is not None else None
+    return _sdpa_xla(q, k, v, causal=causal, scale=scale, mask=m,
+                     dropout_p=dropout_p, dropout_key=dropout_key)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -48,8 +33,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     md = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
     drop = dropout_p if training else 0.0
 
-    if _should_use_flash(query) and md is None and drop == 0.0:
+    if _should_use_flash(query) and md is None and drop < 1.0:
         from ...ops.pallas.flash_attention import flash_attention_fwd
+        if drop > 0.0:
+            # the key rides as a marked arg (same contract as F.dropout)
+            # so static Program replay refills a FRESH key per run — a
+            # closure-captured seed would freeze the mask across runs.
+            # Under jit the key is traced off the step key per step.
+            from .common import _rng_key_tensor
+            key_t = _rng_key_tensor()
+
+            def f(q, k, v, rng_key):
+                return flash_attention_fwd(
+                    q, k, v, causal=is_causal, dropout_p=float(drop),
+                    seed=random_mod.derive_seed(rng_key))
+            return apply_op(f, query, key, value, key_t,
+                            op_name="flash_attention")
         return apply_op(
             lambda q, k, v: flash_attention_fwd(q, k, v, causal=is_causal),
             query, key, value, op_name="flash_attention")
@@ -211,12 +210,16 @@ def flashmask_attention(query, key, value, startend_row_indices,
 
 
 def _should_use_flash(q) -> bool:
+    """True when the attention should route to the Pallas flash kernel.
+    Traced values (inside jit/TrainStep) carry no devices — fall back to
+    the default backend, NOT False: a compiled step on TPU must still
+    take the fused path (this was exactly the BERT slow-path bug)."""
     import jax as _jax
+    data = q._data if isinstance(q, Tensor) else q
     try:
-        dev = (q._data.devices() if isinstance(q, Tensor) else set()) or set()
-        plats = {d.platform for d in dev}
-        if not plats:
-            plats = {_jax.default_backend()}
-        return any(p in ("tpu", "axon") for p in plats)
+        plats = {d.platform for d in data.devices()}
     except Exception:
-        return False
+        plats = set()
+    if not plats:
+        plats = {_jax.default_backend()}
+    return any(p in ("tpu", "axon") for p in plats)

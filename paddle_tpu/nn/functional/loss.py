@@ -50,6 +50,27 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     wd = weight._data if isinstance(weight, Tensor) else weight
 
     def f(logits, lbl):
+        # big-vocab hard-label mean: chunked-CE custom VJP — never
+        # materializes the fp32 [N, V] log-softmax (the top HBM
+        # allocation of an MLM/LM step at V=30k+; ref fused
+        # c_softmax_with_cross_entropy role)
+        hard = not (lbl.ndim == logits.ndim and
+                    lbl.shape[axis] == logits.shape[axis] and
+                    jnp.issubdtype(lbl.dtype, jnp.floating))
+        if (use_softmax and not soft_label and hard and wd is None
+                and label_smoothing == 0.0 and reduction == "mean"
+                and axis in (-1, logits.ndim - 1)
+                and logits.ndim in (2, 3)
+                and logits.shape[-1] >= 4096):
+            from ...ops.fused_ce import fused_softmax_ce_mean
+            lbl_idx = lbl.astype(jnp.int32)
+            if (lbl_idx.ndim == logits.ndim and
+                    lbl_idx.shape[-1] == 1):
+                lbl_idx = jnp.squeeze(lbl_idx, -1)
+            if lbl_idx.ndim == logits.ndim - 1:
+                lg3 = logits if logits.ndim == 3 else logits[None]
+                lb3 = lbl_idx if lbl_idx.ndim == 2 else lbl_idx[None]
+                return fused_softmax_ce_mean(lg3, lb3, ignore_index)
         if use_softmax:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
         else:

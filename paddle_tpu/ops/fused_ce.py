@@ -32,15 +32,18 @@ def _chunks(seq_len: int, target: int = 256) -> int:
     return seq_len
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=())
-def fused_softmax_ce_mean(logits, labels):
-    """mean over all positions of -log softmax(logits)[labels].
-    logits: [B, L, V] (any float dtype), labels: [B, L] int."""
-    loss, _ = _ce_fwd_impl(logits, labels)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fused_softmax_ce_mean(logits, labels, ignore_index=None):
+    """mean over positions of -log softmax(logits)[labels].
+    logits: [B, L, V] (any float dtype), labels: [B, L] int.
+    ``ignore_index``: positions with that label contribute nothing and
+    are excluded from the mean's denominator (ref: cross_entropy
+    ignore_index semantics, python/paddle/nn/functional/loss.py)."""
+    loss, _, _ = _ce_fwd_impl(logits, labels, ignore_index)
     return loss
 
 
-def _ce_fwd_impl(logits, labels):
+def _ce_fwd_impl(logits, labels, ignore_index):
     b, l, v = logits.shape
     c = _chunks(l)
     lg = logits.reshape(b, l // c, c, v)
@@ -50,34 +53,49 @@ def _ce_fwd_impl(logits, labels):
         lg_c, lb_c = xs  # [B, c, V], [B, c]
         f = lg_c.astype(jnp.float32)
         lse = jax.nn.logsumexp(f, axis=-1)               # [B, c]
-        tgt = jnp.take_along_axis(
-            f, lb_c[..., None].astype(jnp.int32), axis=-1)[..., 0]
-        return carry + jnp.sum(lse - tgt), lse
+        idx = lb_c.astype(jnp.int32)
+        if ignore_index is not None:
+            idx = jnp.clip(idx, 0, v - 1)  # ignored labels may be -100
+        tgt = jnp.take_along_axis(f, idx[..., None], axis=-1)[..., 0]
+        per = lse - tgt
+        if ignore_index is not None:
+            per = jnp.where(lb_c == ignore_index, 0.0, per)
+        return carry + jnp.sum(per), lse
 
     total, lses = jax.lax.scan(
         chunk, jnp.float32(0.0),
         (jnp.swapaxes(lg, 0, 1), jnp.swapaxes(lb, 0, 1)))
     lse = jnp.swapaxes(lses, 0, 1).reshape(b, l)
-    return total / (b * l), lse
+    if ignore_index is None:
+        n_valid = jnp.float32(b * l)
+    else:
+        n_valid = jnp.maximum(
+            jnp.sum(labels != ignore_index).astype(jnp.float32), 1.0)
+    return total / n_valid, lse, n_valid
 
 
-def _ce_vjp_fwd(logits, labels):
-    loss, lse = _ce_fwd_impl(logits, labels)
-    return loss, (logits, labels, lse)
+def _ce_vjp_fwd(logits, labels, ignore_index):
+    loss, lse, n_valid = _ce_fwd_impl(logits, labels, ignore_index)
+    return loss, (logits, labels, lse, n_valid)
 
 
-def _ce_vjp_bwd(res, g):
-    logits, labels, lse = res
+def _ce_vjp_bwd(ignore_index, res, g):
+    logits, labels, lse, n_valid = res
     b, l, v = logits.shape
     c = _chunks(l)
-    scale = g / (b * l)
+    scale = g / n_valid
 
     def chunk(_, xs):
         lg_c, lb_c, lse_c = xs
         p = jnp.exp(lg_c.astype(jnp.float32) - lse_c[..., None])
-        onehot = jax.nn.one_hot(lb_c.astype(jnp.int32), v,
-                                dtype=jnp.float32)
-        return None, ((p - onehot) * scale).astype(logits.dtype)
+        idx = lb_c.astype(jnp.int32)
+        if ignore_index is not None:
+            idx = jnp.clip(idx, 0, v - 1)
+        onehot = jax.nn.one_hot(idx, v, dtype=jnp.float32)
+        d = (p - onehot) * scale
+        if ignore_index is not None:
+            d = jnp.where((lb_c == ignore_index)[..., None], 0.0, d)
+        return None, d.astype(logits.dtype)
 
     _, dl = jax.lax.scan(
         chunk, None,

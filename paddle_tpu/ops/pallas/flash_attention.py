@@ -26,12 +26,20 @@ __all__ = ["flash_attention_fwd", "flash_attention",
 _NEG_INF = -1e30
 
 
-def _sdpa_xla(q, k, v, causal=False, scale=None, mask=None):
+def _sdpa_xla(q, k, v, causal=False, scale=None, mask=None,
+              dropout_p=0.0, seed=None, dropout_key=None):
     """Numeric oracle, layout [B, L, H, D]. `mask` is additive, broadcast
     against [B, H, Lq, Lk] logits. Handles Lq < Lk (KV-cache decode) by
-    offsetting the causal diagonal."""
+    offsetting the causal diagonal. Dropout is deterministic given
+    ``seed`` (or an explicit ``dropout_key``) so the VJP fallback can
+    replay the identical mask. This is THE reference oracle —
+    nn.functional's _sdpa_reference delegates here."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
+    if dropout_p >= 1.0:
+        # everything dropped: zeros with zero (not NaN) gradients — the
+        # 1/(1-p) rescale below would divide by zero
+        return jnp.zeros_like(q)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -43,29 +51,64 @@ def _sdpa_xla(q, k, v, causal=False, scale=None, mask=None):
     if mask is not None:
         logits = logits + mask
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0:
+        if dropout_key is None and seed is not None:
+            dropout_key = jax.random.PRNGKey(jnp.asarray(seed).reshape(()))
+        if dropout_key is not None:
+            keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                        probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                              0.0).astype(probs.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
 
 
 try:  # Pallas import is deferred-safe: CPU wheels ship it but TPU lowering
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
     _HAS_PALLAS = True
 except Exception:  # pragma: no cover
     pl = None
+    pltpu = None
     _HAS_PALLAS = False
+
+
+def _keep_mask(seed_ref, b, qi, ki, block_q, block_k, seq_len, dropout_p):
+    """Deterministic per-tile dropout keep-mask. Seeding with the
+    (seed, batch-head, q-tile, k-tile) tuple makes the mask a pure
+    function of absolute tile position, so forward and both backward
+    kernels regenerate identical bits regardless of their grid order
+    (ref: the flash_attn CUDA kernels thread a philox offset the same
+    way, paddle/phi/kernels/gpu/flash_attn_kernel.cu seed/offset args).
+    Mosaic caps prng_seed at 2 values, so the tile coordinate folds into
+    one int32 — injective because qi < L/block_q and ki < L/block_k."""
+    nq = seq_len // block_q
+    nk = seq_len // block_k
+    tile = (b * nq + qi) * nk + ki
+    pltpu.prng_seed(seed_ref[0], tile)
+    bits = pltpu.prng_random_bits((block_q, block_k))
+    bits = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+    thresh = jnp.uint32(min(int(dropout_p * (2 ** 32)), 2 ** 32 - 1))
+    return bits >= thresh
 
 
 # ---------------------------------------------------------------------------
 # forward kernel: one (batch*head, q-block) program; inner loop tiles KV
 # with online softmax; also emits logsumexp for the backward pass
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
-                seq_len, causal, scale, segmented=False):
+def _fwd_kernel(*refs, block_q, block_k, seq_len, causal, scale,
+                segmented=False, dropout_p=0.0):
+    if dropout_p > 0.0:
+        seed_ref, *refs = refs
+    else:
+        seed_ref = None
+    q_ref, k_ref, v_ref, *rest = refs
     if segmented:
         seg_ref, o_ref, lse_ref = rest
     else:
         seg_ref = None
         o_ref, lse_ref = rest
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
 
@@ -101,11 +144,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
         m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
         p = jnp.exp(logits - m_new)
         alpha = jnp.exp(m - m_new)
+        # softmax statistics (l, lse) use the UNdropped probabilities;
+        # dropout zeroes entries of the numerator only — dividing by the
+        # full l afterwards is exactly dropout(softmax(s)) since the
+        # normalization is linear
         l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref, b, qi, ki, block_q, block_k,
+                              seq_len, dropout_p)
+            p = jnp.where(keep, p, 0.0)
         acc_new = alpha * acc + p @ v_blk
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks_eff, body, (m, l, acc))
+    if dropout_p > 0.0:
+        acc = acc * (1.0 / (1.0 - dropout_p))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
@@ -117,14 +170,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
 #   dS = P ∘ (dO @ Vᵀ − Δ) · scale     with Δ = rowsum(dO ∘ O)
 #   dQ = dS @ K ;  dK = dSᵀ @ Q
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                   block_q, block_k, seq_len, causal, scale,
-                   segmented=False):
+def _bwd_dq_kernel(*refs, block_q, block_k, seq_len, causal, scale,
+                   segmented=False, dropout_p=0.0):
+    if dropout_p > 0.0:
+        seed_ref, *refs = refs
+    else:
+        seed_ref = None
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest = refs
     if segmented:
         seg_ref, dq_ref = rest
     else:
         seg_ref = None
         (dq_ref,) = rest
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -153,6 +211,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]
             p = jnp.where(seg_q == seg_k.reshape(1, block_k), p, 0.0)
         dp = do @ v_blk.T
+        if dropout_p > 0.0:
+            # dS = P ∘ (M∘dP_d/(1−p) − Δ): Δ = rowsum(dO∘O) already
+            # equals Σ_k P_d·dP_d, so only the dp term needs the mask
+            keep = _keep_mask(seed_ref, b, qi, ki, block_q, block_k,
+                              seq_len, dropout_p)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_p))
         ds = p * (dp - delta) * scale
         return dq + ds @ k_blk
 
@@ -162,14 +226,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    *rest, block_q, block_k, seq_len, causal,
-                    scale, segmented=False):
+def _bwd_dkv_kernel(*refs, block_q, block_k, seq_len, causal,
+                    scale, segmented=False, dropout_p=0.0):
+    if dropout_p > 0.0:
+        seed_ref, *refs = refs
+    else:
+        seed_ref = None
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest = refs
     if segmented:
         seg_ref, dk_ref, dv_ref = rest
     else:
         seg_ref = None
         dk_ref, dv_ref = rest
+    b = pl.program_id(0)
     ki = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)      # [block_k, d]
     v_blk = v_ref[0].astype(jnp.float32)
@@ -198,8 +267,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if segmented:
             seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
             p = jnp.where(seg_q == seg_k.reshape(1, block_k), p, 0.0)
-        dv_new = dv + p.T @ do_blk
         dp = do_blk @ v_blk.T
+        if dropout_p > 0.0:
+            # same (seed, b, qi, ki) tuple as fwd/dq — identical mask
+            # despite this kernel's transposed grid order
+            keep = _keep_mask(seed_ref, b, qi, ki, block_q, block_k,
+                              seq_len, dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            p_d = jnp.where(keep, p, 0.0) * inv   # dropped P for dV
+            dp = jnp.where(keep, dp, 0.0) * inv
+        else:
+            p_d = p
+        dv_new = dv + p_d.T @ do_blk
         ds = p * (dp - delta) * scale
         dk_new = dk + ds.T @ q_blk
         return dk_new, dv_new
@@ -213,18 +292,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k"))
-def _flash_fwd_pallas(q, k, v, causal, scale, block_q=256, block_k=256):
-    """q,k,v: [BH, L, D] -> (out [BH, L, D], lse [BH, L])."""
+                                             "block_k", "dropout_p"))
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q=256, block_k=256,
+                      dropout_p=0.0, seed=None):
+    """q,k,v: [BH, L, D] -> (out [BH, L, D], lse [BH, L]).
+    ``seed``: (1,) int32 SMEM scalar, required when dropout_p > 0 —
+    dropout masks are regenerated from it in the backward kernels."""
     bh, seq_len, d = q.shape
     grid = (bh, seq_len // block_q)
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, seq_len=seq_len,
-        causal=causal, scale=scale)
+        causal=causal, scale=scale, dropout_p=dropout_p)
+    seed_specs = ([pl.BlockSpec(memory_space=pltpu.SMEM)]
+                  if dropout_p > 0.0 else [])
+    seed_args = (seed,) if dropout_p > 0.0 else ()
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
@@ -237,25 +322,28 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q=256, block_k=256):
             jax.ShapeDtypeStruct((bh, seq_len, d), q.dtype),
             jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
         ],
-    )(q, k, v)
+    )(*seed_args, q, k, v)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k"))
+                                             "block_k", "dropout_p"))
 def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, block_q=256,
-                      block_k=256):
+                      block_k=256, dropout_p=0.0, seed=None):
     """[BH, L, D] residuals + dO -> (dq, dk, dv)."""
     bh, seq_len, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, L, 1]
+    seed_specs = ([pl.BlockSpec(memory_space=pltpu.SMEM)]
+                  if dropout_p > 0.0 else [])
+    seed_args = (seed,) if dropout_p > 0.0 else ()
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, block_q=block_q, block_k=block_k, seq_len=seq_len,
-        causal=causal, scale=scale)
+        causal=causal, scale=scale, dropout_p=dropout_p)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, seq_len // block_q),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
@@ -265,15 +353,15 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, block_q=256,
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_len, d), q.dtype),
-    )(q, k, v, do, lse, delta)
+    )(*seed_args, q, k, v, do, lse, delta)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, block_q=block_q, block_k=block_k, seq_len=seq_len,
-        causal=causal, scale=scale)
+        causal=causal, scale=scale, dropout_p=dropout_p)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, seq_len // block_k),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
@@ -289,13 +377,16 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, block_q=256,
             jax.ShapeDtypeStruct((bh, seq_len, d), k.dtype),
             jax.ShapeDtypeStruct((bh, seq_len, d), v.dtype),
         ],
-    )(q, k, v, do, lse, delta)
+    )(*seed_args, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
 def _tiles_ok(seq_len, d, block_q, block_k) -> bool:
+    # d=64 (BERT-class heads) runs natively: Mosaic lays a [*, 64] tile
+    # across half the 128 lanes; measured on v5e the native kernel beats
+    # pad-to-128 at the BERT bench geometry (no pad/slice HBM traffic)
     return (seq_len % block_q == 0 and seq_len % block_k == 0
-            and d % 128 == 0 and seq_len >= block_q)
+            and d % 64 == 0 and seq_len >= block_q)
 
 
 _block_tune_cache: dict = {}
@@ -364,36 +455,58 @@ def _from_bhld(x, b, h):
     return jnp.swapaxes(x.reshape(b, h, l, d), 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, scale=None):
-    """[B, L, H, D] in/out (paddle flash-attention layout)."""
-    out, _ = _flash_fwd_res(q, k, v, causal, scale)
+def _as_seed(seed):
+    """Normalize to the (1,) int32 SMEM scalar the kernels expect."""
+    return jnp.asarray(seed, jnp.int32).reshape(1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=False, scale=None, dropout_p=0.0,
+                    seed=None):
+    """[B, L, H, D] in/out (paddle flash-attention layout).
+
+    ``dropout_p``/``seed`` give fused attention-probability dropout
+    (ref: flash_attn_kernel.cu p_dropout + philox seed/offset): the keep
+    mask is generated inside the kernel from (seed, tile position) and
+    regenerated identically in the backward kernels, so dropped
+    probabilities never touch HBM. ``seed`` may be a python int or a
+    traced int scalar (changes per step under one compiled program)."""
+    out, _ = _flash_fwd_res(q, k, v, causal, scale, dropout_p, seed)
     return out
 
 
-def _flash_fwd_res(q, k, v, causal, scale):
+def _flash_fwd_res(q, k, v, causal, scale, dropout_p=0.0, seed=None):
     b, l, h, d = q.shape
     s = scale if scale is not None else 1.0 / math.sqrt(d)
+    if dropout_p > 0.0 and seed is None:
+        raise ValueError("flash_attention dropout needs a seed")
+    if dropout_p >= 1.0:
+        raise ValueError(
+            "flash_attention dropout_p must be < 1 (p=1 zeroes the "
+            "output — handle it at the dropout call site)")
     if _use_pallas(l, d):
         qb, kb, vb = _to_bhld(q), _to_bhld(k), _to_bhld(v)
         blk = _pick_block(l, d, sample=(qb, kb, vb))
         out_bhld, lse = _flash_fwd_pallas(
-            qb, kb, vb, causal, s, block_q=blk, block_k=blk)
+            qb, kb, vb, causal, s, block_q=blk, block_k=blk,
+            dropout_p=float(dropout_p),
+            seed=_as_seed(seed) if dropout_p > 0.0 else None)
         out = _from_bhld(out_bhld, b, h)
         # residual keeps the blhd output (the array the caller holds
         # anyway); bwd re-derives the bhld layout transiently — avoids
         # pinning a second copy of every layer's attention output
         return out, (out, lse)
-    return _sdpa_xla(q, k, v, causal=causal, scale=s), None
+    return _sdpa_xla(q, k, v, causal=causal, scale=s,
+                     dropout_p=dropout_p, seed=seed), None
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale):
-    out, res = _flash_fwd_res(q, k, v, causal, scale)
-    return out, (q, k, v, res)
+def _flash_vjp_fwd(q, k, v, causal, scale, dropout_p, seed):
+    out, res = _flash_fwd_res(q, k, v, causal, scale, dropout_p, seed)
+    return out, (q, k, v, seed, res)
 
 
-def _flash_vjp_bwd(causal, scale, residuals, g):
-    q, k, v, res = residuals
+def _flash_vjp_bwd(causal, scale, dropout_p, residuals, g):
+    q, k, v, seed, res = residuals
     b, l, h, d = q.shape
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     if res is not None:  # pallas path: res = (out in blhd, lse)
@@ -401,21 +514,26 @@ def _flash_vjp_bwd(causal, scale, residuals, g):
         blk = _pick_block(l, d)
         dq, dk, dv = _flash_bwd_pallas(
             _to_bhld(q), _to_bhld(k), _to_bhld(v), _to_bhld(out), lse,
-            _to_bhld(g), causal, s, block_q=blk, block_k=blk)
+            _to_bhld(g), causal, s, block_q=blk, block_k=blk,
+            dropout_p=float(dropout_p),
+            seed=_as_seed(seed) if dropout_p > 0.0 else None)
         return (_from_bhld(dq, b, h), _from_bhld(dk, b, h),
-                _from_bhld(dv, b, h))
-    # fallback: recompute-based XLA VJP
-    _, vjp = jax.vjp(lambda a, b_, c: _sdpa_xla(a, b_, c, causal=causal,
-                                                scale=s), q, k, v)
-    return vjp(g)
+                _from_bhld(dv, b, h), None)
+    # fallback: recompute-based XLA VJP (same seed -> identical mask)
+    _, vjp = jax.vjp(
+        lambda a, b_, c: _sdpa_xla(a, b_, c, causal=causal, scale=s,
+                                   dropout_p=dropout_p, seed=seed),
+        q, k, v)
+    return vjp(g) + (None,)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention_fwd(q, k, v, causal=False, scale=None):
+def flash_attention_fwd(q, k, v, causal=False, scale=None, dropout_p=0.0,
+                        seed=None):
     """Entry used by nn.functional.attention."""
-    return flash_attention(q, k, v, causal, scale)
+    return flash_attention(q, k, v, causal, scale, dropout_p, seed)
 
 
 # ---------------------------------------------------------------------------

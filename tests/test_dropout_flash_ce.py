@@ -1,0 +1,202 @@
+"""Tests for the BERT-bar perf pack: hash dropout, flash d=64 gating +
+in-kernel dropout plumbing, and the big-vocab chunked cross-entropy route
+(ref: dropout_kernel.cu philox dropout; flash_attn_kernel.cu p_dropout;
+c_softmax_with_cross_entropy fused CE)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestHashDropout:
+    def test_mean_preserved_and_fraction(self):
+        paddle.seed(0)
+        x = paddle.ones([256, 256])
+        y = F.dropout(x, p=0.25, training=True)
+        yn = y.numpy()
+        frac_kept = float((yn != 0).mean())
+        assert abs(frac_kept - 0.75) < 0.02
+        # upscale_in_train: kept entries are x/(1-p)
+        np.testing.assert_allclose(yn[yn != 0], 1.0 / 0.75, rtol=1e-6)
+        assert abs(float(yn.mean()) - 1.0) < 0.03
+
+    def test_deterministic_per_seed(self):
+        x = paddle.ones([64, 128])
+        paddle.seed(7)
+        a = F.dropout(x, p=0.5, training=True).numpy()
+        paddle.seed(7)
+        b = F.dropout(x, p=0.5, training=True).numpy()
+        np.testing.assert_array_equal(a, b)
+        paddle.seed(8)
+        c = F.dropout(x, p=0.5, training=True).numpy()
+        assert not np.array_equal(a, c)
+
+    def test_grad_is_mask_over_keep(self):
+        paddle.seed(3)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (32, 128)).astype(np.float32), stop_gradient=False)
+        paddle.seed(11)
+        y = F.dropout(x, p=0.4, training=True)
+        y.sum().backward()
+        g = x.grad.numpy()
+        mask = (y.numpy() != 0).astype(np.float32)
+        np.testing.assert_allclose(g, mask / 0.6, rtol=1e-5)
+
+    def test_eval_passthrough_and_edges(self):
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (8, 128)).astype(np.float32))
+        np.testing.assert_array_equal(
+            F.dropout(x, p=0.9, training=False).numpy(), x.numpy())
+        np.testing.assert_array_equal(
+            F.dropout(x, p=0.0, training=True).numpy(), x.numpy())
+        assert float(np.abs(
+            F.dropout(x, p=1.0, training=True).numpy()).max()) == 0.0
+
+    def test_axis_mode_still_works(self):
+        """axis dropout keeps the bernoulli path (mask broadcast along
+        non-listed dims)."""
+        paddle.seed(0)
+        x = paddle.ones([16, 64])
+        y = F.dropout(x, p=0.5, axis=0, training=True).numpy()
+        # each row is all-kept or all-dropped
+        rows = (y != 0).all(axis=1) | (y == 0).all(axis=1)
+        assert rows.all()
+
+
+class TestFusedCERoute:
+    def _oracle(self, logits, labels, ignore_index=-100):
+        f = logits.astype(np.float64)
+        lse = np.log(np.exp(f - f.max(-1, keepdims=True)).sum(-1)) + \
+            f.max(-1)
+        per = lse - np.take_along_axis(
+            f, np.maximum(labels, 0)[..., None], axis=-1)[..., 0]
+        valid = labels != ignore_index
+        return per[valid].mean()
+
+    def test_big_vocab_matches_oracle(self):
+        rng = np.random.default_rng(0)
+        n, v = 64, 4096  # v >= 4096 engages the chunked route
+        logits = rng.standard_normal((n, v)).astype(np.float32)
+        labels = rng.integers(0, v, (n,)).astype(np.int64)
+        got = float(F.cross_entropy(paddle.to_tensor(logits),
+                                    paddle.to_tensor(labels)))
+        np.testing.assert_allclose(got, self._oracle(logits, labels),
+                                   rtol=1e-5)
+
+    def test_big_vocab_ignore_index(self):
+        rng = np.random.default_rng(1)
+        n, v = 64, 4096
+        logits = rng.standard_normal((n, v)).astype(np.float32)
+        labels = rng.integers(0, v, (n,)).astype(np.int64)
+        labels[::3] = -100
+        got = float(F.cross_entropy(paddle.to_tensor(logits),
+                                    paddle.to_tensor(labels)))
+        np.testing.assert_allclose(got, self._oracle(labels=labels,
+                                                     logits=logits),
+                                   rtol=1e-5)
+
+    def test_big_vocab_grad_matches_small_vocab_formula(self):
+        """d_logits = (softmax - onehot)/N on the fused route == the
+        unfused formula (checked against the v<4096 XLA path on a
+        sliced problem is impossible, so check analytically)."""
+        rng = np.random.default_rng(2)
+        n, v = 16, 4096
+        logits_np = rng.standard_normal((n, v)).astype(np.float32)
+        labels_np = rng.integers(0, v, (n,)).astype(np.int64)
+        t = paddle.to_tensor(logits_np, stop_gradient=False)
+        loss = F.cross_entropy(t, paddle.to_tensor(labels_np))
+        loss.backward()
+        g = t.grad.numpy()
+        f = logits_np.astype(np.float64)
+        sm = np.exp(f - f.max(-1, keepdims=True))
+        sm /= sm.sum(-1, keepdims=True)
+        oh = np.zeros_like(sm)
+        oh[np.arange(n), labels_np] = 1.0
+        np.testing.assert_allclose(g, (sm - oh) / n, atol=1e-6)
+
+    def test_3d_logits_route(self):
+        rng = np.random.default_rng(3)
+        b, l, v = 2, 8, 4096
+        logits = rng.standard_normal((b, l, v)).astype(np.float32)
+        labels = rng.integers(0, v, (b, l)).astype(np.int64)
+        got = float(F.cross_entropy(paddle.to_tensor(logits),
+                                    paddle.to_tensor(labels)))
+        np.testing.assert_allclose(
+            got, self._oracle(logits.reshape(-1, v), labels.reshape(-1)),
+            rtol=1e-5)
+
+
+class TestFlashD64Gate:
+    def test_tiles_ok_accepts_d64(self):
+        from paddle_tpu.ops.pallas.flash_attention import _tiles_ok
+        assert _tiles_ok(512, 64, 128, 128)
+        assert _tiles_ok(512, 128, 128, 128)
+        assert not _tiles_ok(512, 48, 128, 128)
+        assert not _tiles_ok(100, 64, 128, 128)
+
+    def test_sdpa_dropout_seed_deterministic_fallback(self):
+        """CPU fallback of flash_attention with dropout: same seed ->
+        same output; p=0 matches the no-dropout oracle."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention, _sdpa_xla)
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((2, 16, 2, 8)).astype(
+            np.float32))
+        k = jnp.asarray(rng.standard_normal((2, 16, 2, 8)).astype(
+            np.float32))
+        v = jnp.asarray(rng.standard_normal((2, 16, 2, 8)).astype(
+            np.float32))
+        o0 = flash_attention(q, k, v, False, None, 0.0, None)
+        np.testing.assert_allclose(np.asarray(o0),
+                                   np.asarray(_sdpa_xla(q, k, v)),
+                                   rtol=1e-6)
+        a = flash_attention(q, k, v, False, None, 0.2, 5)
+        b = flash_attention(q, k, v, False, None, 0.2, 5)
+        c = flash_attention(q, k, v, False, None, 0.2, 6)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_sdpa_dropout_grad_fd_fallback(self):
+        """Finite differences re-run the same seeded mask, so they give a
+        true check of the dropout VJP on the fallback path."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.standard_normal((1, 8, 1, 4)).astype(
+            np.float64))
+        k = jnp.asarray(rng.standard_normal((1, 8, 1, 4)).astype(
+            np.float64))
+        v = jnp.asarray(rng.standard_normal((1, 8, 1, 4)).astype(
+            np.float64))
+        w = jnp.asarray(rng.standard_normal((1, 8, 1, 4)).astype(
+            np.float64))
+
+        def loss(qq):
+            return jnp.sum(flash_attention(qq, k, v, True, None, 0.3, 9)
+                           * w)
+
+        g = jax.grad(loss)(q)
+        # f32 arithmetic: FD quotient noise ~|L|*1e-7/eps — keep eps
+        # large enough that 1% tolerance holds (mask is seed-only, so
+        # perturbation never flips it)
+        eps = 5e-3
+        d = jnp.asarray(rng.standard_normal(q.shape))
+        fd = (loss(q + eps * d) - loss(q - eps * d)) / (2 * eps)
+        np.testing.assert_allclose(float(jnp.sum(g * d)), float(fd),
+                                   rtol=1e-2)
+
+    def test_mha_dropout_trains(self):
+        """MultiHeadAttention with attn dropout>0 trains end-to-end on the
+        CPU path (routing sanity for the fused-dropout attention gate)."""
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(32, 4, dropout=0.1)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 16, 32)).astype(np.float32), stop_gradient=False)
+        out = mha(x, x, x)
+        out.sum().backward()
+        assert mha.q_proj.weight.grad is not None
+        assert np.isfinite(mha.q_proj.weight.grad.numpy()).all()
