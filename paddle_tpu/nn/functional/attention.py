@@ -53,11 +53,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             lambda q, k, v: flash_attention_fwd(q, k, v, causal=is_causal),
             query, key, value, op_name="flash_attention")
 
-    dropout_key = random_mod.next_key() if drop > 0.0 else None
+    if drop > 0.0:
+        # same marked-arg contract as the flash path: a closure-captured
+        # key would freeze the dropout mask across compiled steps and
+        # static replays
+        from .common import _rng_key_tensor
+        key_t = _rng_key_tensor()
+
+        def f_drop(q, k, v, rng_key):
+            return _sdpa_reference(q, k, v, mask=md, causal=is_causal,
+                                   dropout_p=drop, dropout_key=rng_key)
+        return apply_op(f_drop, query, key, value, key_t, op_name="sdpa")
 
     def f(q, k, v):
-        return _sdpa_reference(q, k, v, mask=md, causal=is_causal,
-                               dropout_p=drop, dropout_key=dropout_key)
+        return _sdpa_reference(q, k, v, mask=md, causal=is_causal)
     return apply_op(f, query, key, value, op_name="sdpa")
 
 

@@ -23,10 +23,31 @@ from .program import (  # noqa: F401
 )
 from .executor import Executor, global_scope  # noqa: F401
 from .io import load_inference_model, save_inference_model  # noqa: F401
+from .extras import (  # noqa: F401
+    Variable, BuildStrategy, CompiledProgram, ExponentialMovingAverage,
+    WeightNormParamAttr, Print, py_func, accuracy, auc,
+    ctr_metric_bundle, append_backward, gradients, create_global_var,
+    create_parameter, cpu_places, cuda_places, xpu_places, device_guard,
+    name_scope, scope_guard, save, load, save_to_file, load_from_file,
+    load_program_state, set_program_state, serialize_program,
+    serialize_persistables, deserialize_program, deserialize_persistables,
+    normalize_program, IpuCompiledProgram, IpuStrategy, ipu_shard_guard,
+    set_ipu_shard,
+)
 from . import nn  # noqa: F401
 
 __all__ = [
     "InputSpec", "Program", "data", "default_main_program",
     "default_startup_program", "program_guard", "Executor", "global_scope",
     "save_inference_model", "load_inference_model", "nn",
+    "Variable", "BuildStrategy", "CompiledProgram",
+    "ExponentialMovingAverage", "WeightNormParamAttr", "Print", "py_func",
+    "accuracy", "auc", "ctr_metric_bundle", "append_backward",
+    "gradients", "create_global_var", "create_parameter", "cpu_places",
+    "cuda_places", "xpu_places", "device_guard", "name_scope",
+    "scope_guard", "save", "load", "save_to_file", "load_from_file",
+    "load_program_state", "set_program_state", "serialize_program",
+    "serialize_persistables", "deserialize_program",
+    "deserialize_persistables", "normalize_program", "IpuCompiledProgram",
+    "IpuStrategy", "ipu_shard_guard", "set_ipu_shard",
 ]

@@ -35,8 +35,12 @@ def global_scope():
 
 
 def _replay(program: Program, feed_vals: Dict[str, jax.Array],
-            ref_vals: Sequence[jax.Array], rng_vals: Sequence = ()):
-    """Pure replay of the tape. Returns env mapping tensor-id -> value."""
+            ref_vals: Sequence[jax.Array], rng_vals: Sequence = (),
+            overrides: Optional[Dict[int, jax.Array]] = None):
+    """Pure replay of the tape. Returns env mapping tensor-id -> value.
+    ``overrides`` substitutes a produced var's value right after its op —
+    this is how gradients() differentiates w.r.t. an intermediate: the
+    override value becomes the graph input at that cut point."""
     env: Dict[int, jax.Array] = {}
 
     def resolve(spec):
@@ -58,7 +62,69 @@ def _replay(program: Program, feed_vals: Dict[str, jax.Array],
         for oid, o in zip(op.out_ids, outs):
             if oid is not None:
                 env[oid] = o
+                if overrides is not None and oid in overrides:
+                    env[oid] = overrides[oid]
     return env
+
+
+def _grad_fetches(program: Program, fetch_list, feed_arrays, ref_vals,
+                  rng_vals):
+    """Resolve gradient-handle fetches (append_backward / gradients) by
+    differentiating the pure replay. Returns {fetch_index: value}.
+    Handles are grouped by target expression so each group costs one
+    jax.grad trace (XLA CSE merges the repeated forward subgraphs)."""
+    groups: Dict[tuple, list] = {}
+    for i, t in enumerate(fetch_list):
+        req = program._grad_handles.get(id(t))
+        if req is not None:
+            targets, wrt_spec = req
+            groups.setdefault(targets, []).append((i, wrt_spec))
+    out: Dict[int, jax.Array] = {}
+    for targets, members in groups.items():
+        specs = [s for (_, s) in members]
+
+        def scalar(vals, targets=targets, specs=specs):
+            feeds2 = dict(feed_arrays)
+            refs2 = list(ref_vals)
+            overrides = {}
+            for spec, v in zip(specs, vals):
+                kind, key = spec
+                if kind == "ref":
+                    refs2[key] = v
+                elif kind == "feed":
+                    feeds2[key] = v
+                elif kind == "var":
+                    overrides[key] = v
+            env = _replay(program, feeds2, refs2, rng_vals,
+                          overrides=overrides)
+            tot = jnp.float32(0.0)
+            for tid, tg_spec in targets:
+                tv = env[tid].astype(jnp.float32)
+                if tg_spec is None:
+                    tot = tot + jnp.sum(tv)
+                else:
+                    kind, key = tg_spec
+                    tg = (env[key] if kind == "var" else
+                          ref_vals[key] if kind == "ref" else
+                          feed_arrays[key])
+                    tot = tot + jnp.sum(tv * tg.astype(jnp.float32))
+            return tot
+
+        def current(spec):
+            kind, key = spec
+            if kind == "ref":
+                return ref_vals[key]
+            if kind == "feed":
+                return feed_arrays[key]
+            # var: its forward value from a plain replay
+            env = _replay(program, feed_arrays, ref_vals, rng_vals)
+            return env[key]
+
+        vals = [current(s) for s in specs]
+        gs = jax.grad(scalar)(vals)
+        for (i, _), g in zip(members, gs):
+            out[i] = g
+    return out
 
 
 def _lookup_fetch(program, env, feed_arrays, ref_vals, t: Tensor):
@@ -139,12 +205,20 @@ class Executor:
             from ..core import random as random_mod
             return [random_mod.next_key() for _ in range(n_rng)]
 
+        grad_ids = {i for i, t in enumerate(fetch_list)
+                    if id(t) in program._grad_handles}
+
         if opt is None:
             @jax.jit
             def pure(feed_arrays, ref_vals, rng_vals):
                 env = _replay(program, feed_arrays, ref_vals, rng_vals)
-                fetches = [_lookup_fetch(program, env, feed_arrays,
-                                         ref_vals, t) for t in fetch_list]
+                fetches = [None if i in grad_ids else
+                           _lookup_fetch(program, env, feed_arrays,
+                                         ref_vals, t)
+                           for i, t in enumerate(fetch_list)]
+                for i, g in _grad_fetches(program, fetch_list, feed_arrays,
+                                          ref_vals, rng_vals).items():
+                    fetches[i] = g
                 return fetches, [env[sid] for sid in buf_src_ids]
 
             def run(feed_arrays):
@@ -192,8 +266,12 @@ class Executor:
                 np_, ns = opt._update(p, g, s, lr)
                 new_params.append(np_)
                 new_states.append(ns)
-            fetches = [_lookup_fetch(program, env, feed_arrays, ref_vals, t)
-                       for t in fetch_list]
+            fetches = [None if i in grad_ids else
+                       _lookup_fetch(program, env, feed_arrays, ref_vals, t)
+                       for i, t in enumerate(fetch_list)]
+            for i, g in _grad_fetches(program, fetch_list, feed_arrays,
+                                      ref_vals, rng_vals).items():
+                fetches[i] = g
             return fetches, new_params, new_states, \
                 [env[sid] for sid in buf_src_ids]
 

@@ -1,0 +1,717 @@
+"""paddle.static facade long tail.
+
+ref: python/paddle/static/__init__.py __all__ — the user-visible names
+beyond Program/Executor/data. Everything here is implemented over the
+record/replay Program machinery (program.py, executor.py): gradients are
+resolved by differentiating the pure replay, serialization rides the
+.pdmodel/state-dict formats, and places map onto the PJRT device list.
+IPU names are documented capability exclusions (no IPU backend in a TPU
+build) and fail loudly.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+from .program import (Program, current_program, default_main_program,
+                      default_startup_program)
+
+__all__ = [
+    "Variable", "BuildStrategy", "CompiledProgram",
+    "ExponentialMovingAverage", "WeightNormParamAttr", "Print",
+    "py_func", "accuracy", "auc", "ctr_metric_bundle",
+    "append_backward", "gradients", "create_global_var",
+    "create_parameter", "cpu_places", "cuda_places", "xpu_places",
+    "device_guard", "name_scope", "scope_guard", "save", "load",
+    "save_to_file", "load_from_file", "load_program_state",
+    "set_program_state", "serialize_program", "serialize_persistables",
+    "deserialize_program", "deserialize_persistables", "normalize_program",
+    "IpuCompiledProgram", "IpuStrategy", "ipu_shard_guard",
+    "set_ipu_shard",
+]
+
+# The reference's static Variable is the graph-tensor handle
+# (python/paddle/base/framework.py Variable); in the record/replay design
+# the recorded Tensor IS that handle, so the name is an alias, not a
+# parallel class hierarchy.
+Variable = Tensor
+
+
+class BuildStrategy:
+    """ref: static.BuildStrategy — pass-selection knobs for the legacy
+    graph engine (fuse_*, reduce strategy, …). Under XLA the fusion
+    decisions belong to the compiler, so these knobs are accepted,
+    recorded, and surfaced via repr for tooling parity; they do not steer
+    XLA (which already performs the fusions they request)."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_bn_add_act_ops = True
+        self.fuse_relu_depthwise_conv = False
+        self.fuse_broadcast_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.enable_auto_fusion = False
+        self.memory_optimize = None
+        self.enable_inplace = False
+        self.build_cinn_pass = False
+        self.debug_graphviz_path = ""
+
+    def __repr__(self):
+        flags = {k: v for k, v in self.__dict__.items()}
+        return f"BuildStrategy({flags})"
+
+
+class CompiledProgram:
+    """ref: static.CompiledProgram(program, build_strategy). The reference
+    wraps a Program for the ParallelExecutor path; here compilation is the
+    Executor's per-signature jit cache, so this carries the program +
+    strategy and the Executor unwraps it."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, name):
+        return getattr(self.program, name)
+
+
+class ExponentialMovingAverage:
+    """ref: static.ExponentialMovingAverage (static/ema.py): shadow
+    variables updated as ema = decay*ema + (1-decay)*param, with the
+    bias-corrected apply/restore swap used for evaluation."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = float(decay)
+        self._step = 0
+        self._shadow: dict = {}
+        self._backup: dict = {}
+        self._params: List[Parameter] = []
+
+    def _ensure(self, params):
+        import jax.numpy as jnp
+        for p in params:
+            if id(p) not in self._shadow:
+                self._params.append(p)
+                self._shadow[id(p)] = jnp.asarray(p._data,
+                                                  jnp.float32)
+
+    def update(self, parameters: Optional[Sequence] = None):
+        """One EMA step over ``parameters`` (default: every Parameter of
+        the default main program / previously tracked set)."""
+        import jax.numpy as jnp
+        if parameters is None:
+            prog = current_program() or default_main_program()
+            parameters = prog.parameters() or self._params
+        self._ensure(parameters)
+        self._step += 1
+        d = self.decay
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1.0 - d) * p._data.astype(
+                jnp.float32)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap EMA weights in (bias-corrected); restore on exit."""
+        bias = 1.0 - self.decay ** max(self._step, 1)
+        for p in self._params:
+            self._backup[id(p)] = p._data
+            p._data = (self._shadow[id(p)] / bias).astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+class WeightNormParamAttr:
+    """ref: static.WeightNormParamAttr — parameter attribute requesting
+    the w = g * v/||v|| reparameterization along ``dim``. Consumed by
+    static.create_parameter below; dygraph layers get the same effect
+    from paddle.nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=False,
+          print_tensor_lod=False, print_phase="both"):
+    """ref: static.Print — identity op that prints the tensor at run
+    time. jax.debug.print fires on every replay of the compiled program
+    (the reference prints from the op's Run)."""
+    import jax
+    from ..core.autograd import apply_op
+
+    msg = message or getattr(input, "name", "var")
+
+    def f(x):
+        jax.debug.print(msg + ": {}", x)
+        return x
+
+    return apply_op(f, input, op_name="print")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """ref: static.py_func — wrap a host-side python callable as an op.
+    TPU-native: jax.pure_callback (host round-trip per replay); the
+    optional backward_func becomes the op's custom VJP."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.autograd import apply_op
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+              for o in outs]
+
+    def host(*arrs):
+        res = func(*arrs)
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r) for r in res)
+
+    def f(*vals):
+        res = jax.pure_callback(host, shapes, *vals)
+        return res if len(res) > 1 else res[0]
+
+    if backward_func is not None:
+        in_shapes = [jax.ShapeDtypeStruct(tuple(t.shape), t._data.dtype)
+                     for t in xs]
+
+        def bwd_host(*arrs):
+            grads = backward_func(*arrs)
+            grads = grads if isinstance(grads, (list, tuple)) else [grads]
+            return tuple(np.asarray(g) for g in grads)
+
+        @jax.custom_vjp
+        def op(*vals):
+            return f(*vals)
+
+        def fwd(*vals):
+            return f(*vals), vals
+
+        def bwd(res_vals, g):
+            # the backward is a host callable too — it must go through
+            # pure_callback, not run on traced values
+            gs = g if isinstance(g, (list, tuple)) else (g,)
+            grads = jax.pure_callback(bwd_host, tuple(in_shapes),
+                                      *res_vals, *gs)
+            return tuple(grads)
+
+        op.defvjp(fwd, bwd)
+        return apply_op(op, *xs, op_name="py_func")
+    return apply_op(f, *xs, op_name="py_func")
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """ref: static.accuracy — top-k accuracy over softmax scores.
+    input [N, C] scores, label [N] or [N, 1] int."""
+    import jax.numpy as jnp
+    from ..core.autograd import apply_op
+
+    def f(scores, lbl):
+        if lbl.ndim == scores.ndim:
+            lbl = lbl.reshape(lbl.shape[0])
+        topk = jnp.argsort(-scores, axis=-1)[:, :k]
+        hit = (topk == lbl[:, None].astype(topk.dtype)).any(axis=1)
+        return hit.mean(dtype=jnp.float32)
+
+    return apply_op(f, input, label, op_name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """ref: static.auc — streaming ROC-AUC via threshold buckets. Returns
+    (auc_value, batch_auc, [stat_pos, stat_neg]) like the reference; the
+    stat tensors are live buffers the caller can reset."""
+    import jax.numpy as jnp
+    from ..core.autograd import apply_op
+
+    if curve != "ROC":
+        raise ValueError(f"auc curve {curve!r} not supported (ROC only)")
+    nb = num_thresholds + 1
+    stat_pos = Tensor(jnp.zeros((nb,), jnp.float32))
+    stat_neg = Tensor(jnp.zeros((nb,), jnp.float32))
+
+    def f(scores, lbl, sp, sn):
+        pos_score = scores[:, 1] if scores.ndim == 2 and \
+            scores.shape[1] >= 2 else scores.reshape(-1)
+        if lbl.ndim == 2:
+            lbl = lbl.reshape(-1)
+        bucket = jnp.clip((pos_score * num_thresholds).astype(jnp.int32),
+                          0, num_thresholds)
+        pos = (lbl > 0).astype(jnp.float32)
+        bp = jnp.zeros((nb,), jnp.float32).at[bucket].add(pos)
+        bn = jnp.zeros((nb,), jnp.float32).at[bucket].add(1.0 - pos)
+
+        def _auc(p, n):
+            # sweep thresholds high->low accumulating TP/FP trapezoids
+            tp = jnp.cumsum(p[::-1])
+            fp = jnp.cumsum(n[::-1])
+            tot_p = jnp.maximum(tp[-1], 1e-12)
+            tot_n = jnp.maximum(fp[-1], 1e-12)
+            tpr = tp / tot_p
+            fpr = fp / tot_n
+            tpr0 = jnp.concatenate([jnp.zeros((1,)), tpr[:-1]])
+            fpr0 = jnp.concatenate([jnp.zeros((1,)), fpr[:-1]])
+            return jnp.sum((fpr - fpr0) * (tpr + tpr0) / 2.0)
+
+        sp_new = sp + bp
+        sn_new = sn + bn
+        return _auc(sp_new, sn_new), _auc(bp, bn), sp_new, sn_new
+
+    out = apply_op(f, input, label, stat_pos, stat_neg, op_name="auc")
+    auc_val, batch_auc, sp_new, sn_new = out
+    # streaming state: carry forward eagerly; under a recorded program the
+    # buffer-update hook replays the accumulation every Executor.run
+    prog = current_program()
+    if prog is not None:
+        prog.register_buffer_update(stat_pos, sp_new,
+                                    lambda old, new: new)
+        prog.register_buffer_update(stat_neg, sn_new,
+                                    lambda old, new: new)
+    else:
+        stat_pos._data = sp_new._data
+        stat_neg._data = sn_new._data
+    return auc_val, batch_auc, [stat_pos, stat_neg]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """ref: static.ctr_metric_bundle — (auc, sqrerr, abserr, prob, q,
+    pos, total) used by CTR jobs. Returns the locally computable subset
+    with the same ordering contract."""
+    import jax.numpy as jnp
+    from ..core.autograd import apply_op
+
+    auc_val, _, stats = auc(input, label)
+
+    def f(scores, lbl):
+        p = scores[:, 1] if scores.ndim == 2 and scores.shape[1] >= 2 \
+            else scores.reshape(-1)
+        y = (lbl.reshape(-1) > 0).astype(jnp.float32)
+        sqrerr = jnp.sum((p - y) ** 2)
+        abserr = jnp.sum(jnp.abs(p - y))
+        prob = jnp.sum(p)
+        q = jnp.sum(p * p)
+        pos = jnp.sum(y)
+        total = jnp.float32(y.shape[0])
+        return sqrerr, abserr, prob, q, pos, total
+
+    sqrerr, abserr, prob, q, pos, total = apply_op(
+        f, input, label, op_name="ctr_metric_bundle")
+    return auc_val, sqrerr, abserr, prob, q, pos, total
+
+
+# -- gradients ------------------------------------------------------------
+
+def _make_grad_handle(prog: Program, targets, wrt_spec, like: Tensor,
+                      name: str):
+    import jax.numpy as jnp
+    h = Tensor(jnp.zeros_like(like._data))
+    h.stop_gradient = True
+    h.name = name
+    prog._grad_handles[id(h)] = (targets, wrt_spec)
+    # keep the handle alive with the program
+    prog._produced.setdefault(id(h), h)
+    return h
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """ref: static.append_backward — add the backward pass for ``loss``
+    and return [(param, grad_var), ...]. The grad vars are fetchable from
+    Executor.run; they resolve by differentiating the pure replay
+    (executor._grad_fetches), the record/replay analog of appending grad
+    ops to the ProgramDesc."""
+    prog = current_program() or default_main_program()
+    if parameter_list is None:
+        parameter_list = prog.parameters()
+    no_grad = set(id(t) for t in (no_grad_set or ()))
+    targets = ((id(loss), None),)
+    out = []
+    for p in parameter_list:
+        if id(p) in no_grad or p.stop_gradient:
+            continue
+        slot = prog._refs.get(id(p))
+        if slot is None:
+            slot = prog._ref_slot(p)
+        h = _make_grad_handle(prog, targets, ("ref", slot), p,
+                              f"{getattr(p, 'name', 'param')}@GRAD")
+        out.append((p, h))
+    prog._loss = loss
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """ref: static.gradients — d(sum targets)/d(inputs) as fetchable
+    vars. ``target_gradients`` weights each target (implicit ones when
+    None), matching the reference's output_grads contract."""
+    prog = current_program() or default_main_program()
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    tgs = target_gradients if target_gradients is not None else \
+        [None] * len(ts)
+    if not isinstance(tgs, (list, tuple)):
+        tgs = [tgs]
+    if len(tgs) != len(ts):
+        raise ValueError(
+            f"gradients: target_gradients has {len(tgs)} entries for "
+            f"{len(ts)} targets — they must pair 1:1 (pass None entries "
+            f"for implicit ones)")
+    tspecs = []
+    for t, tg in zip(ts, tgs):
+        tg_spec = None
+        if tg is not None:
+            tg_spec = prog._spec_for(tg)
+        tspecs.append((id(t), tg_spec))
+    tspecs = tuple(tspecs)
+    out = []
+    for x in ins:
+        spec = prog._spec_for(x)
+        out.append(_make_grad_handle(
+            prog, tspecs, spec, x, f"{getattr(x, 'name', 'x')}@GRAD"))
+    return out
+
+
+# -- variable / parameter creation ---------------------------------------
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """ref: static.create_global_var — a persistent filled var registered
+    with the startup program semantics (initialized now, referenced by
+    the main program through its live Tensor)."""
+    import jax.numpy as jnp
+    from ..core.dtype import convert_dtype
+    t = Tensor(jnp.full(tuple(shape), value, convert_dtype(dtype)))
+    t.stop_gradient = True
+    t.name = name or f"global_var_{id(t):x}"
+    # persistable/force_cpu are ProgramDesc attributes in the reference;
+    # a live Tensor is inherently persistent here (Tensor uses __slots__,
+    # so the flag is not carried)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref: static.create_parameter. A WeightNormParamAttr attr applies
+    the g*v/||v|| reparameterization eagerly (dim per the attr)."""
+    import jax.numpy as jnp
+    from ..core.dtype import convert_dtype
+    from ..nn import initializer as I
+
+    init = default_initializer
+    if init is None and attr is not None and \
+            getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    dt = convert_dtype(dtype)
+    data = init(tuple(shape), dt)
+    data = data._data if isinstance(data, Tensor) else jnp.asarray(data, dt)
+    p = Parameter(data)
+    p.name = name or (getattr(attr, "name", None) or
+                      f"param_{id(p):x}")
+    if isinstance(attr, WeightNormParamAttr):
+        # the train-time g*v/||v|| reparameterization needs two trainable
+        # tensors; that transform lives in nn.utils.weight_norm — apply
+        # it to the layer holding this parameter. At creation the weight
+        # value itself is unchanged (g initialises to ||v||).
+        p._weight_norm_dim = attr.dim
+    return p
+
+
+# -- places / scopes / guards --------------------------------------------
+
+def cpu_places(device_count=None):
+    """ref: static.cpu_places. Count defaults to CPU_NUM (1)."""
+    from ..core.device import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """ref: static.cuda_places — the accelerator places. This build's
+    accelerator is TPU; the name is kept for source compatibility and
+    returns the TPU places (there is no CUDA device to return)."""
+    import jax
+    from ..core.device import Place
+    if device_ids is None:
+        device_ids = range(len(jax.devices()))
+    return [Place("tpu", i) for i in device_ids]
+
+
+def xpu_places(device_ids=None):
+    raise NotImplementedError(
+        "xpu_places: the XPU backend is a documented exclusion of the "
+        "TPU build (SURVEY.md non-goals); use cpu_places/cuda_places")
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """ref: static.device_guard — pin ops in the block to a device. The
+    compiled replay runs on the default backend; 'cpu' pins via
+    jax.default_device so host-side ops (e.g. big embedding inits) stay
+    off-chip."""
+    import jax
+    if device is None:
+        yield
+        return
+    kind = device.split(":")[0]
+    if kind == "gpu":
+        kind = "tpu"  # the accelerator of this build
+    devs = [d for d in jax.devices(kind)] if kind != "cpu" else \
+        jax.devices("cpu")
+    with jax.default_device(devs[0]):
+        yield
+
+
+_name_scope_stack = threading.local()
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """ref: static.name_scope — hierarchical op-name prefix, visible in
+    recorded op names (Program introspection / profiler labels)."""
+    stack = getattr(_name_scope_stack, "stack", None)
+    if stack is None:
+        stack = _name_scope_stack.stack = []
+    stack.append(prefix or "scope")
+    try:
+        yield "/".join(stack)
+    finally:
+        stack.pop()
+
+
+def current_name_scope() -> str:
+    stack = getattr(_name_scope_stack, "stack", None) or []
+    return "/".join(stack)
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """ref: static.scope_guard — swap the global variable Scope."""
+    from . import executor as ex
+    old = ex._GLOBAL_SCOPE
+    ex._GLOBAL_SCOPE = scope
+    try:
+        yield
+    finally:
+        ex._GLOBAL_SCOPE = old
+
+
+# -- program/params persistence ------------------------------------------
+
+def _prog_state(program: Program) -> dict:
+    state = {}
+    for i, t in enumerate(program._ref_tensors):
+        name = getattr(t, "name", None) or f"ref_{i}"
+        state[name] = np.asarray(t._data)
+    return state
+
+
+def save(program: Program, model_path: str, protocol=4, **configs):
+    """ref: static.save — persist the program's persistables
+    (params + buffers) as <path>.pdparams (np archive)."""
+    program = getattr(program, "program", program)  # CompiledProgram
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    np.savez(model_path + ".pdparams", **_prog_state(program))
+
+
+def load(program: Program, model_path: str, executor=None, var_names=None):
+    """ref: static.load — restore persistables saved by static.save."""
+    program = getattr(program, "program", program)
+    set_program_state(program, load_program_state(model_path),
+                      var_names=var_names)
+
+
+def load_program_state(model_path: str, var_list=None) -> dict:
+    path = model_path + ".pdparams" if not model_path.endswith(".npz") \
+        else model_path
+    if not os.path.exists(path):
+        path = model_path + ".pdparams.npz"
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def set_program_state(program: Program, state_dict: dict, var_names=None):
+    import jax.numpy as jnp
+    program = getattr(program, "program", program)
+    by_name = {}
+    for i, t in enumerate(program._ref_tensors):
+        by_name[getattr(t, "name", None) or f"ref_{i}"] = t
+    for name, arr in state_dict.items():
+        if var_names is not None and name not in var_names:
+            continue
+        t = by_name.get(name)
+        if t is None:
+            continue
+        if tuple(t._data.shape) != tuple(arr.shape):
+            raise ValueError(
+                f"shape mismatch for {name}: program has "
+                f"{tuple(t._data.shape)}, state has {tuple(arr.shape)}")
+        t._data = jnp.asarray(arr, t._data.dtype)
+
+
+def save_to_file(path: str, content: bytes):
+    """ref: static.save_to_file — raw bytes to disk."""
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs
+                      ) -> bytes:
+    """ref: static.serialize_program — the program as portable bytes.
+    Record/replay tapes hold python closures, so the portable form is the
+    jax.export serialization of the pruned replay (StableHLO): loadable
+    without the recording process. Shapes are those of the recorded
+    feeds."""
+    import jax
+    import pickle
+    from .executor import _replay
+
+    prog = program or default_main_program()
+    prog = getattr(prog, "program", prog)
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else \
+        [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else \
+        [fetch_vars]
+    names = [t._static_feed_name for t in feed_vars]
+    ref_vals = [t._data for t in prog._ref_tensors]
+    n_rng = prog._rng_count
+
+    def fn(*feeds):
+        import jax.numpy as jnp
+        feed_map = dict(zip(names, feeds))
+        keys = [jax.random.PRNGKey(0)] * n_rng
+        env = _replay(prog, feed_map, ref_vals, keys)
+        return tuple(env[id(t)] for t in fetch_vars)
+
+    args = [jax.ShapeDtypeStruct(tuple(t.shape), t._data.dtype)
+            for t in feed_vars]
+    exported = jax.export.export(jax.jit(fn))(*args)
+    return pickle.dumps({"stablehlo": exported.serialize(),
+                         "feed_names": names})
+
+
+def deserialize_program(data: bytes):
+    """ref: static.deserialize_program — rebuild a runnable program-like
+    object from serialize_program bytes. Returns an object Executor.run
+    accepts (carries its own compiled callable)."""
+    import jax
+    import pickle
+    payload = pickle.loads(data)
+    exported = jax.export.deserialize(payload["stablehlo"])
+    names = payload["feed_names"]
+
+    class _Deserialized:
+        _exported_call = True
+
+        def run(self, feed=None, fetch_list=None, return_numpy=True):
+            import jax.numpy as jnp
+            feed = feed or {}
+            args = [jnp.asarray(np.asarray(feed[n])) for n in names]
+            outs = exported.call(*args)
+            if return_numpy:
+                outs = [np.asarray(o) for o in outs]
+            return list(outs)
+
+    return _Deserialized()
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs
+                           ) -> bytes:
+    """ref: static.serialize_persistables — params/buffers as bytes."""
+    import pickle
+    prog = program or default_main_program()
+    prog = getattr(prog, "program", prog)
+    return pickle.dumps(_prog_state(prog))
+
+
+def deserialize_persistables(program: Program, data: bytes, executor=None):
+    import pickle
+    set_program_state(getattr(program, "program", program),
+                      pickle.loads(data))
+
+
+def normalize_program(program: Program, feed_vars, fetch_vars, **kwargs
+                      ) -> Program:
+    """ref: static.normalize_program — prune to the ops reachable from
+    fetch_vars (the inference-export subgraph). Real reachability pass
+    over the tape: ops whose outputs never flow into a fetch are
+    dropped."""
+    program = getattr(program, "program", program)
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else \
+        [fetch_vars]
+    needed = set(id(t) for t in fetch_vars)
+    kept = []
+    for op in reversed(program.ops):
+        produces = [oid for oid in op.out_ids if oid is not None]
+        if any(oid in needed for oid in produces):
+            kept.append(op)
+            for spec in op.arg_specs:
+                if spec[0] == "var":
+                    needed.add(spec[1])
+    kept.reverse()
+    out = Program()
+    out.ops = kept
+    out.feeds = dict(program.feeds)
+    out._produced = {oid: program._produced[oid] for op in kept
+                     for oid in op.out_ids
+                     if oid is not None and oid in program._produced}
+    out._refs = dict(program._refs)
+    out._ref_tensors = list(program._ref_tensors)
+    out._rng_count = program._rng_count
+    out.version = program.version
+    return out
+
+
+# -- IPU: documented exclusions ------------------------------------------
+
+def _no_ipu(*a, **k):
+    raise NotImplementedError(
+        "IPU support is a documented capability exclusion of the "
+        "TPU-native build (no Graphcore backend); see SURVEY.md non-goals")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+def ipu_shard_guard(*a, **k):
+    _no_ipu()
+
+
+def set_ipu_shard(*a, **k):
+    _no_ipu()

@@ -61,6 +61,12 @@ class Program:
         # ops (dropout masks...), refreshed with fresh keys on every
         # Executor.run so replays don't reuse the record-time randomness
         self._rng_count = 0
+        # fetchable gradient handles (append_backward / gradients):
+        # id(handle Tensor) -> (targets, wrt_spec) where targets is a
+        # tuple of (target_tensor_id, tg_spec_or_None) and wrt_spec is a
+        # replay arg spec ("ref", slot) / ("feed", name) / ("var", id).
+        # The Executor differentiates the pure replay to resolve them.
+        self._grad_handles: Dict[int, tuple] = {}
 
     # -- recording ----------------------------------------------------------
     def _ref_slot(self, t: Tensor) -> int:

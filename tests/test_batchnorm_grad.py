@@ -67,3 +67,24 @@ def test_deep_preact_stack_grads_bounded():
     gm = max(float(np.abs(np.asarray(p.grad._data)).max())
              for p in m.parameters() if p.grad is not None)
     assert gm < 1e3, f"gradient explosion through BN stack: max|g|={gm:.3e}"
+
+
+def test_bn_uncentered_input_variance_stable():
+    """Training BN on data with |mean| >> sigma must still normalize
+    correctly: the one-pass E[x^2]-m^2 variance cancels in f32 at
+    mean ~3000 and trained on garbage (review regression)."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal((8, 16, 4, 4)) + 3000.0).astype(np.float32)
+    rm = paddle.to_tensor(np.zeros(16, np.float32))
+    rv = paddle.to_tensor(np.ones(16, np.float32))
+    w = paddle.to_tensor(np.ones(16, np.float32))
+    b = paddle.to_tensor(np.zeros(16, np.float32))
+    y = F.batch_norm(paddle.to_tensor(x), rm, rv, w, b,
+                     training=True).numpy()
+    ref = (x - x.mean(axis=(0, 2, 3), keepdims=True)) / \
+        x.std(axis=(0, 2, 3), keepdims=True)
+    assert np.abs(y - ref).max() < 2e-2
+    # running var must be ~1, not garbage
+    np.testing.assert_allclose(rv.numpy(), 1.0, atol=0.2)

@@ -1,0 +1,252 @@
+"""Tests for the paddle.static facade long tail (static/extras.py):
+gradient machinery over the replay (append_backward/gradients), metrics,
+EMA, py_func, persistence, pruning (ref: python/paddle/static/__init__.py
+__all__)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture
+def prog_pair():
+    main, startup = static.Program(), static.Program()
+    return main, startup
+
+
+class TestGradientMachinery:
+    def test_append_backward_grads_fetchable(self, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            w = static.create_parameter([3, 2], "float32")
+            w.name = "w0"
+            y = paddle.matmul(x, w)
+            loss = (y * y).mean()
+            pgs = static.append_backward(loss)
+        assert len(pgs) == 1
+        exe = static.Executor()
+        xv = np.random.default_rng(0).standard_normal((4, 3)).astype(
+            np.float32)
+        out = exe.run(main, feed={"x": xv},
+                      fetch_list=[loss, pgs[0][1]])
+        loss_v, gw = out
+        # oracle: d mean((xw)^2) / dw = 2 x^T (xw) / numel
+        wv = np.asarray(w.numpy(), np.float64)
+        yv = xv.astype(np.float64) @ wv
+        exp = 2.0 * xv.astype(np.float64).T @ yv / yv.size
+        np.testing.assert_allclose(gw, exp, rtol=1e-5)
+        np.testing.assert_allclose(loss_v, (yv * yv).mean(), rtol=1e-5)
+
+    def test_gradients_wrt_feed_input(self, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [5], "float32")
+            y = (x * x).sum()
+            (gx,) = static.gradients(y, x)
+        exe = static.Executor()
+        xv = np.arange(5, dtype=np.float32)
+        (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+        np.testing.assert_allclose(g, 2 * xv, rtol=1e-6)
+
+    def test_gradients_with_target_gradients(self, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [3], "float32")
+            t = x * 2.0
+            tg = paddle.to_tensor(np.asarray([1.0, 0.0, 3.0], np.float32))
+            (gx,) = static.gradients([t], [x], target_gradients=[tg])
+        exe = static.Executor()
+        (g,) = exe.run(main, feed={"x": np.ones(3, np.float32)},
+                       fetch_list=[gx])
+        np.testing.assert_allclose(g, [2.0, 0.0, 6.0], rtol=1e-6)
+
+    def test_gradients_length_mismatch_raises(self, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [3], "float32")
+            t1, t2 = x * 2.0, x * 3.0
+            tg = paddle.to_tensor(np.ones(3, np.float32))
+            with pytest.raises(ValueError, match="1:1"):
+                static.gradients([t1, t2], [x], target_gradients=[tg])
+
+
+class TestMetricsAndOps:
+    def test_accuracy(self):
+        scores = paddle.to_tensor(np.asarray(
+            [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32))
+        label = paddle.to_tensor(np.asarray([1, 0, 0], np.int64))
+        acc = static.accuracy(scores, label, k=1)
+        np.testing.assert_allclose(float(acc), 2.0 / 3.0, rtol=1e-6)
+
+    def test_auc_perfect_separation(self):
+        scores = paddle.to_tensor(np.asarray(
+            [[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]], np.float32))
+        label = paddle.to_tensor(np.asarray([0, 0, 1, 1], np.int64))
+        auc_v, batch_auc, stats = static.auc(scores, label)
+        assert float(auc_v) > 0.99
+        assert len(stats) == 2
+
+    def test_ctr_metric_bundle(self):
+        scores = paddle.to_tensor(np.asarray(
+            [[0.4, 0.6], [0.7, 0.3]], np.float32))
+        label = paddle.to_tensor(np.asarray([1, 0], np.int64))
+        vals = static.ctr_metric_bundle(scores, label)
+        assert len(vals) == 7
+        np.testing.assert_allclose(float(vals[6]), 2.0)  # total
+        np.testing.assert_allclose(float(vals[5]), 1.0)  # positives
+
+    def test_py_func_forward_and_backward(self):
+        x = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        out_t = paddle.to_tensor(np.zeros(3, np.float32))
+        y = static.py_func(lambda a: a * 2.0, x, out_t,
+                           backward_func=lambda a, g: g * 2.0)
+        np.testing.assert_allclose(y.numpy(), [2.0, 4.0, 6.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0, 2.0])
+
+    def test_print_is_identity(self, capsys):
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        y = static.Print(x, message="dbg")
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+
+
+class TestEMA:
+    def test_update_apply_restore(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        ema = static.ExponentialMovingAverage(decay=0.5)
+        w0 = lin.weight.numpy().copy()
+        ema.update(lin.parameters())
+        lin.weight.set_value(paddle.to_tensor(w0 * 3.0))
+        ema.update(lin.parameters())
+        with ema.apply():
+            # shadow after 2 steps: .5*(.5*w0+.5*w0) + ... bias-corrected
+            applied = lin.weight.numpy().copy()
+            assert not np.allclose(applied, w0 * 3.0)
+        np.testing.assert_allclose(lin.weight.numpy(), w0 * 3.0,
+                                   rtol=1e-6)
+
+
+class TestPersistence:
+    def test_save_load_roundtrip(self, tmp_path, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3], "float32")
+            w = static.create_parameter([3, 2], "float32")
+            w.name = "w_rt"
+            y = paddle.matmul(x, w)
+        path = str(tmp_path / "m")
+        static.save(main, path)
+        orig = w.numpy().copy()
+        w.set_value(paddle.to_tensor(np.zeros((3, 2), np.float32)))
+        static.load(main, path)
+        np.testing.assert_allclose(w.numpy(), orig)
+
+    def test_program_state_roundtrip(self, tmp_path, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            w = static.create_parameter([2], "float32")
+            w.name = "w_ps"
+            y = (x * w).sum()
+        static.save(main, str(tmp_path / "st"))
+        state = static.load_program_state(str(tmp_path / "st"))
+        assert "w_ps" in state
+        state["w_ps"] = state["w_ps"] + 1.0
+        static.set_program_state(main, state)
+        np.testing.assert_allclose(
+            w.numpy(), np.asarray(state["w_ps"]), rtol=1e-6)
+
+    def test_save_load_file_bytes(self, tmp_path):
+        p = str(tmp_path / "blob.bin")
+        static.save_to_file(p, b"abc123")
+        assert static.load_from_file(p) == b"abc123"
+
+    def test_serialize_deserialize_program(self, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [4], "float32")
+            y = x * 2.0 + 1.0
+        data = static.serialize_program([x], [y], program=main)
+        assert isinstance(data, bytes)
+        prog2 = static.deserialize_program(data)
+        exe = static.Executor()
+        out = exe.run(prog2, feed={"x": np.ones(4, np.float32)},
+                      fetch_list=None)
+        np.testing.assert_allclose(out[0], 3.0 * np.ones(4), rtol=1e-6)
+
+    def test_serialize_persistables_roundtrip(self, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            w = static.create_parameter([2], "float32")
+            w.name = "w_sp"
+            y = x * w
+        blob = static.serialize_persistables([x], [y], program=main)
+        orig = w.numpy().copy()
+        w.set_value(paddle.to_tensor(np.zeros(2, np.float32)))
+        static.deserialize_persistables(main, blob)
+        np.testing.assert_allclose(w.numpy(), orig)
+
+
+class TestProgramUtils:
+    def test_normalize_program_prunes(self, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [3], "float32")
+            y = x * 2.0
+            z = x + 10.0  # dead wrt fetch y
+            dead = z * z
+        pruned = static.normalize_program(main, [x], [y])
+        assert len(pruned.ops) < len(main.ops)
+        exe = static.Executor()
+        (out,) = exe.run(pruned, feed={"x": np.ones(3, np.float32)},
+                         fetch_list=[y])
+        np.testing.assert_allclose(out, 2.0 * np.ones(3))
+
+    def test_compiled_program_wraps(self, prog_pair):
+        main, startup = prog_pair
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            y = x + 1.0
+        cp = static.CompiledProgram(main,
+                                    build_strategy=static.BuildStrategy())
+        exe = static.Executor()
+        (out,) = exe.run(cp.program, feed={"x": np.zeros(2, np.float32)},
+                         fetch_list=[y])
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_variable_alias_and_places(self):
+        assert static.Variable is paddle.Tensor
+        assert len(static.cpu_places(2)) == 2
+        assert len(static.cuda_places()) >= 1
+        with pytest.raises(NotImplementedError):
+            static.xpu_places()
+        with pytest.raises(NotImplementedError):
+            static.IpuStrategy()
+
+    def test_name_scope_nests(self):
+        with static.name_scope("a"):
+            with static.name_scope("b") as full:
+                assert full == "a/b"
+
+    def test_scope_guard(self):
+        from paddle_tpu.static.executor import _Scope
+        s = _Scope()
+        with static.scope_guard(s):
+            assert static.global_scope() is s
+        assert static.global_scope() is not s
+
+    def test_device_guard_cpu(self):
+        with static.device_guard("cpu"):
+            t = paddle.to_tensor(np.ones(2, np.float32))
+        assert np.allclose(t.numpy(), 1.0)
+
+    def test_create_global_var(self):
+        v = static.create_global_var([2, 2], 3.5, "float32",
+                                     persistable=True)
+        np.testing.assert_allclose(v.numpy(), 3.5)

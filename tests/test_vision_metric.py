@@ -36,6 +36,34 @@ def test_resnet_state_dict_structure():
     assert "fc.weight" in sd
 
 
+def test_resnet_nhwc_matches_nchw():
+    """data_format='NHWC' (the TPU-native conv layout used by bench.py)
+    must be numerically identical to NCHW — same weights, transposed
+    input/activations only."""
+    x = np.random.default_rng(0).standard_normal((2, 3, 64, 64)).astype(
+        np.float32)
+    paddle.seed(0)
+    m1 = resnet18(num_classes=10)
+    m1.eval()
+    paddle.seed(0)
+    m2 = resnet18(num_classes=10, data_format="NHWC")
+    m2.eval()
+    o1 = m1(paddle.to_tensor(x)).numpy()
+    o2 = m2(paddle.to_tensor(np.transpose(x, (0, 2, 3, 1)))).numpy()
+    np.testing.assert_allclose(o1, o2, atol=2e-4)
+    # NHWC state dict keys/shapes identical (weights stay OIHW)
+    assert {k: tuple(v.shape) for k, v in m1.state_dict().items()} == \
+        {k: tuple(v.shape) for k, v in m2.state_dict().items()}
+    # train-mode fwd/bwd works and running stats update
+    m2.train()
+    before = m2.bn1._mean.numpy().copy()
+    out = m2(paddle.to_tensor(np.transpose(x, (0, 2, 3, 1))))
+    (out ** 2).mean().backward()
+    assert m2.conv1.weight.grad is not None
+    assert np.isfinite(m2.conv1.weight.grad.numpy()).all()
+    assert not np.array_equal(before, m2.bn1._mean.numpy())
+
+
 @pytest.mark.slow
 def test_mobilenet_vgg_forward():
     x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
