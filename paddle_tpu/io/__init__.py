@@ -4,7 +4,8 @@ from .dataset import (  # noqa: F401
     ConcatDataset, Subset, random_split,
 )
 from .sampler import (  # noqa: F401
-    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
-    BatchSampler, DistributedBatchSampler,
+    Sampler, SequenceSampler, RandomSampler, SubsetRandomSampler,
+    WeightedRandomSampler, BatchSampler, DistributedBatchSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .worker import WorkerInfo, get_worker_info  # noqa: F401

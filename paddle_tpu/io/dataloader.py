@@ -1,15 +1,16 @@
-"""DataLoader: host input pipeline with background prefetch.
+"""DataLoader: host input pipeline with worker processes.
 
 ref: python/paddle/io/dataloader/dataloader_iter.py (single/multi-process
-iterators) + worker.py shared-memory loop. TPU-native shape: the device is
-fed from the host, so the pipeline is (a) index batches from a sampler,
-(b) a thread pool mapping dataset.__getitem__ + collate, (c) a bounded
-prefetch queue overlapping host work with device steps (the analog of the
-reference's pin-memory + worker processes; threads suffice because the work
-is numpy/IO which releases the GIL).
+iterators) + worker.py shared-memory loop. num_workers>0 forks worker
+PROCESSES (io/worker.py) that run dataset.__getitem__ + collate off the
+main process and off the GIL, shipping big arrays back through /dev/shm
+(the reference's mmap_allocator transport). A legacy in-process thread
+pool remains behind FLAGS_dataloader_use_threads for fork-hostile
+setups.
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -52,6 +53,23 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        if persistent_workers and num_workers > 0:
+            import warnings
+            warnings.warn(
+                "persistent_workers is not implemented: workers are "
+                "forked per epoch (fork is cheap on Linux; worker state "
+                "does not persist across epochs)", stacklevel=2)
+        # num_workers>0 => worker PROCESSES (the reference contract);
+        # transforms must be fork-safe numpy/IO — don't return device
+        # Tensors from dataset.__getitem__ under workers. The env flag
+        # forces the legacy in-process thread pool.
+        self._use_processes = (num_workers > 0 and hasattr(os, "fork")
+                               and not os.environ.get(
+                                   "FLAGS_dataloader_use_threads"))
         from .dataset import IterableDataset
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -81,6 +99,9 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def __iter__(self):
+        if self.num_workers > 0 and self._use_processes:
+            yield from self._iter_multiprocess()
+            return
         if self._iterable:
             yield from self._iter_iterable()
             return
@@ -90,6 +111,172 @@ class DataLoader:
                 yield self.collate_fn(samples)
             return
         yield from self._iter_prefetch()
+
+    def _iter_multiprocess(self):
+        """Worker PROCESSES + shared-memory transport (ref:
+        dataloader_iter.py _DataLoaderIterMultiProcess :370 + worker.py
+        _worker_loop :281 + mmap_allocator.cc). At most
+        prefetch_factor * num_workers index batches are in flight (a
+        consumed result refills the worker that produced it); results are
+        re-ordered to sampler order. Workers are forked so transforms run
+        off the main process and off the GIL — fork of a JAX-threaded
+        parent is the same documented tradeoff the reference/torch take
+        on Linux; set FLAGS_dataloader_use_threads=1 if a fork ever
+        misbehaves in your setup. Worker death (even SIGKILL, which
+        sends no 'end') is detected by a liveness poll instead of
+        hanging."""
+        import multiprocessing as mp
+        import queue as queue_mod
+
+        from .worker import _decode, _release_shm, _worker_loop
+
+        ctx = mp.get_context("fork" if hasattr(os, "fork") else "spawn")
+        result_q = ctx.Queue()
+        base_seed = int(np.random.randint(0, 2 ** 31 - 1))
+        workers, index_qs = [], []
+        iterable = self._iterable
+        # per-run /dev/shm directory: segments are unlinked as decoded,
+        # and the whole dir is removed at teardown so early exit or a
+        # worker killed mid-handoff cannot leak tmpfs (RAM) files
+        from .worker import _shm_ok
+        shm_dir = None
+        if self.use_shared_memory and _shm_ok():
+            import tempfile
+            shm_dir = tempfile.mkdtemp(dir="/dev/shm", prefix="ptpu_dl_")
+        timeout = self.timeout if self.timeout and self.timeout > 0 \
+            else None
+        poll = min(timeout, 5.0) if timeout else 5.0
+
+        ended = set()  # worker ids that posted their 'end' sentinel
+
+        def get_result():
+            """Queue get with liveness detection and a descriptive
+            timeout error instead of a bare queue.Empty. A worker that
+            posted 'end' is allowed to be gone; one that vanished without
+            it (SIGKILL/OOM) means lost batches."""
+            waited = 0.0
+            while True:
+                try:
+                    return result_q.get(timeout=poll)
+                except queue_mod.Empty:
+                    waited += poll
+                    dead = [w.name for i, w in enumerate(workers)
+                            if not w.is_alive() and i not in ended]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker(s) {dead} died without "
+                            f"reporting (killed? OOM?) — batches are "
+                            f"lost") from None
+                    if timeout and waited >= timeout:
+                        raise RuntimeError(
+                            f"DataLoader timed out after {timeout}s "
+                            f"waiting for a worker batch") from None
+
+        try:
+            for wid in range(self.num_workers):
+                iq = None if iterable else ctx.Queue()
+                index_qs.append(iq)
+                w = ctx.Process(
+                    target=_worker_loop,
+                    args=(self.dataset, self.collate_fn, iq, result_q,
+                          wid, self.num_workers, base_seed,
+                          self.worker_init_fn, shm_dir,
+                          iterable, self.batch_size
+                          if iterable else 0, self.drop_last
+                          if iterable else False),
+                    daemon=True)
+                w.start()
+                workers.append(w)
+
+            if iterable:
+                # arrival order; each worker streams its own shard
+                live = self.num_workers
+                while live:
+                    msg = get_result()
+                    if msg[0] == "end":
+                        ended.add(msg[1])
+                        live -= 1
+                    elif msg[0] == "error":
+                        raise RuntimeError(
+                            f"DataLoader worker {msg[1]} failed:\n"
+                            f"{msg[2]}")
+                    else:
+                        yield _decode(msg[1])
+                return
+
+            # map-style: bounded dispatch — initial round-robin window,
+            # then refill the worker that returned a result (adaptively
+            # balances slow workers); re-order results to sampler order
+            sampler_it = enumerate(iter(self.batch_sampler))
+            window = self.prefetch_factor * self.num_workers
+            n_sent = 0
+            exhausted = False
+            owner = {}  # batch idx -> worker id
+
+            def send_next(wid):
+                nonlocal n_sent, exhausted
+                if exhausted:
+                    return False
+                try:
+                    bidx, idx_batch = next(sampler_it)
+                except StopIteration:
+                    exhausted = True
+                    for iq in index_qs:
+                        iq.put(None)
+                    return False
+                index_qs[wid].put((bidx, list(idx_batch)))
+                owner[bidx] = wid
+                n_sent += 1
+                return True
+
+            for i in range(window):
+                if not send_next(i % self.num_workers):
+                    break
+            buf, next_idx, received = {}, 0, 0
+            live = self.num_workers
+            while not exhausted or next_idx < n_sent:
+                if next_idx in buf:
+                    yield buf.pop(next_idx)
+                    next_idx += 1
+                    continue
+                if received >= n_sent and exhausted:
+                    break  # nothing further can arrive
+                msg = get_result()
+                if msg[0] == "end":
+                    ended.add(msg[1])
+                    live -= 1
+                    if live == 0 and (not exhausted or
+                                      received < n_sent):
+                        raise RuntimeError(
+                            "DataLoader workers exited before producing "
+                            "all batches")
+                    continue
+                if msg[0] == "error":
+                    raise RuntimeError(
+                        f"DataLoader worker {msg[1]} failed:\n{msg[2]}")
+                bidx, data = msg
+                received += 1
+                buf[bidx] = _decode(data)
+                send_next(owner.pop(bidx))
+        finally:
+            for w in workers:
+                if w.is_alive():
+                    w.terminate()
+            for w in workers:
+                w.join(timeout=5)
+            # drain undecoded results (unlinks their segments), then
+            # remove the per-run dir — catches even segments whose queue
+            # message never landed (worker killed mid-put)
+            while True:
+                try:
+                    msg = result_q.get_nowait()
+                except Exception:
+                    break
+                if msg and msg[0] not in ("end", "error"):
+                    _release_shm(msg[-1])
+            if shm_dir is not None:
+                import shutil
+                shutil.rmtree(shm_dir, ignore_errors=True)
 
     def _iter_prefetch(self):
         """Thread-pool fetch + bounded queue prefetch."""

@@ -42,6 +42,22 @@ class RandomSampler(Sampler):
         return self.num_samples
 
 
+class SubsetRandomSampler(Sampler):
+    """ref: io/sampler.py SubsetRandomSampler — random permutation of a
+    caller-supplied index subset (train/val splits over one dataset)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+        self._rng = np.random.default_rng()
+
+    def __iter__(self):
+        order = self._rng.permutation(len(self.indices))
+        return iter(self.indices[i] for i in order)
+
+    def __len__(self):
+        return len(self.indices)
+
+
 class WeightedRandomSampler(Sampler):
     def __init__(self, weights, num_samples, replacement=True):
         self.weights = np.asarray(weights, np.float64)

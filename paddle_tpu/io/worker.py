@@ -1,0 +1,188 @@
+"""DataLoader worker processes + shared-memory transport.
+
+ref: python/paddle/io/dataloader/worker.py (_worker_loop :281,
+WorkerInfo/get_worker_info) and paddle/phi/core/memory/allocation/
+mmap_allocator.cc (shared-memory sample transport). TPU-native shape:
+workers are forked CPU processes running dataset.__getitem__ + collate
+(pure numpy/IO — JAX/device state stays in the parent); big arrays
+travel through /dev/shm memmap files instead of the queue pipe, sidestepping
+both pickling-through-pipe copies and the multiprocessing.shared_memory
+resource-tracker cross-process warts. The parent reads then unlinks each
+file, so segment lifetime is one batch.
+"""
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+
+import numpy as np
+
+__all__ = ["WorkerInfo", "get_worker_info"]
+
+_SHM_DIR = "/dev/shm"
+_SHM_MIN_BYTES = 16 * 1024  # below this, pipe pickling is cheaper
+
+
+class WorkerInfo:
+    """ref: io/dataloader/worker.py WorkerInfo — read-only description of
+    the calling worker (None in the main process)."""
+
+    def __init__(self, id, num_workers, seed, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, num_workers={self.num_workers},"
+                f" seed={self.seed})")
+
+
+_worker_info: WorkerInfo | None = None
+
+
+def get_worker_info():
+    """ref: paddle.io.get_worker_info — the current worker's info inside
+    a DataLoader worker process, None in the main process. IterableDataset
+    shards itself with this (id/num_workers)."""
+    return _worker_info
+
+
+def _shm_ok():
+    return os.name == "posix" and os.path.isdir(_SHM_DIR)
+
+
+def _encode(obj, use_shm):
+    """Structure-preserving encode for the result queue: big ndarrays ->
+    /dev/shm memmap descriptors; Tensors -> tagged ndarrays (workers must
+    not touch device state, the parent re-wraps). ``use_shm`` is the
+    per-run segment DIRECTORY (or None): the parent rmtree's it at
+    iterator teardown, so a worker killed mid-handoff can't leak
+    segments."""
+    from ..core.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return ("__tensor__", _encode(np.asarray(obj._data), use_shm))
+    if isinstance(obj, np.ndarray):
+        if use_shm and obj.nbytes >= _SHM_MIN_BYTES:
+            fd, path = tempfile.mkstemp(dir=use_shm, prefix="ptpu_dl_")
+            os.close(fd)
+            mm = np.memmap(path, dtype=obj.dtype, mode="w+",
+                           shape=obj.shape if obj.shape else (1,))
+            mm[...] = obj if obj.shape else obj.reshape(1)
+            mm.flush()
+            del mm
+            return ("__shm__", path, str(obj.dtype), obj.shape)
+        return obj
+    if isinstance(obj, dict):
+        return {k: _encode(v, use_shm) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(_encode(v, use_shm) for v in obj)
+    if isinstance(obj, list):
+        return ["__list__"] + [_encode(v, use_shm) for v in obj]
+    return obj
+
+
+def _decode(obj):
+    from ..core.tensor import Tensor
+    tag = obj[0] if (isinstance(obj, tuple) and obj
+                     and isinstance(obj[0], str)) else None
+    if tag == "__tensor__":
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(_decode(obj[1])))
+    if tag == "__shm__":
+        _, path, dtype, shape = obj
+        mm = np.memmap(path, dtype=np.dtype(dtype), mode="r",
+                       shape=shape if shape else (1,))
+        arr = np.array(mm)  # own the data before the file goes away
+        del mm
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return arr if shape else arr.reshape(())
+    if isinstance(obj, dict):
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(_decode(v) for v in obj)
+    if isinstance(obj, list) and obj and isinstance(obj[0], str) and \
+            obj[0] == "__list__":
+        return [_decode(v) for v in obj[1:]]
+    return obj
+
+
+def _release_shm(obj):
+    """Unlink every /dev/shm segment referenced by an UNdecoded message
+    (early-exit / error cleanup — normally _decode unlinks on read)."""
+    tag = obj[0] if (isinstance(obj, tuple) and obj
+                     and isinstance(obj[0], str)) else None
+    if tag == "__shm__":
+        try:
+            os.unlink(obj[1])
+        except OSError:
+            pass
+        return
+    if tag == "__tensor__":
+        _release_shm(obj[1])
+        return
+    if isinstance(obj, dict):
+        for v in obj.values():
+            _release_shm(v)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _release_shm(v)
+
+
+def _seed_worker(worker_id, base_seed):
+    seed = (base_seed + worker_id) % (2 ** 31)
+    np.random.seed(seed)
+    random.seed(seed)
+    try:
+        from ..core import random as random_mod
+        random_mod.seed(seed)
+    except Exception:
+        pass
+    return seed
+
+
+def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
+                 num_workers, base_seed, worker_init_fn, use_shared_memory,
+                 iterable, batch_size, drop_last):
+    """ref: worker.py _worker_loop — consume index batches, emit collated
+    results, exit on the None sentinel. For IterableDataset the worker
+    iterates its own (get_worker_info-sharded) stream instead."""
+    global _worker_info
+    seed = _seed_worker(worker_id, base_seed)
+    _worker_info = WorkerInfo(worker_id, num_workers, seed, dataset)
+    # use_shared_memory arrives as the per-run /dev/shm directory path
+    # (already gated on _shm_ok by the parent) or None
+    use_shm = use_shared_memory
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if iterable:
+            batch = []
+            for sample in dataset:
+                batch.append(sample)
+                if len(batch) == batch_size:
+                    result_queue.put(
+                        ("data", _encode(collate_fn(batch), use_shm)))
+                    batch = []
+            if batch and not drop_last:
+                result_queue.put(
+                    ("data", _encode(collate_fn(batch), use_shm)))
+        else:
+            while True:
+                item = index_queue.get()
+                if item is None:
+                    break
+                bidx, idxs = item
+                data = collate_fn([dataset[i] for i in idxs])
+                result_queue.put((bidx, _encode(data, use_shm)))
+    except KeyboardInterrupt:
+        pass
+    except Exception:  # propagate the traceback, don't hang the parent
+        import traceback
+        result_queue.put(("error", worker_id, traceback.format_exc()))
+    finally:
+        result_queue.put(("end", worker_id))

@@ -8,5 +8,6 @@ step 3 calls for (replacing eager engine + PirInterpreter + CINN with one
 trace path).
 """
 from .api import to_static, functionalize, TrainStep, save, load, not_to_static  # noqa: F401
-from .api import ignore_module  # noqa: F401
+from .api import ignore_module, TranslatedLayer, enable_to_static  # noqa: F401
+from .api import set_code_level, set_verbosity  # noqa: F401
 from .sot import sot_compile, SOTFunction, BucketPolicy  # noqa: F401

@@ -161,6 +161,45 @@ class StaticFunction:
         return _tree_wrap(out)
 
 
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    """ref: jit/api.py enable_to_static — global kill-switch: with False,
+    to_static returns the function/layer untouched (pure eager), the
+    reference's debugging workflow for dy2static issues."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+_D2S_LOGGER_NAME = "paddle_tpu.jit.dy2static"
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """ref: jit/dy2static/logging_utils.py set_verbosity — verbosity of
+    the dy2static/SOT transform logs (0 silences, higher = chattier)."""
+    import logging
+    logger = logging.getLogger(_D2S_LOGGER_NAME)
+    logger.setLevel(logging.WARNING if level <= 0 else
+                    logging.INFO if level == 1 else logging.DEBUG)
+    if also_to_stdout and not logger.handlers:
+        import sys
+        logger.addHandler(logging.StreamHandler(sys.stdout))
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """ref: jit/dy2static/logging_utils.py set_code_level — how much
+    transformed code to log. The SOT tracer has no source transform to
+    print; at level>0 it logs each compiled trace's op count through the
+    same logger (the observable analog)."""
+    import logging
+    logger = logging.getLogger(_D2S_LOGGER_NAME + ".code")
+    logger.setLevel(logging.DEBUG if level > 0 else logging.WARNING)
+    if also_to_stdout and not logger.handlers:
+        import sys
+        logger.addHandler(logging.StreamHandler(sys.stdout))
+
+
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=False, bucket_policy=None, **kwargs):
     """ref: python/paddle/jit/api.py to_static.
@@ -177,6 +216,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     and RNG.
     """
     def decorate(fn):
+        if not _to_static_enabled:
+            return fn
         if full_graph:
             return StaticFunction(fn, input_spec, **kwargs)
         from .sot import SOTFunction
@@ -337,9 +378,51 @@ def save(layer, path, input_spec=None, **configs):
     save_inference_model(path, layer, input_spec=input_spec)
 
 
+class TranslatedLayer:
+    """ref: jit/translated_layer.py TranslatedLayer — the Layer-like
+    object jit.load returns when the saved model's Python class cannot
+    be imported in this process: forward runs the artifact's
+    AOT-exported (StableHLO) program with the saved params/buffers.
+    Built lazily over inference.Predictor's AOT path; construction is
+    via TranslatedLayer.load (or jit.load's fallback), matching the
+    reference's 'not created by constructor' contract."""
+
+    def __init__(self, predictor):
+        self._predictor = predictor
+        self.training = False
+
+    @staticmethod
+    def load(path):
+        from ..inference import Config, Predictor
+        return TranslatedLayer(Predictor(Config(path)))
+
+    def forward(self, *inputs):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        outs = self._predictor.run(*inputs)
+        outs = [Tensor(jnp.asarray(o)) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def __call__(self, *inputs):
+        return self.forward(*inputs)
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer wraps a compiled inference program; it "
+            "cannot be put in train mode (re-train from the original "
+            "Layer class)")
+
+
 def load(path, **configs):
     """Returns a reconstructed Layer in eval mode (ref: jit.load →
-    TranslatedLayer). Legacy .pdparams artifacts (raw state-dicts, not
+    TranslatedLayer). If the artifact carries an AOT export and the
+    original class is NOT importable here, a TranslatedLayer serves it
+    instead. Legacy .pdparams artifacts (raw state-dicts, not
     reconstructable Layers) fail loudly with the right tool named."""
     import os
 
@@ -350,4 +433,15 @@ def load(path, **configs):
             f"{path}.pdparams is a legacy weights-only artifact and "
             "cannot be reconstructed into a Layer; load it with "
             "paddle_tpu.load() and apply set_state_dict on your model")
-    return load_inference_model(path)
+    try:
+        return load_inference_model(path)
+    except (ImportError, AttributeError, ModuleNotFoundError) as e:
+        from ..inference import _load
+        payload = _load(path + ".pdmodel", return_numpy=False)
+        if payload.get("aot"):
+            return TranslatedLayer.load(path)
+        raise ValueError(
+            f"cannot reconstruct {payload.get('class_name')} ({e}) and "
+            f"the artifact has no AOT export — re-save with "
+            f"save_inference_model(aot=True) to serve without the "
+            f"class") from e

@@ -80,7 +80,6 @@ def _bn_train_fwd_math(x, w, b, axes, eps):
     for a in axes:
         n *= x.shape[a]
     x32 = x.astype(jnp.float32)
-    ch = [i for i in range(x.ndim) if i not in axes][0]
     m = jnp.mean(x32, axis=axes)
     mb = m
     for a in sorted(axes):

@@ -250,3 +250,73 @@ class TestProgramUtils:
         v = static.create_global_var([2, 2], 3.5, "float32",
                                      persistable=True)
         np.testing.assert_allclose(v.numpy(), 3.5)
+
+
+class TestJitVisionNameTail:
+    def test_enable_to_static_off_returns_fn(self):
+        import paddle_tpu.jit as jit
+
+        def f(x):
+            return x * 2
+
+        jit.enable_to_static(False)
+        try:
+            assert jit.to_static(f) is f
+        finally:
+            jit.enable_to_static(True)
+        assert jit.to_static(f) is not f
+
+    def test_verbosity_and_code_level_knobs(self):
+        import logging
+
+        import paddle_tpu.jit as jit
+        jit.set_verbosity(2)
+        assert logging.getLogger(
+            "paddle_tpu.jit.dy2static").level == logging.DEBUG
+        jit.set_verbosity(0)
+        jit.set_code_level(1)
+        assert logging.getLogger(
+            "paddle_tpu.jit.dy2static.code").level == logging.DEBUG
+
+    def test_translated_layer_from_aot_artifact(self, tmp_path):
+        from paddle_tpu.inference import save_inference_model
+        from paddle_tpu.jit import TranslatedLayer
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        m.eval()
+        path = str(tmp_path / "aot_model")
+        save_inference_model(path, m, input_spec=[
+            InputSpec([1, 8], "int32")], aot=True)
+        # TranslatedLayer serves the AOT program with no model class
+        tl = TranslatedLayer.load(path)
+        ids = paddle.to_tensor(np.random.default_rng(0).integers(
+            0, 128, (1, 8)).astype(np.int32))
+        np.testing.assert_allclose(tl(ids).numpy(), m(ids).numpy(),
+                                   atol=1e-5)
+        with pytest.raises(RuntimeError, match="train"):
+            tl.train()
+
+    def test_image_backend_helpers(self, tmp_path):
+        from PIL import Image
+
+        from paddle_tpu.vision import (get_image_backend, image_load,
+                                       set_image_backend)
+        p = str(tmp_path / "img.png")
+        arr = np.zeros((4, 4, 3), np.uint8)
+        arr[..., 0] = 255  # red in RGB
+        Image.fromarray(arr).save(p)
+        assert get_image_backend() == "pil"
+        img = image_load(p)
+        assert np.asarray(img).shape == (4, 4, 3)
+        set_image_backend("cv2")
+        try:
+            a = image_load(p)
+            assert isinstance(a, np.ndarray)
+            assert a[0, 0, 2] == 255  # BGR: red lands in channel 2
+        finally:
+            set_image_backend("pil")
+        with pytest.raises(ValueError):
+            set_image_backend("magick")
