@@ -174,7 +174,8 @@ class DataLoader:
 
         try:
             for wid in range(self.num_workers):
-                iq = None if iterable else ctx.Queue()
+                # map-style: index batches; iterable: flow-control tokens
+                iq = ctx.Queue()
                 index_qs.append(iq)
                 w = ctx.Process(
                     target=_worker_loop,
@@ -189,7 +190,11 @@ class DataLoader:
                 workers.append(w)
 
             if iterable:
-                # arrival order; each worker streams its own shard
+                # arrival order; each worker streams its own shard,
+                # bounded to prefetch_factor tokens in flight per worker
+                for iq in index_qs:
+                    for _ in range(self.prefetch_factor):
+                        iq.put(True)
                 live = self.num_workers
                 while live:
                     msg = get_result()
@@ -201,7 +206,9 @@ class DataLoader:
                             f"DataLoader worker {msg[1]} failed:\n"
                             f"{msg[2]}")
                     else:
-                        yield _decode(msg[1])
+                        _, wid, payload = msg
+                        index_qs[wid].put(True)  # return the token
+                        yield _decode(payload)
                 return
 
             # map-style: bounded dispatch — initial round-robin window,

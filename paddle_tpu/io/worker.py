@@ -161,16 +161,23 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
         if iterable:
+            # flow control: one token (from index_queue) is consumed per
+            # emitted batch; the parent returns tokens as it consumes,
+            # bounding in-flight batches like the map-style window
             batch = []
             for sample in dataset:
                 batch.append(sample)
                 if len(batch) == batch_size:
+                    index_queue.get()
                     result_queue.put(
-                        ("data", _encode(collate_fn(batch), use_shm)))
+                        ("data", worker_id,
+                         _encode(collate_fn(batch), use_shm)))
                     batch = []
             if batch and not drop_last:
+                index_queue.get()
                 result_queue.put(
-                    ("data", _encode(collate_fn(batch), use_shm)))
+                    ("data", worker_id,
+                     _encode(collate_fn(batch), use_shm)))
         else:
             while True:
                 item = index_queue.get()

@@ -1,3 +1,4 @@
 from . import clip_grad  # noqa: F401
 from . import custom_op  # noqa: F401
+from . import download  # noqa: F401
 from .custom_op import register_op  # noqa: F401

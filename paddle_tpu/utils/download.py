@@ -1,0 +1,93 @@
+"""Pretrained-weight distribution: URL fetch + cache + md5 check.
+
+ref: python/paddle/utils/download.py (get_weights_path_from_url,
+WEIGHTS_HOME, _md5check). Weights cache under
+~/.cache/paddle_tpu/weights (override: PADDLE_TPU_WEIGHTS_HOME). For
+air-gapped machines the documented local override is
+PADDLE_TPU_PRETRAINED_DIR: a directory searched FIRST by file name —
+drop reference-format .pdparams files there and pretrained=True works
+with no network. Offline with no local file fails loudly, naming both
+the URL and the override.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url",
+           "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = os.environ.get(
+    "PADDLE_TPU_WEIGHTS_HOME",
+    osp.expanduser("~/.cache/paddle_tpu/weights"))
+
+
+def _md5check(fullname: str, md5sum: str | None = None) -> bool:
+    """ref: download.py _md5check — streaming md5 of the file."""
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _local_override(fname: str, md5sum: str | None):
+    d = os.environ.get("PADDLE_TPU_PRETRAINED_DIR")
+    if not d:
+        return None
+    cand = osp.join(d, fname)
+    if osp.isfile(cand):
+        if not _md5check(cand, md5sum):
+            raise ValueError(
+                f"{cand} (from PADDLE_TPU_PRETRAINED_DIR) fails its md5 "
+                f"check — expected {md5sum}; re-download the weights")
+        return cand
+    return None
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
+                      check_exist: bool = True) -> str:
+    """ref: download.py get_path_from_url — cached download of ``url``
+    into ``root_dir`` with an md5 gate (archives are not auto-extracted;
+    weight files are single .pdparams blobs)."""
+    fname = osp.basename(url)
+    local = _local_override(fname, md5sum)
+    if local is not None:
+        return local
+    fullname = osp.join(root_dir, fname)
+    if check_exist and osp.isfile(fullname) and _md5check(fullname, md5sum):
+        return fullname
+    os.makedirs(root_dir, exist_ok=True)
+    tmp = fullname + ".part"
+    try:
+        import urllib.request
+        with urllib.request.urlopen(url, timeout=60) as r, \
+                open(tmp, "wb") as f:
+            shutil.copyfileobj(r, f)
+    except Exception as e:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise RuntimeError(
+            f"could not download pretrained weights from {url} ({e}). "
+            f"On an offline machine, place the file at "
+            f"{fullname}, or point PADDLE_TPU_PRETRAINED_DIR at a "
+            f"directory containing {fname}") from e
+    if not _md5check(tmp, md5sum):
+        os.unlink(tmp)
+        raise RuntimeError(
+            f"downloaded {url} but its md5 does not match {md5sum} "
+            f"(corrupted transfer or changed artifact)")
+    os.replace(tmp, fullname)
+    return fullname
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    """ref: download.py get_weights_path_from_url — fetch into the
+    weights cache (or resolve via PADDLE_TPU_PRETRAINED_DIR)."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,37 @@
+"""Shared pretrained-weight loader for the vision model zoo.
+
+ref: each reference zoo file's pretrained branch (vision/models/
+resnet.py etc.: get_weights_path_from_url + set_dict). One loader +
+one arch-key normalization here instead of ten hand-built f-strings —
+the per-file variants produced key-mismatch bugs (squeezenet '1.0' vs
+'1_0', integer scale '1' vs '1.0')."""
+from __future__ import annotations
+
+__all__ = ["load_pretrained", "scale_suffix"]
+
+
+def scale_suffix(scale) -> str:
+    """Canonical textual form of a width multiplier: 1 / 1.0 -> '1.0',
+    0.25 -> '0.25' (the form the published artifact names use)."""
+    return str(float(scale))
+
+
+def load_pretrained(model, arch, urls):
+    """Fetch (or resolve via PADDLE_TPU_PRETRAINED_DIR) the published
+    weights for ``arch`` from the zoo's ``urls`` table and install them,
+    failing loudly on a missing arch or any mismatched key."""
+    if arch not in urls:
+        raise ValueError(
+            f"{arch} has no published pretrained weights; set "
+            f"pretrained=False (available: {sorted(urls)})")
+    from ... import framework
+    from ...utils.download import get_weights_path_from_url
+    path = get_weights_path_from_url(urls[arch][0], urls[arch][1])
+    state = framework.io.load(path, return_numpy=True)
+    missing, unexpected = model.set_state_dict(state)
+    if missing or unexpected:
+        raise ValueError(
+            f"pretrained weights for {arch} do not match the model: "
+            f"missing={list(missing)[:5]}, "
+            f"unexpected={list(unexpected)[:5]}")
+    return model

@@ -38,9 +38,16 @@ class AlexNet(nn.Layer):
         return x
 
 
+model_urls = {
+    "alexnet": ("https://paddle-imagenet-models-name.bj.bcebos.com/"
+                "dygraph/AlexNet_pretrained.pdparams",
+                "7f0f9f737132e02732d75a1459d98a43"),
+}
+
+
 def alexnet(pretrained: bool = False, **kwargs) -> AlexNet:
+    model = AlexNet(**kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled (no network egress); load a "
-            "converted state_dict with model.set_state_dict instead")
-    return AlexNet(**kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model, "alexnet", urls=model_urls)
+    return model

@@ -89,8 +89,16 @@ class GoogLeNet(nn.Layer):
         return x
 
 
+model_urls = {
+    "googlenet": ("https://paddle-imagenet-models-name.bj.bcebos.com/"
+                  "dygraph/GoogLeNet_pretrained.pdparams",
+                  "80c06f038e905c53ab32c40eca6e26ae"),
+}
+
+
 def googlenet(pretrained: bool = False, **kwargs) -> GoogLeNet:
+    model = GoogLeNet(**kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled (no network egress)")
-    return GoogLeNet(**kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model, "googlenet", urls=model_urls)
+    return model

@@ -149,8 +149,16 @@ class InceptionV3(nn.Layer):
         return x
 
 
+model_urls = {
+    "inception_v3": (
+        "https://paddle-hapi.bj.bcebos.com/models/inception_v3.pdparams",
+        "649a4547c3243e8b59c656f41fe330b8"),
+}
+
+
 def inception_v3(pretrained: bool = False, **kwargs) -> InceptionV3:
+    model = InceptionV3(**kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled (no network egress)")
-    return InceptionV3(**kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model, "inception_v3", urls=model_urls)
+    return model

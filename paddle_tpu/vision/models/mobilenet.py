@@ -125,13 +125,33 @@ class MobileNetV2(nn.Layer):
         return x
 
 
+# ref: mobilenetv1.py / mobilenetv2.py model_urls (published only at
+# scale 1.0; other scales fail loudly)
+model_urls = {
+    "mobilenetv1_1.0": (
+        "https://paddle-hapi.bj.bcebos.com/models/mobilenetv1_1.0.pdparams",
+        "3033ab1975b1670bef51545feb65fc45"),
+    "mobilenetv2_1.0": (
+        "https://paddle-hapi.bj.bcebos.com/models/mobilenet_v2_x1.0.pdparams",
+        "0340af0a901346c8d46f4529882fb63d"),
+}
+
+
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV1(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("no pretrained weights in this build")
-    return MobileNetV1(scale=scale, **kwargs)
+        from ._utils import load_pretrained
+        from ._utils import scale_suffix
+        load_pretrained(model, f"mobilenetv1_{scale_suffix(scale)}",
+                        urls=model_urls)
+    return model
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV2(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("no pretrained weights in this build")
-    return MobileNetV2(scale=scale, **kwargs)
+        from ._utils import load_pretrained
+        from ._utils import scale_suffix
+        load_pretrained(model, f"mobilenetv2_{scale_suffix(scale)}",
+                        urls=model_urls)
+    return model

@@ -150,10 +150,51 @@ class ResNet(nn.Layer):
         return x
 
 
-def _resnet(block, depth, pretrained=False, **kwargs):
+# published weight artifacts (ref: vision/models/resnet.py model_urls —
+# same URLs/checksums, so reference-trained weights load directly)
+model_urls = {
+    "resnet18": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnet18.pdparams",
+        "cf548f46534aa3560945be4b95cd11c4"),
+    "resnet34": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnet34.pdparams",
+        "8d2275cf8706028345f78ac0e1d31969"),
+    "resnet50": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnet50.pdparams",
+        "ca6f485ee1ab0492d38f323885b0ad80"),
+    "resnet101": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnet101.pdparams",
+        "02f35f034ca3858e1e54d4036443c92d"),
+    "resnet152": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnet152.pdparams",
+        "7ad16a2f1e7333859ff986138630fd7a"),
+    "resnext50_32x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext50_32x4d.pdparams",
+        "dc47483169be7d6f018fcbb7baf8775d"),
+    "resnext101_64x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext101_64x4d.pdparams",
+        "98e04e7ca616a066699230d769d03008"),
+    "wide_resnet50_2": (
+        "https://paddle-hapi.bj.bcebos.com/models/wide_resnet50_2.pdparams",
+        "0282f804d73debdab289bd9fea3fa6dc"),
+    "wide_resnet101_2": (
+        "https://paddle-hapi.bj.bcebos.com/models/wide_resnet101_2.pdparams",
+        "d4360a2d23657f059216f5d5a1a9ac93"),
+}
+
+
+def load_pretrained(model, arch, urls=None):
+    """Install published weights (delegates to models._utils; resnet's
+    table is the default for backward compatibility)."""
+    from ._utils import load_pretrained as _lp
+    return _lp(model, arch, model_urls if urls is None else urls)
+
+
+def _resnet(block, depth, pretrained=False, arch=None, **kwargs):
+    model = ResNet(block, depth, **kwargs)
     if pretrained:
-        raise NotImplementedError("no pretrained weights in this build")
-    return ResNet(block, depth, **kwargs)
+        load_pretrained(model, arch or f"resnet{depth}")
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):
@@ -178,21 +219,25 @@ def resnet152(pretrained=False, **kwargs):
 
 def wide_resnet50_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
-    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained,
+                   arch="wide_resnet50_2", **kwargs)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
-    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained,
+                   arch="wide_resnet101_2", **kwargs)
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 32
     kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained,
+                   arch="resnext50_32x4d", **kwargs)
 
 
 def resnext101_64x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 64
     kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained,
+                   arch="resnext101_64x4d", **kwargs)

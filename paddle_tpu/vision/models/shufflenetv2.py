@@ -120,36 +120,76 @@ class ShuffleNetV2(nn.Layer):
         return x
 
 
-def _shufflenet(scale, act, pretrained, **kwargs):
+model_urls = {
+    "shufflenet_v2_x0_25": (
+        "https://paddle-hapi.bj.bcebos.com/models/"
+        "shufflenet_v2_x0_25.pdparams",
+        "1e509b4c140eeb096bb16e214796d03b"),
+    "shufflenet_v2_x0_33": (
+        "https://paddle-hapi.bj.bcebos.com/models/"
+        "shufflenet_v2_x0_33.pdparams",
+        "3d7b3ab0eaa5c0927ff1026d31b729bd"),
+    "shufflenet_v2_x0_5": (
+        "https://paddle-hapi.bj.bcebos.com/models/"
+        "shufflenet_v2_x0_5.pdparams",
+        "5e5cee182a7793c4e4c73949b1a71bd4"),
+    "shufflenet_v2_x1_0": (
+        "https://paddle-hapi.bj.bcebos.com/models/"
+        "shufflenet_v2_x1_0.pdparams",
+        "122d42478b9e81eb49f8a9ede327b1a4"),
+    "shufflenet_v2_x1_5": (
+        "https://paddle-hapi.bj.bcebos.com/models/"
+        "shufflenet_v2_x1_5.pdparams",
+        "faced5827380d73531d0ee027c67826d"),
+    "shufflenet_v2_x2_0": (
+        "https://paddle-hapi.bj.bcebos.com/models/"
+        "shufflenet_v2_x2_0.pdparams",
+        "cd3dddcd8305e7bcd8ad14d1c69a5784"),
+    "shufflenet_v2_swish": (
+        "https://paddle-hapi.bj.bcebos.com/models/"
+        "shufflenet_v2_swish.pdparams",
+        "adde0aa3b023e5b0c94a68be1c394b84"),
+}
+
+
+def _shufflenet(scale, act, pretrained, arch=None, **kwargs):
+    model = ShuffleNetV2(scale, act, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled (no network egress)")
-    return ShuffleNetV2(scale, act, **kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model, arch or "?", urls=model_urls)
+    return model
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kw):
-    return _shufflenet(0.25, "relu", pretrained, **kw)
+    return _shufflenet(0.25, "relu", pretrained,
+                       arch="shufflenet_v2_x0_25", **kw)
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kw):
-    return _shufflenet(0.33, "relu", pretrained, **kw)
+    return _shufflenet(0.33, "relu", pretrained,
+                       arch="shufflenet_v2_x0_33", **kw)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kw):
-    return _shufflenet(0.5, "relu", pretrained, **kw)
+    return _shufflenet(0.5, "relu", pretrained,
+                       arch="shufflenet_v2_x0_5", **kw)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kw):
-    return _shufflenet(1.0, "relu", pretrained, **kw)
+    return _shufflenet(1.0, "relu", pretrained,
+                       arch="shufflenet_v2_x1_0", **kw)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kw):
-    return _shufflenet(1.5, "relu", pretrained, **kw)
+    return _shufflenet(1.5, "relu", pretrained,
+                       arch="shufflenet_v2_x1_5", **kw)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
-    return _shufflenet(2.0, "relu", pretrained, **kw)
+    return _shufflenet(2.0, "relu", pretrained,
+                       arch="shufflenet_v2_x2_0", **kw)
 
 
 def shufflenet_v2_swish(pretrained=False, **kw):
-    return _shufflenet(1.0, "swish", pretrained, **kw)
+    return _shufflenet(1.0, "swish", pretrained,
+                       arch="shufflenet_v2_swish", **kw)

@@ -70,11 +70,26 @@ class SqueezeNet(nn.Layer):
         return x
 
 
+model_urls = {
+    "squeezenet1_0": (
+        "https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/"
+        "SqueezeNet1_0_pretrained.pdparams",
+        "30b95af60a2178f03cf9b66cd77e1db1"),
+    "squeezenet1_1": (
+        "https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/"
+        "SqueezeNet1_1_pretrained.pdparams",
+        "a11250d3a1f91d7131fd095ebbf09eee"),
+}
+
+
 def _squeezenet(version, pretrained, **kwargs):
+    model = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled (no network egress)")
-    return SqueezeNet(version, **kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model,
+                        f"squeezenet{str(version).replace('.', '_')}",
+                        urls=model_urls)
+    return model
 
 
 def squeezenet1_0(pretrained: bool = False, **kwargs) -> SqueezeNet:

@@ -54,23 +54,35 @@ class VGG(nn.Layer):
         return x
 
 
-def _vgg(cfg, batch_norm=False, pretrained=False, **kwargs):
+# ref: vision/models/vgg.py model_urls (bn variants have no published
+# weights — pretrained=True on them fails loudly via load_pretrained)
+model_urls = {
+    "vgg16": ("https://paddle-hapi.bj.bcebos.com/models/vgg16.pdparams",
+              "89bbffc0f87d260be9b8cdc169c991c4"),
+    "vgg19": ("https://paddle-hapi.bj.bcebos.com/models/vgg19.pdparams",
+              "23b18bb13d8894f60f54e642be79a0dd"),
+}
+
+
+def _vgg(cfg, batch_norm=False, pretrained=False, arch=None, **kwargs):
+    model = VGG(make_layers(cfgs[cfg], batch_norm=batch_norm), **kwargs)
     if pretrained:
-        raise NotImplementedError("no pretrained weights in this build")
-    return VGG(make_layers(cfgs[cfg], batch_norm=batch_norm), **kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model, arch or "?", urls=model_urls)
+    return model
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("A", batch_norm, pretrained, **kwargs)
+    return _vgg("A", batch_norm, pretrained, arch="vgg11", **kwargs)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("B", batch_norm, pretrained, **kwargs)
+    return _vgg("B", batch_norm, pretrained, arch="vgg13", **kwargs)
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("D", batch_norm, pretrained, **kwargs)
+    return _vgg("D", batch_norm, pretrained, arch="vgg16", **kwargs)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("E", batch_norm, pretrained, **kwargs)
+    return _vgg("E", batch_norm, pretrained, arch="vgg19", **kwargs)

@@ -85,10 +85,31 @@ def test_inception_v3_forward():
     assert m(x).shape == [1, 8]
 
 
-def test_no_pretrained_weights_errors():
-    with pytest.raises(NotImplementedError):
+def test_pretrained_offline_fails_loudly(monkeypatch, tmp_path):
+    """pretrained=True with no network and no local override must fail
+    with the override instructions, not hang or silently random-init
+    (the full machinery incl. local-dir round-trip is in
+    tests/test_pretrained.py)."""
+    import importlib
+
+    import paddle_tpu.utils.download as dl
+    # attribute access resolves to the constructor functions (package
+    # __init__ shadowing); import_module gets the module objects
+    an = importlib.import_module("paddle_tpu.vision.models.alexnet")
+    dn = importlib.import_module("paddle_tpu.vision.models.densenet")
+    monkeypatch.delenv("PADDLE_TPU_PRETRAINED_DIR", raising=False)
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path))
+    # unresolvable host: the test must not depend on (or pay for) real
+    # egress on machines that have it
+    monkeypatch.setitem(an.model_urls, "alexnet",
+                        ("https://invalid.example.invalid/a.pdparams",
+                         None))
+    monkeypatch.setitem(dn.model_urls, "densenet121",
+                        ("https://invalid.example.invalid/d.pdparams",
+                         None))
+    with pytest.raises(RuntimeError, match="PADDLE_TPU_PRETRAINED_DIR"):
         M.alexnet(pretrained=True)
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(RuntimeError, match="PADDLE_TPU_PRETRAINED_DIR"):
         M.densenet121(pretrained=True)
 
 
