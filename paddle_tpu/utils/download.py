@@ -62,7 +62,11 @@ def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
     if check_exist and osp.isfile(fullname) and _md5check(fullname, md5sum):
         return fullname
     os.makedirs(root_dir, exist_ok=True)
-    tmp = fullname + ".part"
+    # unique temp per caller: concurrent ranks downloading the same
+    # weights must not interleave into one .part file
+    import tempfile
+    fd, tmp = tempfile.mkstemp(dir=root_dir, prefix=fname + ".part.")
+    os.close(fd)
     try:
         import urllib.request
         with urllib.request.urlopen(url, timeout=60) as r, \

@@ -68,7 +68,10 @@ def _vgg(cfg, batch_norm=False, pretrained=False, arch=None, **kwargs):
     model = VGG(make_layers(cfgs[cfg], batch_norm=batch_norm), **kwargs)
     if pretrained:
         from ._utils import load_pretrained
-        load_pretrained(model, arch or "?", urls=model_urls)
+        # bn variants have no published artifact: the _bn-suffixed key
+        # misses the table BEFORE any download is attempted
+        key = (arch or "?") + ("_bn" if batch_norm else "")
+        load_pretrained(model, key, urls=model_urls)
     return model
 
 

@@ -528,6 +528,14 @@ EXEMPT = {
     "rms_norm", "flash_attention", "scaled_dot_product_attention",
     "interpolate", "upsample", "fold", "unfold", "pixel_unshuffle",
     "channel_shuffle",
+    # fused/Pallas kernels: covered by test_incubate, test_moe,
+    # test_ring_attention, test_dropout_flash_ce (their yaml entries
+    # exist to carry SPMD rules; see distributed/spmd_rules.py)
+    "fused_linear", "fused_rms_norm", "fused_bias_act",
+    "fused_layernorm_residual_dropout",
+    "fused_rotary_position_embedding", "fused_softmax_ce_mean",
+    "grouped_matmul", "moe_forward_indices",
+    "flash_attention_segmented", "ring_attention",
     # composite losses covered in test_distributions_losses /
     # test_functional_longtail
     "ctc_loss", "gaussian_nll_loss", "poisson_nll_loss",
