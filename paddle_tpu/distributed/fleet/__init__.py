@@ -18,6 +18,10 @@ from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
 from .pipeline_parallel import (  # noqa: F401
     PipelineParallel, PipelineParallelWithInterleave,
 )
+from .pipeline_zero_bubble import (  # noqa: F401
+    PipelineParallelZeroBubble, zb_h1_schedule, one_f_one_b_schedule,
+    simulate_schedule,
+)
 from .tensor_parallel import TensorParallel  # noqa: F401
 from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
 from .sharding_optimizer import DygraphShardingOptimizer  # noqa: F401

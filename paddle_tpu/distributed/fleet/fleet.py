@@ -106,6 +106,13 @@ class _Fleet:
         if hcg is not None and hcg.get_pipe_parallel_world_size() > 1:
             from .pipeline_parallel import (PipelineParallel,
                                             PipelineParallelWithInterleave)
+            pcfg = getattr(strategy, "pipeline_configs", {}) or {}
+            if str(pcfg.get("schedule_mode", "")).upper() in (
+                    "ZB", "ZB-H1", "ZBH1"):
+                # ref: passes/pipeline_scheduler_pass/pipeline_zero_bubble
+                # selected via pipeline_configs schedule_mode
+                from .pipeline_zero_bubble import PipelineParallelZeroBubble
+                return PipelineParallelZeroBubble(model, hcg, strategy)
             if getattr(model, "_num_virtual_stages", 1) > 1:
                 # ref: fleet/model.py:162-172 picks the interleave runtime
                 # when the PipelineLayer declares virtual stages

@@ -56,15 +56,6 @@ def list_rules():
     return sorted(_RULES)
 
 
-def _first(*specs):
-    """First non-None batch-dim sharding among the inputs (the common
-    'align on batch' propagation used by elementwise-family rules)."""
-    for s in specs:
-        if s is not None and len(s) and s[0] is not None:
-            return s[0]
-    return None
-
-
 # -- generic families -----------------------------------------------------
 
 @register_rule("elementwise")
@@ -117,10 +108,28 @@ def reduction(x_spec, axis=None, keepdims=False):
 @register_rule("matmul")
 def matmul(x_spec, y_spec):
     """[.., M, K] @ [.., K, N]: K sharded on both -> partial (psum);
-    M/N shardings pass through. ref: spmd_rules/matmul.cc:116."""
+    M/N pass through; batch dims merge across operands (conflict
+    raises). ref: spmd_rules/matmul.cc:116."""
     xs = list(x_spec) if x_spec is not None else [None, None]
     ys = list(y_spec) if y_spec is not None else [None, None]
-    batch = xs[:-2]
+    if len(xs) < 2 or len(ys) < 2:
+        raise ValueError(
+            "matmul rule covers rank>=2 operands; annotate 1-D "
+            "operands replicated (GSPMD handles the vector forms)")
+    bx, by = xs[:-2], ys[:-2]
+    rank = max(len(bx), len(by))
+    batch = [None] * rank
+    for bs in (bx, by):
+        off = rank - len(bs)
+        for i, d in enumerate(bs):
+            if d is None:
+                continue
+            j = off + i
+            if batch[j] is not None and batch[j] != d:
+                raise ValueError(
+                    f"matmul batch dim {j} sharded differently: "
+                    f"{batch[j]} vs {d}")
+            batch[j] = d
     m, kx = xs[-2], xs[-1]
     ky, n = ys[-2], ys[-1]
     if kx is not None and ky is not None and kx != ky:
@@ -221,17 +230,26 @@ def dropout(x_spec, *rest):
 
 
 @register_rule("conv")
-def conv(x_spec, w_spec):
-    """NHWC conv: batch sharding passes through, weights replicated,
-    spatial dims unsharded (halo exchange is future work)."""
-    if x_spec is not None and len(x_spec) == 4 and any(
-            d is not None for d in list(x_spec)[1:3]):
-        raise ValueError(
-            "spatially-sharded conv needs halo exchange — unsupported")
+def conv(x_spec, w_spec, data_format="NCHW"):
+    """Conv: batch sharding passes through, weights replicated, spatial
+    dims unsharded (halo exchange is future work), input-channel
+    sharding rejected (it would leave partial sums). Layout-aware:
+    NCHW channel=1 / spatial=2,3; NHWC spatial=1,2 / channel=3."""
+    if x_spec is not None and len(x_spec) == 4:
+        dims = list(x_spec)
+        spatial = (2, 3) if data_format == "NCHW" else (1, 2)
+        ch = 1 if data_format == "NCHW" else 3
+        if any(dims[i] is not None for i in spatial):
+            raise ValueError(
+                "spatially-sharded conv needs halo exchange — "
+                "unsupported")
+        if dims[ch] is not None:
+            raise ValueError(
+                "input-channel-sharded conv leaves partial sums "
+                "(needs psum); reshard the channel dim first")
     if w_spec is not None and any(d is not None for d in w_spec):
         raise ValueError("conv weights must be replicated in this rule")
     out = list(x_spec) if x_spec is not None else [None] * 4
-    out[-1] = None  # output channels from replicated weights
     return (x_spec, w_spec), P(*out)
 
 
@@ -385,14 +403,18 @@ def shard_map_moe_dispatch(mesh, tokens, gate_w, w_in, w_out, *, top_k,
 
     from ..incubate.moe_dispatch import moe_forward_indices
 
-    # pin expert-sharded weights AND token-sharded input/output: with
-    # both ends fixed, either GSPMD moves tokens (all-to-all, the
-    # global_scatter contract) or it would have to all-gather the full
-    # expert weights — the HLO test forbids weight-shaped all-gathers,
-    # so the memory-saving decomposition is what ships
+    # pin expert-sharded weights AND token-sharded input/output per the
+    # registered moe_dispatch rule: with both ends fixed, either GSPMD
+    # moves tokens (all-to-all, the global_scatter contract) or it would
+    # have to all-gather the full expert weights — the HLO test forbids
+    # weight-shaped all-gathers, so the memory-saving decomposition is
+    # what ships. (Unlike the other appliers this one constrains a
+    # GSPMD program rather than shard_map-ing: the dispatch gather is
+    # data-dependent, which GSPMD lowers to the alltoall directly.)
     from jax.sharding import NamedSharding
+    (tok_spec, _), out_spec = get_rule("moe_dispatch")(P(ep_axis, None))
     tok = jax.lax.with_sharding_constraint(
-        tokens, NamedSharding(mesh, P(ep_axis, None)))
+        tokens, NamedSharding(mesh, tok_spec))
     wi = jax.lax.with_sharding_constraint(
         w_in, NamedSharding(mesh, P(ep_axis, None, None)))
     wo = jax.lax.with_sharding_constraint(
@@ -400,5 +422,5 @@ def shard_map_moe_dispatch(mesh, tokens, gate_w, w_in, w_out, *, top_k,
     out = moe_forward_indices(tok, gate_w, wi, wo, top_k, capacity, act)
     y = out[0] if isinstance(out, tuple) else out
     y = jax.lax.with_sharding_constraint(
-        y, NamedSharding(mesh, P(ep_axis, None)))
+        y, NamedSharding(mesh, out_spec))
     return (y,) + tuple(out[1:]) if isinstance(out, tuple) else y

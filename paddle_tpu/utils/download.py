@@ -67,6 +67,9 @@ def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
     import tempfile
     fd, tmp = tempfile.mkstemp(dir=root_dir, prefix=fname + ".part.")
     os.close(fd)
+    # mkstemp creates 0600; the cache is shared — restore umask-style
+    # permissions so other users/ranks can read the final file
+    os.chmod(tmp, 0o644)
     try:
         import urllib.request
         with urllib.request.urlopen(url, timeout=60) as r, \

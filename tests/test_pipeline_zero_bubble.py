@@ -1,0 +1,283 @@
+"""Zero-bubble (ZB-H1) pipeline schedule tests (ref: distributed/passes/
+pipeline_scheduler_pass/pipeline_zero_bubble.py): bubble-count reduction
+vs 1F1B under the dependency simulator, loss/grad equivalence of the
+split-B/W programs, and the multi-process runtime end-to-end."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet import (one_f_one_b_schedule,
+                                          simulate_schedule,
+                                          zb_h1_schedule)
+
+
+class TestScheduleBubble:
+    def test_zb_reduces_bubble_vs_1f1b(self):
+        """With unit costs the ZB-H1 bubble must be strictly below
+        1F1B's on every non-trivial stage, and the theoretical ~1/3
+        total reduction must show."""
+        S, M = 4, 8
+        f1b = {s: one_f_one_b_schedule(S, s, M) for s in range(S)}
+        zb = {s: zb_h1_schedule(S, s, M) for s in range(S)}
+        idle_1f1b = simulate_schedule(f1b, fused_bw=True)
+        idle_zb = simulate_schedule(zb, fused_bw=False)
+        tot_1f1b = sum(idle_1f1b.values())
+        tot_zb = sum(idle_zb.values())
+        assert tot_zb < tot_1f1b, (idle_zb, idle_1f1b)
+        # taking W off the cooldown critical path saves >= t_W per
+        # non-last stage at unit costs (memory-neutral H1 deferral);
+        # every stage must be no worse
+        assert tot_zb <= tot_1f1b - (S - 1), (tot_zb, tot_1f1b)
+        for s in range(S):
+            assert idle_zb[s] <= idle_1f1b[s], (s, idle_zb, idle_1f1b)
+        # heavier W (common in practice: dW matmuls dominate) widens the
+        # gap — the deferral scales with t_W
+        idle_zb_w2 = sum(simulate_schedule(
+            zb, t_w=2, fused_bw=False).values())
+        idle_f1b_w2 = sum(simulate_schedule(
+            f1b, t_w=2, fused_bw=True).values())
+        assert idle_f1b_w2 - idle_zb_w2 >= 2 * (S - 1)
+
+    def test_zb_schedule_defers_cooldown_w(self):
+        """Event counts must balance (every F has one B and one W) and
+        every cooldown B must precede ALL deferred W's — the W-free
+        B-chain is the zero-bubble property."""
+        S, M = 4, 8
+        for s in range(S):
+            ev = zb_h1_schedule(S, s, M)
+            kinds = [k for k, _ in ev]
+            assert kinds.count("F") == M
+            assert kinds.count("B") == M
+            assert kinds.count("W") == M
+            last_b = max(i for i, k in enumerate(kinds) if k == "B")
+            # the last stage has no cooldown: its tail is the final
+            # steady slot's own W
+            n_tail = max(min(S - 1 - s, M), 1)
+            tail_ws = [k for k in kinds[last_b + 1:]]
+            assert tail_ws == ["W"] * n_tail, (s, ev)
+
+    def test_zb_memory_highwater_matches_1f1b(self):
+        """H1's defining property: no extra activation memory vs 1F1B.
+        Stash count grows at F (activation kept) and shrinks at W
+        (released after weight grads) — the schedule-level high-water
+        must not exceed 1F1B's (the pipeline memory gate for zb)."""
+        S, M = 4, 8
+
+        def highwater(ev):
+            live = hw = 0
+            for kind, _ in ev:
+                if kind == "F":
+                    live += 1
+                    hw = max(hw, live)
+                elif kind == "W":
+                    live -= 1
+            return hw
+
+        for s in range(S):
+            hw_zb = highwater(zb_h1_schedule(S, s, M))
+            hw_1f1b = highwater(one_f_one_b_schedule(S, s, M))
+            assert hw_zb == hw_1f1b, (s, hw_zb, hw_1f1b)
+
+    def test_simulator_detects_deadlock(self):
+        bad = {0: [("B", 0), ("F", 0), ("W", 0)],
+               1: [("F", 0), ("B", 0), ("W", 0)]}
+        with pytest.raises(RuntimeError, match="deadlock"):
+            simulate_schedule(bad)
+
+
+def _loss_fn(out, label):
+    return ((out - label) ** 2).mean()
+
+
+class TestSplitBWEquivalence:
+    def test_zb_single_controller_matches_1f1b(self):
+        """Same model + data through the 1F1B runtime and the ZB runtime
+        (split B/W programs): identical loss and parameter grads."""
+        from paddle_tpu.distributed.fleet import (PipelineLayer, LayerDesc)
+        from paddle_tpu.distributed.fleet.pipeline_parallel import (
+            PipelineParallel)
+        from paddle_tpu.distributed.fleet.pipeline_zero_bubble import (
+            PipelineParallelZeroBubble)
+
+        class Block(nn.Layer):
+            def __init__(self, i):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        class FakeHcg:
+            def get_pipe_parallel_world_size(self):
+                return 1
+
+            def get_stage_id(self):
+                return 0
+
+        class Strat:
+            pipeline_configs = {"accumulate_steps": 4,
+                                "micro_batch_size": 2}
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 8)).astype(np.float32)
+        y = rng.standard_normal((8, 8)).astype(np.float32)
+
+        def run(cls):
+            paddle.seed(0)
+            pl = PipelineLayer([LayerDesc(Block, i) for i in range(3)],
+                               loss_fn=_loss_fn)
+            runtime = cls(pl, FakeHcg(), Strat())
+            loss = runtime.forward_backward_pipeline(
+                (paddle.to_tensor(x), paddle.to_tensor(y)))
+            grads = {k: np.asarray(p.grad._data)
+                     for k, p in dict(pl.named_parameters()).items()
+                     if p.grad is not None}
+            return float(loss), grads
+
+        l1, g1 = run(PipelineParallel)
+        l2, g2 = run(PipelineParallelZeroBubble)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        assert set(g1) == set(g2) and g1
+        for k in g1:
+            np.testing.assert_allclose(g1[k], g2[k], rtol=1e-4,
+                                       atol=1e-6)
+
+    def test_zb_single_records_deferred_schedule(self):
+        from paddle_tpu.distributed.fleet import (PipelineLayer, LayerDesc)
+        from paddle_tpu.distributed.fleet.pipeline_zero_bubble import (
+            PipelineParallelZeroBubble)
+
+        class Block(nn.Layer):
+            def __init__(self, i):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        class FakeHcg:
+            def get_pipe_parallel_world_size(self):
+                return 1
+
+            def get_stage_id(self):
+                return 0
+
+        class Strat:
+            pipeline_configs = {"accumulate_steps": 3,
+                                "micro_batch_size": 1}
+
+        paddle.seed(0)
+        pl = PipelineLayer([LayerDesc(Block, 0)], loss_fn=_loss_fn)
+        rt = PipelineParallelZeroBubble(pl, FakeHcg(), Strat())
+        x = np.zeros((3, 4), np.float32)
+        rt.forward_backward_pipeline((paddle.to_tensor(x),
+                                      paddle.to_tensor(x)))
+        kinds = [k for k, _ in rt.last_schedule]
+        # all W strictly after all B (true deferral on the single path)
+        assert kinds.index("W") > max(i for i, k in enumerate(kinds)
+                                      if k == "B")
+
+
+def _run_launch(tmp_path, script_body, extra=()):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--log_dir", str(tmp_path / "log"), *extra, str(script)]
+    e = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=240, env=e,
+                          cwd="/root/repo"), tmp_path / "log"
+
+
+def test_zb_multiproc_matches_single_process(tmp_path):
+    """2-stage ZB-H1 over real subprocesses: loss matches the
+    single-process oracle and grads flow on both stages
+    (the reference tests PP runtimes with launched workers,
+    test/collective/fleet)."""
+    proc, log = _run_launch(tmp_path, """
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import (PipelineLayer,
+                                                  LayerDesc)
+
+        M = 4
+
+        class Block(nn.Layer):
+            def __init__(self, i):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        def loss_fn(out, label):
+            return ((out - label) ** 2).mean()
+
+        dist.init_parallel_env()
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": M,
+                                     "micro_batch_size": 2,
+                                     "schedule_mode": "ZB-H1"}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        pl = PipelineLayer([LayerDesc(Block, i) for i in range(4)],
+                           loss_fn=loss_fn)
+        from paddle_tpu.distributed.fleet.pipeline_zero_bubble import (
+            PipelineParallelZeroBubble)
+        model = fleet.distributed_model(pl)
+        assert isinstance(model, PipelineParallelZeroBubble), type(model)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 8)).astype(np.float32)
+        y = rng.standard_normal((8, 8)).astype(np.float32)
+        loss = model.forward_backward_pipeline(
+            (paddle.to_tensor(x), paddle.to_tensor(y)))
+
+        # single-process oracle mirroring per-rank init: each rank
+        # constructs its 2 local blocks from seed 0, so stage-1's
+        # blocks have the same weights as stage-0's
+        paddle.seed(0)
+        s0 = [Block(i) for i in range(2)]
+        paddle.seed(0)
+        s1 = [Block(i) for i in range(2)]
+        blocks = nn.Sequential(*(s0 + s1))
+        total = None
+        for xm, ym in zip(np.split(x, M), np.split(y, M)):
+            out = blocks(paddle.to_tensor(xm))
+            l = loss_fn(out, paddle.to_tensor(ym))
+            (l * (1.0 / M)).backward()
+            total = l if total is None else total + l
+        exp = float(total.numpy()) / M
+        np.testing.assert_allclose(float(loss), exp, rtol=1e-5)
+
+        # this rank's stage grads match the oracle's matching blocks
+        r = dist.get_rank()
+        got = {k: p.grad.numpy() for k, p in
+               dict(model._layers.named_parameters()).items()
+               if p.grad is not None}
+        assert got, "no grads on stage"
+        oracle = {k: p.grad.numpy() for k, p in
+                  dict(blocks.named_parameters()).items()}
+        for k, gv in got.items():
+            parts = k.split(".")
+            while parts and not parts[0].isdigit():
+                parts = parts[1:]  # strip container prefixes
+            idx = int(parts[0]) + (2 if r == 1 else 0)
+            ok = oracle[f"{idx}." + ".".join(parts[1:])]
+            np.testing.assert_allclose(gv, ok, rtol=1e-4, atol=1e-6)
+        print("ZB_PP_OK rank", r)
+    """, extra=["--nproc_per_node", "2"])
+    assert proc.returncode == 0, proc.stderr + "".join(
+        (log / f"workerlog.{i}").read_text() for i in (0, 1)
+        if (log / f"workerlog.{i}").exists())
+    for i in (0, 1):
+        assert "ZB_PP_OK" in (log / f"workerlog.{i}").read_text()

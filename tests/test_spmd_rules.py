@@ -93,13 +93,32 @@ class TestRuleSemantics:
                                               P(None, None, None))
         assert tuple(out) == ("dp", None)
 
-    def test_conv_spatial_shard_rejected(self):
+    def test_conv_spatial_and_channel_shard_rejected(self):
+        w = P(None, None, None, None)
+        # NCHW (default): dims 2,3 spatial; dim 1 input-channel
         with pytest.raises(ValueError, match="halo"):
-            R.get_rule("conv")(P(None, "dp", None, None),
-                               P(None, None, None, None))
-        _, out = R.get_rule("conv")(P("dp", None, None, None),
-                                    P(None, None, None, None))
+            R.get_rule("conv")(P(None, None, "dp", None), w)
+        with pytest.raises(ValueError, match="channel"):
+            R.get_rule("conv")(P(None, "mp", None, None), w)
+        # NHWC: dims 1,2 spatial; dim 3 input-channel
+        with pytest.raises(ValueError, match="halo"):
+            R.get_rule("conv")(P(None, "dp", None, None), w,
+                               data_format="NHWC")
+        with pytest.raises(ValueError, match="channel"):
+            R.get_rule("conv")(P(None, None, None, "mp"), w,
+                               data_format="NHWC")
+        _, out = R.get_rule("conv")(P("dp", None, None, None), w)
         assert tuple(out) == ("dp", None, None, None)
+
+    def test_matmul_batch_dim_merge_and_conflict(self):
+        _, out = R.get_rule("matmul")(P(None, None, None),
+                                      P("dp", None, None))
+        assert tuple(out) == ("dp", None, None)
+        with pytest.raises(ValueError, match="batch"):
+            R.get_rule("matmul")(P("dp", None, None),
+                                 P("mp", None, None))
+        with pytest.raises(ValueError, match="rank"):
+            R.get_rule("matmul")(P("dp"), P(None, None))
 
 
 def _collectives(hlo_text):
