@@ -24,12 +24,17 @@ __all__ = ["Engine", "Strategy"]
 @dataclass
 class Strategy:
     """ref: auto_parallel/strategy.py Strategy (amp/recompute/sharding
-    sub-configs as attribute bags)."""
+    sub-configs as attribute bags). ``auto`` turns on the planner
+    (ref: static engine auto_mode + static/cost planner): with
+    enable=True and no mesh given, Engine prices every (dp, fsdp, mp)
+    factorization with the roofline cost model and shards the model on
+    the winner before compiling."""
     amp: dict = field(default_factory=dict)
     recompute: dict = field(default_factory=dict)
     sharding: dict = field(default_factory=dict)
     pipeline: dict = field(default_factory=dict)
     gradient_merge: dict = field(default_factory=dict)
+    auto: dict = field(default_factory=dict)
 
 
 class Engine:
@@ -44,9 +49,12 @@ class Engine:
         self.strategy = strategy or Strategy()
         self.mesh = mesh
         self._data_sharding = data_sharding
+        self._shard_fn = shard_fn
         if shard_fn is not None and mesh is not None:
             shard_fn(model, mesh)
         self._step: Optional[DistTrainStep] = None
+        self._pending_plan_batch = None
+        self.plan_choice = None
         self.history: dict = {"loss": []}
 
     def _apply_strategy(self):
@@ -103,8 +111,71 @@ class Engine:
               else vars(s.gradient_merge))
         self._acc = int(gm.get("k_steps", 1)) if gm.get("enable") else 1
 
+    def plan(self, sample_batch, n_devices: Optional[int] = None,
+             cluster=None, measured: bool = False):
+        """Choose the parallel config (ref: static engine planner,
+        static/cost/): profile the model, search mesh factorizations,
+        build the winning mesh, and shard the model onto it. Called
+        automatically by fit() when strategy.auto.enable and no mesh
+        was given; callable directly for inspection (returns the
+        chosen PlanCandidate)."""
+        import jax
+        import numpy as np
+
+        from ..process_mesh import ProcessMesh
+        from .planner import Planner, profile_model
+
+        n = n_devices or len(jax.devices())
+        first = sample_batch[0] if isinstance(
+            sample_batch, (tuple, list)) else sample_batch
+        arr = np.asarray(first._data if isinstance(first, Tensor)
+                         else first)
+        batch_tokens = int(np.prod(arr.shape[:2])) if arr.ndim >= 2 \
+            else int(arr.shape[0])
+        auto = (self.strategy.auto if isinstance(self.strategy.auto, dict)
+                else vars(self.strategy.auto))
+        prof = profile_model(self.model, batch_tokens,
+                             layer_count=auto.get("layer_count"))
+        planner = Planner(n, cluster=cluster,
+                          max_mp=auto.get("max_mp"))
+        if measured or auto.get("measured"):
+            raise NotImplementedError(
+                "measured planning needs the caller-provided trial "
+                "closures (build_trial_runner model/batch factories); "
+                "use Planner.plan_measured directly for that flow")
+        best = planner.plan(prof, top_k=1)[0]
+        self.plan_choice = best
+        dims = [d for d in best.mesh_shape]
+        mesh = ProcessMesh(
+            np.arange(n).reshape(dims), dim_names=["dp", "fsdp", "mp"])
+        self.mesh = mesh
+        shard_fn = auto.get("shard_fn") or self._shard_fn
+        if shard_fn is not None:
+            # model-aware placements (tp column/row splits need model
+            # knowledge, e.g. models.llama.shard_llama)
+            shard_fn(self.model, mesh)
+        else:
+            from ..api import shard_parameter
+            for p in self.model.parameters():
+                shard_parameter(p, mesh, fsdp_axis="fsdp", fsdp_dim=0)
+        return best
+
     def _ensure_step(self):
         if self._step is None:
+            auto = (self.strategy.auto
+                    if isinstance(self.strategy.auto, dict)
+                    else vars(self.strategy.auto))
+            if auto.get("enable") and self.mesh is None:
+                if self._pending_plan_batch is None:
+                    # building (and caching) an unplanned step here would
+                    # silently disable auto sharding for the whole run
+                    raise RuntimeError(
+                        "strategy.auto needs a sample batch before the "
+                        "step builds: call fit() first, or "
+                        "Engine.plan(sample_batch) explicitly before "
+                        "load()/evaluate()")
+                self.plan(self._pending_plan_batch)
+                self._pending_plan_batch = None  # planning consumed it
             self._apply_strategy()
             loss_fn = self.loss
             if hasattr(loss_fn, "forward"):  # a Layer criterion
@@ -122,13 +193,19 @@ class Engine:
     # -- training (ref: engine.py fit :1544) --------------------------------
     def fit(self, train_data, epochs=1, steps_per_epoch=None, verbose=0,
             log_freq=10):
-        step = self._ensure_step()
+        step = None
         for epoch in range(epochs):
             for i, batch in enumerate(train_data):
                 if steps_per_epoch is not None and i >= steps_per_epoch:
                     break
                 batch = batch if isinstance(batch, (tuple, list)) else \
                     (batch,)
+                if step is None:
+                    # the planner needs a sample batch for its token
+                    # count, so the step builds lazily at first batch
+                    self._pending_plan_batch = batch
+                    step = self._ensure_step()
+                    self._pending_plan_batch = None  # don't pin the batch
                 loss = step(*batch)
                 self.history["loss"].append(float(loss))
                 if verbose and i % log_freq == 0:

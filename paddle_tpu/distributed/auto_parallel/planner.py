@@ -1,0 +1,235 @@
+"""Static auto-parallel planner v1: cost model + mesh/strategy search.
+
+ref: python/paddle/distributed/auto_parallel/static/engine.py:100 (the
+Engine's completion -> partition -> reshard pipeline is GSPMD here), and
+static/cost/ + static/cluster.py — the reference prices each candidate
+distributed program with per-op FLOPs/bytes models over a cluster
+description, prunes infeasible ones, and picks the cheapest. This
+planner does the TPU-native equivalent:
+
+1. enumerate mesh factorizations of n_devices over (dp, fsdp, mp);
+2. price each with a roofline model — MXU time from model FLOPs,
+   ICI time per axis from the collective bytes its sharding implies
+   (dp: grad allreduce; fsdp: param allgather fwd+bwd + grad
+   reduce-scatter; mp: per-layer activation allreduces);
+3. prune configs whose per-chip memory (params + grads + optimizer
+   state + activations) exceeds the HBM budget — the compile-free OOM
+   verdict (the reference's prune-by-memory, auto_tuner/prune.py);
+4. (optional) hand the top-k survivors to the auto_tuner trial runner,
+   which compiles and TIMES each candidate (distributed/auto_tuner/
+   runner.py) — measurement beats modeling for the final pick.
+
+The cluster description (chip FLOP/s, ICI GB/s, HBM bytes) defaults to
+v5e and is overridable — the analog of static/cluster.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+__all__ = ["Cluster", "ModelProfile", "PlanCandidate", "Planner",
+           "profile_model"]
+
+
+@dataclass
+class Cluster:
+    """ref: auto_parallel/static/cluster.py — the device description the
+    cost model prices against. Defaults: one TPU v5e pod slice."""
+    chip_flops: float = 197e12          # bf16 peak per chip
+    ici_bandwidth: float = 45e9         # bytes/s per link direction
+    hbm_bytes: float = 16e9
+    mfu_ceiling: float = 0.6            # realistic matmul efficiency
+    ici_latency: float = 5e-6           # per-collective launch latency
+    mp_min_width: int = 512             # hidden/mp below this starves
+    # the MXU (128-wide systolic tiles + pipelining need fat matmuls);
+    # compute efficiency scales ~ linearly with shard width under it
+
+
+@dataclass
+class ModelProfile:
+    """What the cost model needs to know about one training step."""
+    param_bytes: int                    # total parameter bytes
+    flops_per_step: float               # fwd+bwd+update FLOPs
+    batch_tokens: int = 1
+    hidden: int = 1                     # activation width (mp comm unit)
+    layer_count: int = 1                # mp comm multiplier
+    act_dtype_bytes: int = 2
+    bytes_per_param_state: float = 10.0  # grad + opt state per param byte
+    # (bf16 grads 1x + f32 moments 8 bytes/2-byte-param => ~10x is AdamW
+    # with fp32 state; SGD-momentum would be ~4)
+
+    @property
+    def activation_bytes(self) -> float:
+        """Standard transformer footprint ~12 tensors of
+        [tokens, hidden] live per layer."""
+        return (12.0 * self.layer_count * self.batch_tokens *
+                self.hidden * self.act_dtype_bytes)
+
+
+def profile_model(model, batch_tokens: int,
+                  layer_count: Optional[int] = None) -> ModelProfile:
+    """Build a ModelProfile from a live Layer: params from the module
+    tree, FLOPs from the 6·N·tokens transformer estimate (the standard
+    fwd+bwd accounting; ref static_op_benchmark.json's role is pricing
+    sanity, not exactness), activations ~ 12·tokens·hidden guess."""
+    import numpy as np
+    n_params = 0
+    p_bytes = 0
+    widths: List[int] = []
+    for p in model.parameters():
+        size = int(np.prod(p.shape)) if len(p.shape) else 1
+        n_params += size
+        p_bytes += size * p._data.dtype.itemsize
+        if len(p.shape) >= 2:
+            widths.append(int(p.shape[-1]))
+    hidden = int(np.median(widths)) if widths else 1
+    layers = layer_count
+    if layers is None:
+        # count distinct numbered blocks in param names as the proxy
+        import re
+        idx = {m.group(1) for n, _ in model.named_parameters()
+               for m in [re.search(r"(?:^|\.)(\d+)\.", n)] if m}
+        layers = max(len(idx), 1)
+    return ModelProfile(
+        param_bytes=p_bytes,
+        flops_per_step=6.0 * n_params * batch_tokens,
+        batch_tokens=batch_tokens,
+        hidden=hidden,
+        layer_count=layers,
+    )
+
+
+@dataclass
+class PlanCandidate:
+    dp: int
+    fsdp: int
+    mp: int
+    est_step_time: float = 0.0
+    est_mem_bytes: float = 0.0
+    feasible: bool = True
+    reason: str = ""
+    measured_items_per_s: Optional[float] = None
+
+    @property
+    def mesh_shape(self) -> Tuple[int, int, int]:
+        return (self.dp, self.fsdp, self.mp)
+
+
+def _ring_factor(n: int) -> float:
+    return (n - 1) / n if n > 1 else 0.0
+
+
+class Planner:
+    """Search over (dp, fsdp, mp) factorizations of n_devices.
+
+    ``plan()`` = analytic rank (+ memory prune); ``plan_measured()``
+    additionally times the top-k with the auto_tuner trial runner and
+    returns the measured winner — the reference's two-phase
+    cost-model-then-trials flow (auto_tuner/tuner.py)."""
+
+    def __init__(self, n_devices: int, cluster: Optional[Cluster] = None,
+                 max_mp: Optional[int] = None):
+        self.n = n_devices
+        self.cluster = cluster or Cluster()
+        self.max_mp = max_mp or n_devices
+
+    def candidates(self) -> List[PlanCandidate]:
+        out = []
+        n = self.n
+        for dp in range(1, n + 1):
+            if n % dp:
+                continue
+            rem = n // dp
+            for fsdp in range(1, rem + 1):
+                if rem % fsdp:
+                    continue
+                mp = rem // fsdp
+                if mp > self.max_mp:
+                    continue
+                out.append(PlanCandidate(dp=dp, fsdp=fsdp, mp=mp))
+        return out
+
+    def price(self, cand: PlanCandidate, prof: ModelProfile
+              ) -> PlanCandidate:
+        c = self.cluster
+        n_shard = cand.fsdp * cand.mp
+        # -- memory: params+grads+opt sharded by fsdp*mp; live
+        # activations assume per-layer rematerialization (the training
+        # step checkpoints between layers), so ONE layer's activations
+        # count. dp AND fsdp both split the batch (fsdp = data parallel
+        # with sharded state); mp splits hidden.
+        state_bytes = prof.param_bytes * (1 + prof.bytes_per_param_state)
+        act_live = prof.activation_bytes / max(prof.layer_count, 1)
+        mem = state_bytes / n_shard + act_live / self.n
+        cand.est_mem_bytes = mem
+        if mem > c.hbm_bytes:
+            cand.feasible = False
+            cand.reason = (f"est {mem/1e9:.1f}GB > HBM "
+                           f"{c.hbm_bytes/1e9:.1f}GB")
+        # -- compute: data/model-parallel FLOPs, degraded when mp
+        # shards the hidden dim below the MXU-efficient width (the
+        # known physics that makes tiny-model mp lose to dp even though
+        # its comm bytes look small)
+        width = max(prof.hidden / cand.mp, 1.0)
+        mp_eff = min(1.0, width / c.mp_min_width)
+        t_compute = prof.flops_per_step / self.n / \
+            (c.chip_flops * c.mfu_ceiling * mp_eff)
+        # -- communication per step (ring costs over ICI):
+        bw = c.ici_bandwidth
+        shard_param_bytes = prof.param_bytes / n_shard
+        t_dp = 2 * shard_param_bytes * _ring_factor(cand.dp) / bw
+        t_fsdp = 3 * (prof.param_bytes / cand.mp) * \
+            _ring_factor(cand.fsdp) / bw
+        # Megatron mp: two activation allreduces fwd + two bwd per layer
+        # over this dp-shard's [tokens, hidden] tensor
+        mp_bytes = (4 * prof.layer_count *
+                    (prof.batch_tokens / (cand.dp * cand.fsdp)) *
+                    prof.hidden * prof.act_dtype_bytes)
+        t_mp = mp_bytes * _ring_factor(cand.mp) / bw
+        # per-COLLECTIVE launch latency (ring transfers pipeline, so
+        # the launch cost is ~independent of ring length): dp's grad
+        # allreduce is one fused pair; fsdp gathers/scatters and mp
+        # allreduces fire per layer — at toy scale this fixed cost is
+        # why pure dp measures fastest
+        lat = c.ici_latency
+        t_lat = ((2 * lat if cand.dp > 1 else 0.0) +
+                 (3 * prof.layer_count * lat if cand.fsdp > 1 else 0.0) +
+                 (4 * prof.layer_count * lat if cand.mp > 1 else 0.0))
+        cand.est_step_time = t_compute + t_dp + t_fsdp + t_mp + t_lat
+        return cand
+
+    def plan(self, prof: ModelProfile, top_k: int = 1
+             ) -> List[PlanCandidate]:
+        priced = [self.price(c, prof) for c in self.candidates()]
+        feas = [c for c in priced if c.feasible]
+        if not feas:
+            detail = "; ".join(
+                f"dp{c.dp}/fsdp{c.fsdp}/mp{c.mp}: {c.reason}"
+                for c in priced[:6])
+            raise ValueError(
+                f"no feasible parallel config for {self.n} devices "
+                f"({detail}) — add devices or shrink the model/batch")
+        feas.sort(key=lambda c: c.est_step_time)
+        return feas[:top_k]
+
+    def plan_measured(self, prof: ModelProfile, trial_fn: Callable,
+                      top_k: int = 3) -> PlanCandidate:
+        """Time the analytic top-k with ``trial_fn(config_dict) ->
+        items/s`` (build_trial_runner's contract); failures (OOM et al)
+        are recorded and skipped like the reference's failed trials."""
+        best = None
+        for cand in self.plan(prof, top_k=top_k):
+            cfg = {"dp_degree": cand.dp, "fsdp_degree": cand.fsdp,
+                   "mp_degree": cand.mp}
+            try:
+                cand.measured_items_per_s = float(trial_fn(cfg))
+            except Exception as e:  # noqa: BLE001 — a failed trial is data
+                cand.feasible = False
+                cand.reason = f"trial failed: {type(e).__name__}: {e}"
+                continue
+            if best is None or cand.measured_items_per_s > \
+                    best.measured_items_per_s:
+                best = cand
+        if best is None:
+            raise RuntimeError("every trialed config failed")
+        return best

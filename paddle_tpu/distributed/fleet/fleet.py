@@ -109,6 +109,12 @@ class _Fleet:
             pcfg = getattr(strategy, "pipeline_configs", {}) or {}
             if str(pcfg.get("schedule_mode", "")).upper() in (
                     "ZB", "ZB-H1", "ZBH1"):
+                if getattr(model, "_num_virtual_stages", 1) > 1:
+                    raise ValueError(
+                        "schedule_mode='ZB-H1' assumes one contiguous "
+                        "stage per rank; it cannot drive a PipelineLayer "
+                        "with num_virtual_pipeline_stages > 1 (use the "
+                        "interleaved 1F1B runtime for VPP)")
                 # ref: passes/pipeline_scheduler_pass/pipeline_zero_bubble
                 # selected via pipeline_configs schedule_mode
                 from .pipeline_zero_bubble import PipelineParallelZeroBubble

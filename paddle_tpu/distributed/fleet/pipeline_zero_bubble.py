@@ -301,7 +301,10 @@ class PipelineParallelZeroBubble(PipelineParallel):
                     t = Tensor(jnp.zeros((1,), jnp.float32))
                     recv(t, src=next_rank, group=g)
                     gs[m] = t._data
-                    dx = P_["b_mid"](params, xs[m], gs[m])
+                    # the first stage has no upstream consumer for dx —
+                    # skip the whole input-grad program, keep the recv
+                    dx = (P_["b_mid"](params, xs[m], gs[m])
+                          if not self.is_first_stage else None)
                 if not self.is_first_stage:
                     send(Tensor(dx), dst=prev_rank, group=g)
             else:  # W — deferred weight grads from the stashed (x, g)

@@ -233,12 +233,19 @@ def dropout(x_spec, *rest):
 def conv(x_spec, w_spec, data_format="NCHW"):
     """Conv: batch sharding passes through, weights replicated, spatial
     dims unsharded (halo exchange is future work), input-channel
-    sharding rejected (it would leave partial sums). Layout-aware:
-    NCHW channel=1 / spatial=2,3; NHWC spatial=1,2 / channel=3."""
-    if x_spec is not None and len(x_spec) == 4:
+    sharding rejected (it would leave partial sums). data_format
+    defaults to NCHW, matching the conv ops' own default — pass
+    "NHWC"/"NLC" explicitly for channel-last layouts. Rank 3 (conv1d)
+    and rank 4 (conv2d) specs are both validated."""
+    if x_spec is not None and len(x_spec) in (3, 4):
         dims = list(x_spec)
-        spatial = (2, 3) if data_format == "NCHW" else (1, 2)
-        ch = 1 if data_format == "NCHW" else 3
+        channel_last = data_format in ("NHWC", "NLC", "NWC")
+        if len(dims) == 4:
+            spatial = (1, 2) if channel_last else (2, 3)
+            ch = 3 if channel_last else 1
+        else:
+            spatial = (1,) if channel_last else (2,)
+            ch = 2 if channel_last else 1
         if any(dims[i] is not None for i in spatial):
             raise ValueError(
                 "spatially-sharded conv needs halo exchange — "

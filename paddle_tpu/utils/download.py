@@ -67,9 +67,12 @@ def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
     import tempfile
     fd, tmp = tempfile.mkstemp(dir=root_dir, prefix=fname + ".part.")
     os.close(fd)
-    # mkstemp creates 0600; the cache is shared — restore umask-style
-    # permissions so other users/ranks can read the final file
-    os.chmod(tmp, 0o644)
+    # mkstemp creates 0600 regardless of umask; restore the
+    # umask-governed mode so a shared cache stays readable (and a
+    # restrictive umask stays respected)
+    um = os.umask(0)
+    os.umask(um)
+    os.chmod(tmp, 0o666 & ~um)
     try:
         import urllib.request
         with urllib.request.urlopen(url, timeout=60) as r, \

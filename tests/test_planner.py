@@ -1,0 +1,142 @@
+"""Static auto-parallel planner tests (ref: auto_parallel/static/engine
+planner + static/cost/ + auto_tuner prune/trial flow): candidate
+enumeration, memory pruning, cost-model preferences, the measured-trial
+pick, and Engine auto-planning end-to-end on the 8-virtual-device
+mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel.planner import (
+    Cluster, ModelProfile, Planner, profile_model)
+
+
+class TestCandidatesAndPricing:
+    def test_enumerates_factorizations(self):
+        cands = Planner(8).candidates()
+        shapes = {c.mesh_shape for c in cands}
+        assert (8, 1, 1) in shapes and (1, 8, 1) in shapes and \
+            (1, 1, 8) in shapes and (2, 2, 2) in shapes
+        for c in cands:
+            assert c.dp * c.fsdp * c.mp == 8
+
+    def test_small_model_prefers_pure_dp(self):
+        """Tiny model, plenty of memory: replication has the least
+        communication, so dp wins (the dryrun-Llama case)."""
+        prof = ModelProfile(param_bytes=10 * 2 ** 20,
+                            flops_per_step=1e12, batch_tokens=2048,
+                            hidden=256, layer_count=2)
+        best = Planner(8).plan(prof, top_k=1)[0]
+        assert best.mesh_shape == (8, 1, 1), best
+
+    def test_memory_prune_forces_sharding(self):
+        """A model whose optimizer state cannot replicate must come back
+        with fsdp*mp sharding enough to fit — the compile-free OOM
+        verdict."""
+        # 3B params bf16: state ~ 6GB * 11 = 66GB; fits only sharded 8x
+        prof = ModelProfile(param_bytes=6 * 10 ** 9,
+                            flops_per_step=1e15, batch_tokens=4096,
+                            hidden=4096, layer_count=32)
+        planner = Planner(8)
+        best = planner.plan(prof, top_k=1)[0]
+        assert best.fsdp * best.mp == 8, best
+        assert best.est_mem_bytes <= planner.cluster.hbm_bytes
+        # and every feasible candidate indeed fits
+        for c in planner.plan(prof, top_k=10):
+            assert c.est_mem_bytes <= planner.cluster.hbm_bytes
+
+    def test_infeasible_everywhere_raises(self):
+        prof = ModelProfile(param_bytes=40 * 10 ** 9,
+                            flops_per_step=1e15, batch_tokens=4096,
+                            hidden=8192, layer_count=48)
+        with pytest.raises(ValueError, match="no feasible"):
+            Planner(8).plan(prof)
+
+    def test_comm_model_penalizes_mp_for_long_activations(self):
+        """Huge activation traffic (long sequences, many layers) with a
+        small parameter footprint: mp's per-layer allreduces must price
+        above fsdp's param traffic."""
+        # 1B params bf16, fat hidden (no mp compute penalty), heavy
+        # activation traffic: fsdp's 3x param bytes < mp's per-layer
+        # activation allreduces, and replication cannot fit the state
+        prof = ModelProfile(param_bytes=2 * 10 ** 9,
+                            flops_per_step=1e15,
+                            batch_tokens=64 * 1024, hidden=8192,
+                            layer_count=64)
+        planner = Planner(8)
+        best = planner.plan(prof, top_k=1)[0]
+        assert best.mp == 1, best
+        assert best.fsdp > 1, best
+
+    def test_plan_measured_picks_trial_winner(self):
+        """The measured phase must return the argmax of the trial
+        throughputs, skipping failed trials (the reference's recorded
+        OOM trials)."""
+        prof = ModelProfile(param_bytes=10 * 2 ** 20,
+                            flops_per_step=1e12, batch_tokens=2048,
+                            hidden=256, layer_count=2)
+        calls = []
+
+        def trial(cfg):
+            calls.append(tuple(sorted(cfg.items())))
+            if cfg["dp_degree"] == 8:
+                raise MemoryError("pretend OOM")
+            return 100.0 * cfg["fsdp_degree"]  # fsdp-heaviest "wins"
+
+        best = Planner(8).plan_measured(prof, trial, top_k=3)
+        assert len(calls) == 3
+        assert best.measured_items_per_s == max(
+            100.0 * dict(c)["fsdp_degree"] for c in calls
+            if dict(c)["dp_degree"] != 8)
+
+
+class TestProfileModel:
+    def test_profile_counts_params_and_layers(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        m = nn.Sequential(*[nn.Linear(32, 32) for _ in range(4)])
+        prof = profile_model(m, batch_tokens=128)
+        n_params = 4 * (32 * 32 + 32)
+        assert prof.param_bytes == n_params * 4  # fp32
+        assert prof.flops_per_step == 6.0 * n_params * 128
+        assert prof.hidden == 32
+        assert prof.layer_count == 4  # numbered sequential blocks
+
+
+class TestEngineAutoPlan:
+    def test_engine_plans_and_trains_llama(self):
+        """Engine with strategy.auto and NO mesh: the planner must pick
+        the known-best config for the tiny dryrun Llama on 8 virtual
+        devices (pure dp — tiny model, comm-minimal), shard the model,
+        and train (VERDICT item 6's done-gate)."""
+        import jax
+
+        from paddle_tpu.distributed.auto_parallel.engine import (Engine,
+                                                                 Strategy)
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       LlamaPretrainingCriterion)
+        from paddle_tpu.models.llama import shard_llama
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        crit = LlamaPretrainingCriterion()
+        strat = Strategy()
+        strat.auto = {"enable": True,
+                      "shard_fn": lambda m, mesh: shard_llama(
+                          m, mesh, tp_axis="mp", fsdp_axis="fsdp")}
+        eng = Engine(model, lambda lg, lb: crit(lg, lb), opt,
+                     strategy=strat)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        data = [(ids, ids)] * 3
+        eng.fit(data, epochs=1)
+        assert eng.plan_choice is not None
+        # tiny model -> replication is comm-minimal: known best = dp=8
+        assert eng.plan_choice.mesh_shape == (8, 1, 1), eng.plan_choice
+        assert eng.mesh is not None
+        assert np.isfinite(eng.history["loss"]).all()
