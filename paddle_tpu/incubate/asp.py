@@ -17,9 +17,9 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["calculate_density", "check_sparsity", "create_mask",
-           "decorate", "prune_model", "set_excluded_layers",
-           "reset_excluded_layers"]
+__all__ = ["calculate_density", "check_mask_2d", "check_sparsity",
+           "create_mask", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers"]
 
 _excluded_layers: List[str] = []
 # id(param) -> (weakref(param), mask). The weakref guards against CPython
@@ -55,22 +55,128 @@ def calculate_density(x) -> float:
     return float(np.count_nonzero(arr)) / max(arr.size, 1)
 
 
-_MASK_ALGOS = ("mask_1d",)
+_MASK_ALGOS = ("mask_1d", "mask_2d_greedy", "mask_2d_best")
+
+
+def _blocks_2d(arr: np.ndarray, m: int):
+    """Zero-pad a 2-D array to multiples of m and tile it into
+    (n_blocks, m, m) blocks (row-major block order)."""
+    pad_r = (-arr.shape[0]) % m
+    pad_c = (-arr.shape[1]) % m
+    p = np.pad(arr, ((0, pad_r), (0, pad_c)))
+    rows, cols = p.shape
+    blocks = (p.reshape(rows // m, m, cols // m, m)
+              .transpose(0, 2, 1, 3).reshape(-1, m, m))
+    return blocks, (rows, cols)
+
+
+def _unblock_2d(blocks, padded_shape, orig_shape, m: int) -> np.ndarray:
+    rows, cols = padded_shape
+    out = (blocks.reshape(rows // m, cols // m, m, m)
+           .transpose(0, 2, 1, 3).reshape(rows, cols))
+    return out[:orig_shape[0], :orig_shape[1]]
+
+
+def _mask_2d_greedy(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """2-D n:m mask, greedy: per m x m block, admit entries in
+    descending |value| order while the entry's row and column each still
+    have < n kept entries (ref: utils.py get_mask_2d_greedy)."""
+    blocks, pshape = _blocks_2d(np.abs(mat), m)
+    n_blocks = len(blocks)
+    order = np.argsort(-blocks.reshape(n_blocks, -1), axis=1)
+    masks = np.zeros_like(blocks)
+    row_used = np.zeros((n_blocks, m), np.int64)
+    col_used = np.zeros((n_blocks, m), np.int64)
+    bidx = np.arange(n_blocks)
+    # vectorized across blocks: walk rank positions; at each rank every
+    # block admits its candidate iff that entry's row and column still
+    # have capacity (one candidate per block per rank, so plain fancy
+    # indexing — no duplicate-index hazard)
+    for rank in range(m * m):
+        i, j = np.divmod(order[:, rank], m)
+        ok = (row_used[bidx, i] < n) & (col_used[bidx, j] < n)
+        masks[bidx[ok], i[ok], j[ok]] = 1.0
+        row_used[bidx[ok], i[ok]] += 1
+        col_used[bidx[ok], j[ok]] += 1
+    return _unblock_2d(masks, pshape, mat.shape, m)
+
+
+_patterns_2d_cache: Dict[Tuple[int, int], np.ndarray] = {}
+
+
+def _valid_2d_patterns(n: int, m: int) -> np.ndarray:
+    """All m x m binary patterns with exactly n ones per row and at most
+    n per column, as a (P, m, m) array (ref: utils.py
+    _compute_valid_2d_patterns)."""
+    key = (n, m)
+    cached = _patterns_2d_cache.get(key)
+    if cached is not None:
+        return cached
+    if m > 6:
+        raise NotImplementedError(
+            f"mask_2d_best pattern enumeration is exponential in m "
+            f"(got m={m}); use mask_2d_greedy for m > 6")
+    import itertools
+    row_choices = []
+    for keep in itertools.combinations(range(m), n):
+        row = np.zeros(m)
+        row[list(keep)] = 1.0
+        row_choices.append(row)
+    pats: List[np.ndarray] = []
+
+    def _extend(chosen, col_sum):
+        if len(chosen) == m:
+            pats.append(np.stack(chosen))
+            return
+        # prune: remaining rows must still be able to fill every column
+        # to <= n without exceeding it
+        for row in row_choices:
+            new_sum = col_sum + row
+            if (new_sum <= n).all():
+                _extend(chosen + [row], new_sum)
+
+    _extend([], np.zeros(m))
+    out = np.stack(pats)
+    _patterns_2d_cache[key] = out
+    return out
+
+
+def _mask_2d_best(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """2-D n:m mask maximizing retained L1 magnitude: score every valid
+    pattern against each |block| and take the argmax (ref: utils.py
+    get_mask_2d_best; we score |values| so negative weights rank by
+    magnitude)."""
+    pats = _valid_2d_patterns(n, m)
+    blocks, pshape = _blocks_2d(np.abs(mat), m)
+    scores = blocks.reshape(len(blocks), -1) @ pats.reshape(len(pats), -1).T
+    masks = pats[np.argmax(scores, axis=1)]
+    return _unblock_2d(masks, pshape, mat.shape, m)
+
+
+def _as_2d(arr: np.ndarray) -> np.ndarray:
+    """Collapse leading dims so the 2-D mask algorithms see
+    (rows, last_dim) — the reduction (input-channel) dim stays minor."""
+    return arr.reshape(1, -1) if arr.ndim == 1 else \
+        arr.reshape(-1, arr.shape[-1])
 
 
 def create_mask(x, func_name: str = "mask_1d", n: int = 2,
                 m: int = 4) -> np.ndarray:
-    """n:m structured mask along the last dim: keep the n
-    largest-magnitude entries of every m consecutive weights
-    (ref: utils.py create_mask / get_mask_1d). The reference's 2-D
-    algorithms (mask_2d_greedy/best) are not implemented — fail loudly
-    rather than silently downgrade."""
+    """n:m structured mask (ref: utils.py create_mask): ``mask_1d``
+    keeps the n largest-magnitude entries of every m consecutive weights
+    along the last dim; ``mask_2d_greedy``/``mask_2d_best`` build m x m
+    block patterns with <= n survivors per row AND column (greedy
+    magnitude order vs exhaustive pattern search maximizing L1)."""
     if func_name not in _MASK_ALGOS:
         raise NotImplementedError(
             f"mask algorithm {func_name!r} not supported (available: "
-            f"{_MASK_ALGOS}); the reference's 2-D algorithms are a "
-            f"documented gap")
+            f"{_MASK_ALGOS})")
     arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    if func_name in ("mask_2d_greedy", "mask_2d_best"):
+        algo = _mask_2d_greedy if func_name == "mask_2d_greedy" \
+            else _mask_2d_best
+        mask2d = algo(_as_2d(arr.astype(np.float64)), n, m)
+        return mask2d.reshape(arr.shape).astype(arr.dtype)
     flat = arr.reshape(-1, arr.shape[-1])
     if arr.shape[-1] % m != 0:
         raise ValueError(
@@ -82,9 +188,32 @@ def create_mask(x, func_name: str = "mask_1d", n: int = 2,
     return mask.reshape(arr.shape).astype(arr.dtype)
 
 
-def check_sparsity(x, n: int = 2, m: int = 4) -> bool:
-    """True iff every m-group along the last dim has <= n nonzeros
-    (ref: utils.py check_sparsity)."""
+def check_mask_2d(x, n: int = 2, m: int = 4) -> bool:
+    """True iff every m x m block (zero-padded tiling of the collapsed
+    2-D view) has <= n nonzeros in each row and each column (ref:
+    utils.py check_mask_2d)."""
+    arr = _as_2d(np.asarray(x.numpy() if isinstance(x, Tensor) else x))
+    blocks, _ = _blocks_2d(arr, m)
+    nz = blocks != 0
+    return bool((nz.sum(axis=2) <= n).all() and (nz.sum(axis=1) <= n).all())
+
+
+def check_sparsity(x, n: int = 2, m: int = 4,
+                   func_name: str = "check_1d") -> bool:
+    """``check_1d``: every m-group along the last dim has <= n nonzeros;
+    ``check_2d``: the 2-D block property (ref: utils.py check_sparsity +
+    CheckMethod.get_checking_method). Mask-algo names are accepted and
+    mapped to their checking method, as the reference's
+    CheckMethod.get_checking_method does."""
+    to_check = {"check_1d": "check_1d", "mask_1d": "check_1d",
+                "check_2d": "check_2d", "mask_2d_greedy": "check_2d",
+                "mask_2d_best": "check_2d"}
+    if func_name not in to_check:
+        raise NotImplementedError(
+            f"unknown check {func_name!r} (available: "
+            f"{sorted(to_check)})")
+    if to_check[func_name] == "check_2d":
+        return check_mask_2d(x, n, m)
     arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
     if arr.shape[-1] % m != 0:
         return False

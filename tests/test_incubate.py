@@ -47,6 +47,52 @@ class TestASP:
         assert asp.calculate_density(m.weight.numpy()) == \
             pytest.approx(0.5)
 
+    def test_mask_2d_greedy(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        mask = asp.create_mask(x, "mask_2d_greedy")
+        assert mask.shape == x.shape
+        assert asp.check_mask_2d(mask)
+        # every 4x4 block keeps exactly 2 per row and per column
+        blocks = mask.reshape(2, 4, 2, 4).transpose(0, 2, 1, 3)
+        np.testing.assert_array_equal(blocks.sum(axis=-1), 2)
+        np.testing.assert_array_equal(blocks.sum(axis=-2), 2)
+
+    def test_mask_2d_best_beats_or_ties_greedy(self):
+        rng = np.random.default_rng(1)
+        for _ in range(5):
+            x = rng.normal(size=(4, 4)).astype(np.float64)
+            g = asp.create_mask(x, "mask_2d_greedy")
+            b = asp.create_mask(x, "mask_2d_best")
+            assert asp.check_mask_2d(b)
+            assert np.abs(x * b).sum() >= np.abs(x * g).sum() - 1e-9
+
+    def test_mask_2d_best_reference_example(self):
+        # the reference docstring's worked example (utils.py
+        # get_mask_2d_best): best retains L1=61 vs greedy's 56
+        mat = np.array([[2, 8, 9, 9], [9, 1, 3, 9],
+                        [5, 6, 3, 9], [2, 4, 6, 9]], np.float64)
+        g = asp.create_mask(mat, "mask_2d_greedy")
+        b = asp.create_mask(mat, "mask_2d_best")
+        # our greedy tie-break retains 57 (reference's own ordering: 56);
+        # best is the exhaustive optimum at 61 either way
+        assert (mat * g).sum() >= 56.0
+        assert (mat * b).sum() == pytest.approx(61.0)
+
+    def test_mask_2d_padding_nonmultiple(self):
+        x = np.arange(1, 31, dtype=np.float64).reshape(5, 6)
+        mask = asp.create_mask(x, "mask_2d_greedy")
+        assert mask.shape == x.shape
+        assert asp.check_sparsity(mask[:4, :4], func_name="check_2d")
+
+    def test_prune_model_2d_algo(self):
+        paddle.seed(0)
+        m = nn.Linear(8, 8)
+        asp.prune_model(m, mask_algo="mask_2d_best")
+        assert asp.check_mask_2d(m.weight.numpy())
+        assert asp.calculate_density(m.weight.numpy()) == \
+            pytest.approx(0.5)
+
     def test_excluded_layers(self):
         paddle.seed(0)
         m = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 4))
