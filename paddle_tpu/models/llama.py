@@ -331,8 +331,30 @@ class LlamaPretrainingCriterion(Layer):
 
     def forward(self, logits, labels):
         def f(lg, lb):
+            import jax
+            import jax.numpy as jnp
+
             from ..ops.fused_ce import fused_softmax_ce_mean
-            return fused_softmax_ce_mean(lg[:, :-1, :], lb[:, 1:])
+            # barrier ties label prep (and any reshard GSPMD inserts for
+            # it) into the logits' dependency chain: label-side
+            # collectives would otherwise be independent of the model's
+            # collective chain and can race it on the XLA:CPU in-process
+            # rendezvous (deadlock in the CP dryrun); on TPU the labels
+            # are tiny and the barrier costs nothing
+            lg, lb = jax.lax.optimization_barrier((lg, lb))
+            # shift the LABELS (tiny int array), not the logits: slicing
+            # lg[:, :-1] copies the whole [B, L, V] tensor (262 MB at
+            # the 1B-scale geometry) and leaves an odd L-1 chunk size;
+            # the final position is masked out via ignore_index instead
+            shifted = jnp.concatenate(
+                [lb[:, 1:], jnp.full((lb.shape[0], 1), -100, lb.dtype)],
+                axis=1)
+            # the dynamic valid count (inside fused CE) keeps padded
+            # batches correct: labels may already carry -100 positions,
+            # which must leave the mean's denominator too. Its reduction
+            # is serialized behind the barrier above, so it cannot race
+            # the model's collective chain.
+            return fused_softmax_ce_mean(lg, shifted, ignore_index=-100)
         return apply_op(f, logits, labels, op_name="causal_lm_loss")
 
 

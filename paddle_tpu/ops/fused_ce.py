@@ -32,25 +32,45 @@ def _chunks(seq_len: int, target: int = 256) -> int:
     return seq_len
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def fused_softmax_ce_mean(logits, labels, ignore_index=None):
+def _serial_chunks() -> bool:
+    """True on the CPU test backend, where chunk collectives must be
+    serialized through a loop (see the rendezvous note in _ce_fwd_impl)."""
+    return jax.default_backend() == "cpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_softmax_ce_mean(logits, labels, ignore_index=None,
+                          valid_count=None):
     """mean over positions of -log softmax(logits)[labels].
     logits: [B, L, V] (any float dtype), labels: [B, L] int.
     ``ignore_index``: positions with that label contribute nothing and
     are excluded from the mean's denominator (ref: cross_entropy
-    ignore_index semantics, python/paddle/nn/functional/loss.py)."""
-    loss, _, _ = _ce_fwd_impl(logits, labels, ignore_index)
+    ignore_index semantics, python/paddle/nn/functional/loss.py).
+    ``valid_count``: static count of non-ignored positions when the
+    caller knows it (e.g. the causal-LM shift masks exactly one position
+    per row) — skips the dynamic count, whose cross-device reduction is
+    an extra independent collective in sharded programs (it can race the
+    model's own collective chain on the CPU in-process communicator)."""
+    loss, _, _ = _ce_fwd_impl(logits, labels, ignore_index, valid_count)
     return loss
 
 
-def _ce_fwd_impl(logits, labels, ignore_index):
+def _ce_fwd_impl(logits, labels, ignore_index, valid_count=None):
     b, l, v = logits.shape
     c = _chunks(l)
-    lg = logits.reshape(b, l // c, c, v)
-    lb = labels.reshape(b, l // c, c)
 
-    def chunk(carry, xs):
-        lg_c, lb_c = xs  # [B, c, V], [B, c]
+    # Chunk loop. On TPU: statically unrolled with static slices — a
+    # scan would need the chunk axis leading, and that swapaxes
+    # materializes a full [B, L, V] transpose copy (262 MB at the Llama
+    # headline shape), while a fori_loop costs a per-iteration sync
+    # (~0.3 ms each). Unrolled, each chunk's fp32 intermediates fuse
+    # into their own reduce fusion and nothing [B, L, V]-sized exists in
+    # fp32. On the CPU test backend the chunks must run through a
+    # fori_loop instead: unrolled chunks over sharded logits are
+    # INDEPENDENT collective chains, and XLA:CPU's in-process rendezvous
+    # deadlocks when independent collectives race (real TPU collectives
+    # don't have this hazard).
+    def chunk_stats(lg_c, lb_c):
         f = lg_c.astype(jnp.float32)
         lse = jax.nn.logsumexp(f, axis=-1)               # [B, c]
         idx = lb_c.astype(jnp.int32)
@@ -60,13 +80,33 @@ def _ce_fwd_impl(logits, labels, ignore_index):
         per = lse - tgt
         if ignore_index is not None:
             per = jnp.where(lb_c == ignore_index, 0.0, per)
-        return carry + jnp.sum(per), lse
+        return jnp.sum(per), lse
 
-    total, lses = jax.lax.scan(
-        chunk, jnp.float32(0.0),
-        (jnp.swapaxes(lg, 0, 1), jnp.swapaxes(lb, 0, 1)))
-    lse = jnp.swapaxes(lses, 0, 1).reshape(b, l)
-    if ignore_index is None:
+    if _serial_chunks():
+        def body(i, carry):
+            total, lse_acc = carry
+            s, lse = chunk_stats(
+                jax.lax.dynamic_slice_in_dim(logits, i * c, c, axis=1),
+                jax.lax.dynamic_slice_in_dim(labels, i * c, c, axis=1))
+            lse_acc = jax.lax.dynamic_update_slice_in_dim(
+                lse_acc, lse, i * c, axis=1)
+            return total + s, lse_acc
+        total, lse = jax.lax.fori_loop(
+            0, l // c, body,
+            (jnp.float32(0.0), jnp.zeros((b, l), jnp.float32)))
+    else:
+        total = jnp.float32(0.0)
+        lses = []
+        for i in range(l // c):
+            s, lse = chunk_stats(
+                jax.lax.slice_in_dim(logits, i * c, (i + 1) * c, axis=1),
+                jax.lax.slice_in_dim(labels, i * c, (i + 1) * c, axis=1))
+            total = total + s
+            lses.append(lse)
+        lse = jnp.concatenate(lses, axis=1)
+    if valid_count is not None:
+        n_valid = jnp.float32(max(int(valid_count), 1))
+    elif ignore_index is None:
         n_valid = jnp.float32(b * l)
     else:
         n_valid = jnp.maximum(
@@ -74,19 +114,19 @@ def _ce_fwd_impl(logits, labels, ignore_index):
     return total / n_valid, lse, n_valid
 
 
-def _ce_vjp_fwd(logits, labels, ignore_index):
-    loss, lse, n_valid = _ce_fwd_impl(logits, labels, ignore_index)
+def _ce_vjp_fwd(logits, labels, ignore_index, valid_count=None):
+    loss, lse, n_valid = _ce_fwd_impl(logits, labels, ignore_index,
+                                      valid_count)
     return loss, (logits, labels, lse, n_valid)
 
 
-def _ce_vjp_bwd(ignore_index, res, g):
+def _ce_vjp_bwd(ignore_index, valid_count, res, g):
     logits, labels, lse, n_valid = res
     b, l, v = logits.shape
     c = _chunks(l)
     scale = g / n_valid
 
-    def chunk(_, xs):
-        lg_c, lb_c, lse_c = xs
+    def chunk_grad(lg_c, lb_c, lse_c):
         p = jnp.exp(lg_c.astype(jnp.float32) - lse_c[..., None])
         idx = lb_c.astype(jnp.int32)
         if ignore_index is not None:
@@ -95,14 +135,25 @@ def _ce_vjp_bwd(ignore_index, res, g):
         d = (p - onehot) * scale
         if ignore_index is not None:
             d = jnp.where((lb_c == ignore_index)[..., None], 0.0, d)
-        return None, d.astype(logits.dtype)
+        return d.astype(logits.dtype)
 
-    _, dl = jax.lax.scan(
-        chunk, None,
-        (jnp.swapaxes(logits.reshape(b, l // c, c, v), 0, 1),
-         jnp.swapaxes(labels.reshape(b, l // c, c), 0, 1),
-         jnp.swapaxes(lse.reshape(b, l // c, c), 0, 1)))
-    return jnp.swapaxes(dl, 0, 1).reshape(b, l, v), None
+    if _serial_chunks():  # see _ce_fwd_impl: XLA:CPU rendezvous hazard
+        def body(i, dl):
+            d = chunk_grad(
+                jax.lax.dynamic_slice_in_dim(logits, i * c, c, axis=1),
+                jax.lax.dynamic_slice_in_dim(labels, i * c, c, axis=1),
+                jax.lax.dynamic_slice_in_dim(lse, i * c, c, axis=1))
+            return jax.lax.dynamic_update_slice_in_dim(dl, d, i * c,
+                                                       axis=1)
+        return jax.lax.fori_loop(
+            0, l // c, body, jnp.zeros((b, l, v), logits.dtype)), None
+    chunks = []
+    for i in range(l // c):
+        chunks.append(chunk_grad(
+            jax.lax.slice_in_dim(logits, i * c, (i + 1) * c, axis=1),
+            jax.lax.slice_in_dim(labels, i * c, (i + 1) * c, axis=1),
+            jax.lax.slice_in_dim(lse, i * c, (i + 1) * c, axis=1)))
+    return jnp.concatenate(chunks, axis=1), None
 
 
 fused_softmax_ce_mean.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
