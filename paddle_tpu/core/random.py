@@ -25,6 +25,7 @@ class Generator:
             self._seed = int(seed)
             self._key = jax.random.key(int(seed))
             self._counter = 0
+        _bump_seed_epoch()
         return self
 
     def initial_seed(self) -> int:
@@ -42,6 +43,7 @@ class Generator:
     def set_state(self, state):
         self._seed, self._counter = state
         self._key = jax.random.key(self._seed)
+        _bump_seed_epoch()
 
 
 class TracedKeyStream:
@@ -77,6 +79,20 @@ class key_stream:
         return False
 
 
+# bumped on every re-seed or state restore (manual_seed/set_state/
+# set_rng_state): holders of a derived device-side stream (the compiled
+# train steps cache a root key + counter on device) compare this to
+# know the global stream was reset and they must re-derive. Bumped for
+# ANY generator, not just the default — a spurious bump only costs one
+# extra key fold, a missed one silently breaks reproducibility.
+_seed_epoch = 0
+
+
+def _bump_seed_epoch():
+    global _seed_epoch
+    _seed_epoch += 1
+
+
 _default_generator = Generator(0)
 
 
@@ -84,6 +100,10 @@ def seed(value: int) -> Generator:
     """Global seed for eager random ops. ref: python/paddle/framework/random.py"""
     _default_generator.manual_seed(value)
     return _default_generator
+
+
+def seed_epoch() -> int:
+    return _seed_epoch
 
 
 def default_generator() -> Generator:

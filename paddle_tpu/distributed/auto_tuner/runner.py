@@ -79,20 +79,22 @@ def build_trial_runner(make_model: Callable[[], object],
         # time through the SAME executable (no second compile); donated
         # buffers force threading the state forward between calls
         import jax
-        key = jax.random.key(0)
         opt_state = step._opt_state
         import jax.numpy as jnp
         lr = jnp.float32(0.0)
+        rng = (jax.random.key(0), jnp.uint32(0))
 
-        def one(params, buffers, opt_state):
-            return compiled(params, buffers, opt_state, lr, key, b, labels)
+        def one(params, buffers, opt_state, rng):
+            return compiled(params, buffers, opt_state, lr, rng, b,
+                            labels)
 
-        loss, params, buffers, opt_state = one(params, buffers, opt_state)
+        loss, params, buffers, opt_state, rng = one(params, buffers,
+                                                    opt_state, rng)
         float(loss)  # warm + barrier
         t0 = time.perf_counter()
         for _ in range(steps):
-            loss, params, buffers, opt_state = one(params, buffers,
-                                                   opt_state)
+            loss, params, buffers, opt_state, rng = one(
+                params, buffers, opt_state, rng)
         float(loss)
         dt = (time.perf_counter() - t0) / steps
         # donation consumed the step's original param/buffer/opt-state

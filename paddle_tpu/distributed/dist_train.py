@@ -45,6 +45,13 @@ class DistTrainStep:
         self._opt_state = None
         self._jitted = None
         self._donate = donate
+        # device-resident RNG (root key + counter) and lr cache: a
+        # per-step key upload / lr DevicePut each cost a host->device
+        # transfer (measured ~3 ms/step over the test tunnel)
+        self._rng = None
+        self._rng_epoch = None
+        self._lr_host = None
+        self._lr_dev = None
         # gradient merge (ref: passes/auto_parallel_gradient_merge.py):
         # the global batch is split into accumulate_steps micro-batches,
         # grads averaged inside ONE compiled step via lax.scan, then a
@@ -78,7 +85,9 @@ class DistTrainStep:
 
         acc = self.accumulate_steps
 
-        def step_fn(params, buffers, opt_state, lr, key, batch, labels):
+        def step_fn(params, buffers, opt_state, lr, rng, batch, labels):
+            root, count = rng
+            key = jax.random.fold_in(root, count)
             train_p = {k: v for k, v in params.items() if k in trainable}
             frozen_p = {k: v for k, v in params.items()
                         if k not in trainable}
@@ -140,9 +149,12 @@ class DistTrainStep:
                                            opt_state[k], lr)
                 new_params[k] = new_p
                 new_opt[k] = new_s
-            return loss, new_params, new_buffers, new_opt
+            return (loss, new_params, new_buffers, new_opt,
+                    (root, count + jnp.uint32(1)))
 
-        donate = (0, 2) if self._donate else ()
+        # buffers (argnum 1) donated as well — without aliasing, the
+        # per-step buffer updates (BN stats etc.) force device copies
+        donate = (0, 1, 2, 4) if self._donate else ()
         self._jitted = jax.jit(step_fn, donate_argnums=donate)
 
     # -- checkpoint ---------------------------------------------------------
@@ -215,10 +227,10 @@ class DistTrainStep:
         buffers = {k: t._data for k, t in self._swap.buffers.items()}
         # fixed probe key: a diagnostic must not advance the global RNG
         # stream (seed-fixed training after a stats query stays identical)
-        probe_key = jax.random.key(0)
+        probe_rng = (jax.random.key(0), jnp.uint32(0))
         compiled = self._jitted.lower(
             params, buffers, self._opt_state, jnp.float32(0.0),
-            probe_key, batch, labels).compile()
+            probe_rng, batch, labels).compile()
         mem = compiled.memory_analysis()
         if return_compiled:
             return mem, compiled, (params, buffers, batch, labels)
@@ -244,10 +256,21 @@ class DistTrainStep:
         labels = tuple(raw[len(raw) - num_labels:]) if num_labels else ()
         params = {k: t._data for k, t in self._params.items()}
         buffers = {k: t._data for k, t in self._swap.buffers.items()}
-        lr = jnp.float32(self.optimizer.get_lr())
-        key = random_mod.next_key()
-        loss, new_params, new_buffers, new_opt = self._jitted(
-            params, buffers, self._opt_state, lr, key, batch, labels)
+        if self._rng is None or \
+                self._rng_epoch != random_mod.seed_epoch():
+            # ONE draw from the global stream seeds this step's
+            # device-side stream: distinct step objects stay on distinct
+            # streams, the stream follows paddle.seed, and a re-seed
+            # mid-run (epoch bump) re-derives it
+            self._rng = (random_mod.next_key(), jnp.uint32(0))
+            self._rng_epoch = random_mod.seed_epoch()
+        lr_now = float(self.optimizer.get_lr())
+        if self._lr_host != lr_now:
+            self._lr_dev = jnp.float32(lr_now)
+            self._lr_host = lr_now
+        loss, new_params, new_buffers, new_opt, self._rng = self._jitted(
+            params, buffers, self._opt_state, self._lr_dev, self._rng,
+            batch, labels)
         for k, t in self._params.items():
             t._data = new_params[k]
         for k, t in self._swap.buffers.items():
