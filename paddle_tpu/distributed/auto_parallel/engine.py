@@ -107,49 +107,101 @@ class Engine:
                          3: ShardingStage3}[int(sh.get("stage", 1))]
                 self.optimizer = shard_optimizer(self.optimizer,
                                                  stage(self.mesh))
+        rc = (s.recompute if isinstance(s.recompute, dict)
+              else vars(s.recompute))
+        if rc.get("enable"):
+            self._auto_recompute(min_repeat=int(rc.get("min_repeat", 2)))
         gm = (s.gradient_merge if isinstance(s.gradient_merge, dict)
               else vars(s.gradient_merge))
         self._acc = int(gm.get("k_steps", 1)) if gm.get("enable") else 1
 
+    def _auto_recompute(self, min_repeat: int = 2):
+        """Auto segment picking (ref: passes/auto_parallel_recompute.py,
+        which selects segments on the static IR): the largest-parameter
+        family of repeated same-class sibling blocks (transformer
+        layers, Sequential stages) becomes the recompute segment set;
+        each member's forward is wrapped so its activations
+        re-materialize during backward (jax.checkpoint under the
+        compiled step). Returns the wrapped layers."""
+        from ..fleet.utils.recompute import recompute as rc_fn
+
+        best = None
+        parents = [self.model] + [l for _, l in
+                                  self.model.named_sublayers()]
+        for parent in parents:
+            groups: dict = {}
+            for _, child in parent.named_children():
+                groups.setdefault(type(child).__name__, []).append(child)
+            for members in groups.values():
+                if len(members) < min_repeat:
+                    continue
+                pc = sum(int(np.prod(p.shape)) for m in members
+                         for p in m.parameters())
+                if pc and (best is None or pc > best[0]):
+                    best = (pc, members)
+        if best is None:
+            return []
+        for layer in best[1]:
+            if getattr(layer, "_recompute_wrapped", False):
+                continue
+            inner = layer.forward
+
+            def fwd(*a, __inner=inner, __layer=layer, **kw):
+                return rc_fn(__layer, *a, forward_fn=__inner, **kw)
+
+            layer.forward = fwd
+            layer._recompute_wrapped = True
+        return best[1]
+
     def plan(self, sample_batch, n_devices: Optional[int] = None,
-             cluster=None, measured: bool = False):
+             cluster=None, trial_fn: Optional[Callable] = None):
         """Choose the parallel config (ref: static engine planner,
         static/cost/): profile the model, search mesh factorizations,
         build the winning mesh, and shard the model onto it. Called
         automatically by fit() when strategy.auto.enable and no mesh
         was given; callable directly for inspection (returns the
-        chosen PlanCandidate)."""
+        chosen PlanCandidate). ``cluster``/``n_devices``/``trial_fn``
+        may also be supplied through the strategy.auto dict so the
+        fit() path can reach them. With a ``trial_fn(config_dict) ->
+        items/s`` the analytic top-3 are timed and the measured winner
+        is taken (ref: static engine's tuning mode)."""
         import jax
         import numpy as np
 
         from ..process_mesh import ProcessMesh
         from .planner import Planner, profile_model
 
-        n = n_devices or len(jax.devices())
-        first = sample_batch[0] if isinstance(
-            sample_batch, (tuple, list)) else sample_batch
-        arr = np.asarray(first._data if isinstance(first, Tensor)
-                         else first)
-        batch_tokens = int(np.prod(arr.shape[:2])) if arr.ndim >= 2 \
-            else int(arr.shape[0])
         auto = (self.strategy.auto if isinstance(self.strategy.auto, dict)
                 else vars(self.strategy.auto))
+        n = n_devices or auto.get("n_devices") or len(jax.devices())
+        cluster = cluster if cluster is not None else auto.get("cluster")
+        trial_fn = trial_fn if trial_fn is not None \
+            else auto.get("trial_fn")
+        first = sample_batch[0] if isinstance(
+            sample_batch, (tuple, list)) else sample_batch
+        # shape only — np.asarray would pull the whole (possibly
+        # device-resident) batch to the host
+        shape = (first._data.shape if isinstance(first, Tensor)
+                 else np.shape(first))
+        batch_tokens = int(np.prod(shape[:2])) if len(shape) >= 2 \
+            else int(shape[0])
         prof = profile_model(self.model, batch_tokens,
                              layer_count=auto.get("layer_count"))
-        planner = Planner(n, cluster=cluster,
-                          max_mp=auto.get("max_mp"))
-        if measured or auto.get("measured"):
-            raise NotImplementedError(
-                "measured planning needs the caller-provided trial "
-                "closures (build_trial_runner model/batch factories); "
-                "use Planner.plan_measured directly for that flow")
-        best = planner.plan(prof, top_k=1)[0]
+        shard_fn = auto.get("shard_fn") or self._shard_fn
+        # tensor parallelism needs model knowledge (column/row splits):
+        # without a shard_fn the fallback only shards along fsdp, so an
+        # mp>1 plan would be priced against memory it cannot realize
+        max_mp = (auto.get("max_mp") if shard_fn is not None else 1)
+        planner = Planner(n, cluster=cluster, max_mp=max_mp)
+        if trial_fn is not None:
+            best = planner.plan_measured(prof, trial_fn)
+        else:
+            best = planner.plan(prof, top_k=1)[0]
         self.plan_choice = best
         dims = [d for d in best.mesh_shape]
         mesh = ProcessMesh(
             np.arange(n).reshape(dims), dim_names=["dp", "fsdp", "mp"])
         self.mesh = mesh
-        shard_fn = auto.get("shard_fn") or self._shard_fn
         if shard_fn is not None:
             # model-aware placements (tp column/row splits need model
             # knowledge, e.g. models.llama.shard_llama)

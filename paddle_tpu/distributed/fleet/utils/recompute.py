@@ -19,16 +19,20 @@ from ....nn.layer import Layer
 __all__ = ["recompute"]
 
 
-def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
+def recompute(function, *args, preserve_rng_state: bool = True,
+              forward_fn=None, **kwargs):
     """Run ``function(*args)`` with recompute-in-backward semantics.
 
     function: a Layer (its parameters keep receiving gradients — they are
     threaded through the checkpointed program, not captured as constants)
-    or a pure callable over Tensors.
+    or a pure callable over Tensors. ``forward_fn`` overrides the
+    Layer's callable (used by Engine's auto-recompute pass, which
+    replaces ``layer.forward`` with a recompute wrapper and must hand
+    the ORIGINAL forward here to avoid recursing into itself).
     """
     if isinstance(function, Layer):
         from ....jit.api import functionalize
-        apply, params0, buffers0 = functionalize(function)
+        apply, params0, buffers0 = functionalize(function, forward_fn)
         names = list(params0)
         named = dict(function.named_parameters())
         param_tensors = [named[n] for n in names]

@@ -235,17 +235,18 @@ def conv(x_spec, w_spec, data_format="NCHW"):
     dims unsharded (halo exchange is future work), input-channel
     sharding rejected (it would leave partial sums). data_format
     defaults to NCHW, matching the conv ops' own default — pass
-    "NHWC"/"NLC" explicitly for channel-last layouts. Rank 3 (conv1d)
-    and rank 4 (conv2d) specs are both validated."""
-    if x_spec is not None and len(x_spec) in (3, 4):
+    "NHWC"/"NLC"/"NDHWC" explicitly for channel-last layouts. Ranks 3-5
+    (conv1d/2d/3d) are all validated."""
+    if x_spec is not None and len(x_spec) >= 3:
         dims = list(x_spec)
-        channel_last = data_format in ("NHWC", "NLC", "NWC")
-        if len(dims) == 4:
-            spatial = (1, 2) if channel_last else (2, 3)
-            ch = 3 if channel_last else 1
+        channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+        ndim = len(dims)
+        if channel_last:
+            ch = ndim - 1
+            spatial = tuple(range(1, ndim - 1))
         else:
-            spatial = (1,) if channel_last else (2,)
-            ch = 2 if channel_last else 1
+            ch = 1
+            spatial = tuple(range(2, ndim))
         if any(dims[i] is not None for i in spatial):
             raise ValueError(
                 "spatially-sharded conv needs halo exchange — "

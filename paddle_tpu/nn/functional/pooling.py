@@ -53,14 +53,16 @@ import functools as _functools
 
 
 @_functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
-def _maxpool_ncx(a, k, s, pads):
-    """Channel-first max pool with a gather/scatter backward.
+def _maxpool_cvjp(a, k, s, pads):
+    """Channel-first max pool with a slice/pad backward.
 
     XLA differentiates reduce_window(max) into SelectAndScatter, which
     runs on the TPU scalar core — measured 300x slower than the forward
-    (14.5s vs 48ms on ResNet-50's stem pool at batch 128). The custom
-    backward recomputes per-window argmax through static gather tables
-    and scatter-adds the cotangent: plain vectorized gathers, VPU speed.
+    (14.5s vs 48ms on ResNet-50's stem pool at batch 128 in NCHW). In
+    NHWC the situation inverts: SelectAndScatter is lane-parallel there
+    (~0.8 ms/step on ResNet-50) while this slice/pad backward measured
+    1671 vs 2421 img/s end-to-end — so only the channel-first layout
+    routes here (see the dispatch in _pool).
     """
     window = (1, 1) + k
     strides = (1, 1) + s
@@ -71,11 +73,11 @@ def _maxpool_ncx(a, k, s, pads):
         [(0, 0), (0, 0)] + [tuple(p) for p in pads])
 
 
-def _maxpool_ncx_fwd(a, k, s, pads):
-    return _maxpool_ncx(a, k, s, pads), a
+def _maxpool_cvjp_fwd(a, k, s, pads):
+    return _maxpool_cvjp(a, k, s, pads), a
 
 
-def _maxpool_ncx_bwd(k, s, pads, a, g):
+def _maxpool_cvjp_bwd(k, s, pads, a, g):
     """Backward from shifted strided slices + dilated pads only — no
     gather, no scatter (both serialize on TPU at these shapes, like the
     SelectAndScatter this replaces). For each window offset: compare the
@@ -87,7 +89,7 @@ def _maxpool_ncx_bwd(k, s, pads, a, g):
            else jnp.iinfo(a.dtype).min)
     full_pad = [(0, 0), (0, 0)] + [tuple(p) for p in pads]
     ap = jnp.pad(a, full_pad, constant_values=neg)
-    out = _maxpool_ncx(a, k, s, pads)
+    out = _maxpool_cvjp(a, k, s, pads)
     out_sp = out.shape[2:]
     taken = jnp.zeros(out.shape, bool)
     dxp = jnp.zeros(ap.shape, jnp.float32)
@@ -112,7 +114,7 @@ def _maxpool_ncx_bwd(k, s, pads, a, g):
     return (dx.astype(g.dtype),)
 
 
-_maxpool_ncx.defvjp(_maxpool_ncx_fwd, _maxpool_ncx_bwd)
+_maxpool_cvjp.defvjp(_maxpool_cvjp_fwd, _maxpool_cvjp_bwd)
 
 
 def _pool(x, kernel, stride, padding, nd, data_format, reducer, init,
@@ -148,10 +150,19 @@ def _pool(x, kernel, stride, padding, nd, data_format, reducer, init,
                         full[spatial_off + i] = (lo, hi + (s[i] - rem))
             pad_cfg = full
         if reducer == "max":
+            # custom-VJP path only for channel-first: NCHW
+            # SelectAndScatter grad is catastrophic on the scalar core
+            # (14.5 s vs 48 ms at ResNet stem shapes) while the slice/pad
+            # backward is fast. In NHWC the situation inverts — XLA's
+            # SelectAndScatter is lane-parallel there (~0.8 ms/step on
+            # ResNet-50) and the 9-offset slice/pad backward measured
+            # 1671 vs 2421 img/s end-to-end, so NHWC keeps the native
+            # gradient.
             if not channel_last and not isinstance(pad_cfg, str):
-                # custom-VJP path: avoids the SelectAndScatter gradient
-                out = _maxpool_ncx(a, k, s,
-                                   tuple(tuple(p) for p in pad_cfg[2:]))
+                sp_pads = tuple(
+                    tuple(p) for p in
+                    pad_cfg[spatial_off:spatial_off + nd])
+                out = _maxpool_cvjp(a, k, s, sp_pads)
             else:
                 out = jax.lax.reduce_window(
                     a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)

@@ -23,6 +23,11 @@ WEIGHTS_HOME = os.environ.get(
     "PADDLE_TPU_WEIGHTS_HOME",
     osp.expanduser("~/.cache/paddle_tpu/weights"))
 
+# probed once at import (single-threaded): os.umask is process-wide, so
+# toggling it per-download would race any other thread creating files
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
 
 def _md5check(fullname: str, md5sum: str | None = None) -> bool:
     """ref: download.py _md5check — streaming md5 of the file."""
@@ -70,9 +75,7 @@ def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
     # mkstemp creates 0600 regardless of umask; restore the
     # umask-governed mode so a shared cache stays readable (and a
     # restrictive umask stays respected)
-    um = os.umask(0)
-    os.umask(um)
-    os.chmod(tmp, 0o666 & ~um)
+    os.chmod(tmp, 0o666 & ~_UMASK)
     try:
         import urllib.request
         with urllib.request.urlopen(url, timeout=60) as r, \

@@ -135,6 +135,48 @@ class TestEngine:
         ev = eng.evaluate(data)
         assert ev["loss"] is not None and ev["loss"] < hist["loss"][0]
 
+    def test_auto_recompute_picks_repeated_blocks(self, rng):
+        """strategy.recompute.enable wraps the largest repeated-block
+        family (the reference's auto segment picking,
+        passes/auto_parallel_recompute.py) and numerics match the
+        unwrapped model."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, x):
+                return paddle.nn.functional.relu(self.fc(x))
+
+        def make():
+            paddle.seed(7)
+            m = nn.Sequential(Block(), Block(), Block(), nn.Linear(8, 1))
+            opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=m.parameters())
+            return m, opt
+
+        X = rng.normal(size=(16, 8)).astype(np.float32)
+        y = rng.normal(size=(16, 1)).astype(np.float32)
+        loss_fn = lambda o, l: ((o - l) ** 2).mean()  # noqa: E731
+
+        m1, o1 = make()
+        eng = Engine(model=m1, loss=loss_fn, optimizer=o1,
+                     strategy=Strategy(recompute={"enable": True}))
+        h1 = eng.fit([(X, y)], epochs=3)
+        # the three Blocks (largest repeated family) got wrapped; the
+        # lone tail Linear did not
+        assert all(getattr(b, "_recompute_wrapped", False)
+                   for b in [m1[0], m1[1], m1[2]])
+        assert not getattr(m1[3], "_recompute_wrapped", False)
+
+        m2, o2 = make()
+        eng2 = Engine(model=m2, loss=loss_fn, optimizer=o2)
+        h2 = eng2.fit([(X, y)], epochs=3)
+        np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=2e-4)
+
     def test_save_load_roundtrip(self, rng, tmp_path):
         import paddle_tpu.nn as nn
         from paddle_tpu.distributed.auto_parallel import Engine
