@@ -229,15 +229,32 @@ def wide_resnet101_2(pretrained=False, **kwargs):
                    arch="wide_resnet101_2", **kwargs)
 
 
-def resnext50_32x4d(pretrained=False, **kwargs):
-    kwargs["groups"] = 32
+def _resnext(depth, groups, pretrained, **kwargs):
+    kwargs["groups"] = groups
     kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 50, pretrained,
-                   arch="resnext50_32x4d", **kwargs)
+    return _resnet(BottleneckBlock, depth, pretrained,
+                   arch=f"resnext{depth}_{groups}x4d", **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext(50, 32, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, pretrained, **kwargs)
 
 
 def resnext101_64x4d(pretrained=False, **kwargs):
-    kwargs["groups"] = 64
-    kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 101, pretrained,
-                   arch="resnext101_64x4d", **kwargs)
+    return _resnext(101, 64, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, pretrained, **kwargs)

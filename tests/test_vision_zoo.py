@@ -130,3 +130,33 @@ def test_densenet_train_step_decreases_loss():
         opt.clear_grad()
         losses.append(float(loss.item()))
     assert np.isfinite(losses).all()
+
+
+def test_resnext_variants_forward():
+    # all six reference resnext factories exist; spot-run the smallest
+    for name in ("resnext50_32x4d", "resnext50_64x4d",
+                 "resnext101_32x4d", "resnext101_64x4d",
+                 "resnext152_32x4d", "resnext152_64x4d"):
+        assert hasattr(M, name), name
+    m = M.resnext50_32x4d(num_classes=4)
+    out = m(_img())
+    assert out.shape == [1, 4]
+
+
+def test_models_all_matches_reference_surface():
+    import ast
+    ref = ("/root/reference/python/paddle/vision/models/__init__.py")
+    import os
+    if not os.path.exists(ref):
+        pytest.skip("reference tree unavailable")
+    tree = ast.parse(open(ref).read())
+    ref_all = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    ref_all = [ast.literal_eval(e)
+                               for e in node.value.elts]
+    assert ref_all, "no __all__ found in reference"
+    missing = [n for n in ref_all if n not in M.__all__]
+    assert missing == [], f"vision.models missing: {missing}"
