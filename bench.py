@@ -303,18 +303,28 @@ def bench_moe_dispatch():
     from paddle_tpu.incubate.moe_dispatch import moe_forward_indices
 
     if _on_tpu():
-        T, E, H, F, steps = 8192, 16, 1024, 4096, 8
+        # 32K tokens: an expert-parallel global batch, and the regime
+        # the index path exists for — dense one-hot dispatch/combine
+        # einsums are quadratic in T (~T * E*C * H with E*C ~ 2.5T), so
+        # tiny-T measurements flatter the dense algebra instead of
+        # measuring the scalable path (MoELayer's dispatch_mode="auto"
+        # routes small batches to dense for exactly that reason)
+        T, E, H, F, steps = 32768, 16, 1024, 4096, 6
     else:
         T, E, H, F, steps = 64, 4, 16, 32, 2
     cap = max(1, int(1.25 * T * 2 / E))
     rng = np.random.default_rng(0)
+    # bf16 activations/weights, like every other workload here (and the
+    # reference's fp16 CUTLASS MoE GEMM); gate logits stay fp32. The
+    # grouped-matmul kernel accumulates in fp32 either way.
+    wdt = jnp.bfloat16 if _on_tpu() else jnp.float32
     tokens = jnp.asarray(rng.standard_normal((T, H)).astype(np.float32)
-                         * 0.1)
+                         * 0.1, wdt)
     gw = jnp.asarray(rng.standard_normal((H, E)).astype(np.float32))
     wi = jnp.asarray(rng.standard_normal((E, H, F)).astype(np.float32)
-                     * 0.02)
+                     * 0.02, wdt)
     wo = jnp.asarray(rng.standard_normal((E, F, H)).astype(np.float32)
-                     * 0.02)
+                     * 0.02, wdt)
 
     def dense_fwd(tk, wi_, wo_):
         logits = tk @ gw
@@ -332,7 +342,8 @@ def bench_moe_dispatch():
         @jax.jit
         def f(tk, wi_, wo_):
             def loss(wi2, wo2):
-                return jnp.sum(fwd(tk, wi2, wo2) ** 2)
+                out = fwd(tk, wi2, wo2).astype(jnp.float32)
+                return jnp.sum(out ** 2)
             l, g = jax.value_and_grad(loss, argnums=(0, 1))(wi_, wo_)
             return l, g
         return f

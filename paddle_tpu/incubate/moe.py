@@ -99,6 +99,17 @@ def _gshard_dispatch(gate_logits, top_k, capacity):
     return combine, dispatch, aux_loss
 
 
+# dispatch_mode="auto" crossover (tokens per forward): below this the
+# dense one-hot algebra's quadratic-in-T einsums still win on the MXU;
+# above it the linear index/grouped-matmul path wins. Measured on v5e
+# at top_k=2, capacity_factor=1.25 (dense/index 0.89x @ 16K tokens,
+# 1.72x @ 32K); the dense einsum cost scales with top_k *
+# capacity_factor, so the effective threshold is scaled by the layer's
+# own routing config relative to the measured one (see forward).
+_AUTO_DENSE_TOKENS = 24576
+_AUTO_MEASURED_TOPK_CF = 2 * 1.25
+
+
 class MoELayer(Layer):
     """ref: moe_layer.py:263 MoELayer(d_model, experts, gate, ...). Experts
     are a stacked SwiGLU/relu FFN; `ep_mesh_axis` shards the expert dim for
@@ -114,14 +125,20 @@ class MoELayer(Layer):
         self.d_model = d_model
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
-        if dispatch_mode not in ("index", "dense"):
+        if dispatch_mode not in ("index", "dense", "auto"):
             raise ValueError(
-                f"dispatch_mode must be 'index' or 'dense', got "
+                f"dispatch_mode must be 'index', 'dense' or 'auto', got "
                 f"{dispatch_mode!r}")
         # "index": gather/scatter dispatch + grouped-matmul experts,
         # O(E*C*H) (see incubate.moe_dispatch — the scalable path).
-        # "dense": one-hot einsum oracle, O(T*E*C*H) (kept as the
-        # numeric reference the tests align against).
+        # "dense": one-hot einsum algebra, O(T*E*C*H) — also the numeric
+        # reference the tests align against.
+        # "auto": dense below _AUTO_DENSE_TOKENS tokens, index above.
+        # Dense dispatch/combine einsums cost ~T * (E*C) * H flops with
+        # E*C ~ top_k*capacity_factor*T — quadratic in T but pure MXU
+        # work, so at small T they beat the index path's gathers
+        # (measured bf16 on v5e, E=16 H=1024 F=4096: dense/index =
+        # 0.80x @ 8K tokens, 0.89x @ 16K, 1.72x @ 32K).
         self.dispatch_mode = dispatch_mode
         if gate == "naive":
             self.gate = NaiveGate(d_model, num_experts, top_k)
@@ -152,7 +169,15 @@ class MoELayer(Layer):
         act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
                "silu": jax.nn.silu}[self.activation]
 
-        if self.dispatch_mode == "index":
+        mode = self.dispatch_mode
+        if mode == "auto":
+            # dense dispatch/combine flops ~ T * (top_k*cf*T) * H: a
+            # layer dispatching half the slots crosses over at ~2x the
+            # measured token count, so scale the threshold accordingly
+            thresh = _AUTO_DENSE_TOKENS * _AUTO_MEASURED_TOPK_CF / \
+                max(self.top_k * self.capacity_factor, 1e-6)
+            mode = "dense" if b * l < thresh else "index"
+        if mode == "index":
             from .moe_dispatch import moe_forward_indices
 
             def impl(x_arr, gate_w, w_in, w_out):

@@ -12,7 +12,8 @@ from ... import nn
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
     "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d",
-    "resnext101_64x4d",
+    "resnext50_64x4d", "resnext101_32x4d", "resnext101_64x4d",
+    "resnext152_32x4d", "resnext152_64x4d",
 ]
 
 
@@ -171,9 +172,21 @@ model_urls = {
     "resnext50_32x4d": (
         "https://paddle-hapi.bj.bcebos.com/models/resnext50_32x4d.pdparams",
         "dc47483169be7d6f018fcbb7baf8775d"),
+    "resnext50_64x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext50_64x4d.pdparams",
+        "063d4b483e12b06388529450ad7576db"),
+    "resnext101_32x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext101_32x4d.pdparams",
+        "967b090039f9de2c8d06fe994fb9095f"),
     "resnext101_64x4d": (
         "https://paddle-hapi.bj.bcebos.com/models/resnext101_64x4d.pdparams",
         "98e04e7ca616a066699230d769d03008"),
+    "resnext152_32x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext152_32x4d.pdparams",
+        "18ff0beee21f2efc99c4b31786107121"),
+    "resnext152_64x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext152_64x4d.pdparams",
+        "77c4af00ca42c405fa7f841841959379"),
     "wide_resnet50_2": (
         "https://paddle-hapi.bj.bcebos.com/models/wide_resnet50_2.pdparams",
         "0282f804d73debdab289bd9fea3fa6dc"),

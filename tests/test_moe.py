@@ -173,6 +173,28 @@ class TestIndexDispatch:
         np.testing.assert_allclose(out_i.numpy(), out_d.numpy(),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_auto_mode_routes_by_token_count(self, rng, monkeypatch):
+        """dispatch_mode='auto': dense algebra below the crossover,
+        index dispatch above — outputs match either way."""
+        from paddle_tpu.incubate import moe as moe_mod
+        x_np = rng.normal(size=(2, 16, 8)).astype(np.float32)
+        paddle.seed(3)
+        auto = MoELayer(8, 16, 4, top_k=2, capacity_factor=2.0,
+                        dispatch_mode="auto")
+        paddle.seed(3)
+        dense = MoELayer(8, 16, 4, top_k=2, capacity_factor=2.0,
+                         dispatch_mode="dense")
+        # 32 tokens < crossover: auto takes the dense path
+        out_a = auto(paddle.to_tensor(x_np))
+        np.testing.assert_allclose(
+            out_a.numpy(), dense(paddle.to_tensor(x_np)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        # force the crossover below the batch: auto takes the index path
+        monkeypatch.setattr(moe_mod, "_AUTO_DENSE_TOKENS", 16)
+        out_i = auto(paddle.to_tensor(x_np))
+        np.testing.assert_allclose(
+            out_i.numpy(), out_a.numpy(), rtol=1e-4, atol=1e-5)
+
     def test_index_mode_trains(self, rng):
         x = paddle.to_tensor(rng.normal(size=(2, 8, 16)).astype(np.float32),
                              stop_gradient=False)
