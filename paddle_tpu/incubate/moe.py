@@ -102,12 +102,13 @@ def _gshard_dispatch(gate_logits, top_k, capacity):
 # dispatch_mode="auto" crossover (tokens per forward): below this the
 # dense one-hot algebra's quadratic-in-T einsums still win on the MXU;
 # above it the linear index/grouped-matmul path wins. Measured on v5e
-# at top_k=2, capacity_factor=1.25 (dense/index 0.89x @ 16K tokens,
-# 1.72x @ 32K); the dense einsum cost scales with top_k *
-# capacity_factor, so the effective threshold is scaled by the layer's
-# own routing config relative to the measured one (see forward).
+# at top_k=2, capacity_factor=1.25, E=16, H=1024, F=4096 (dense/index
+# 0.80x @ 8K tokens, 0.89x @ 16K, 1.72x @ 32K). Both paths' dispatch
+# costs scale together with top_k*capacity_factor (everything is
+# proportional to the E*C slot count), so the crossover is kept as a
+# flat token threshold; configs far from the measured one should set
+# dispatch_mode explicitly.
 _AUTO_DENSE_TOKENS = 24576
-_AUTO_MEASURED_TOPK_CF = 2 * 1.25
 
 
 class MoELayer(Layer):
@@ -171,12 +172,7 @@ class MoELayer(Layer):
 
         mode = self.dispatch_mode
         if mode == "auto":
-            # dense dispatch/combine flops ~ T * (top_k*cf*T) * H: a
-            # layer dispatching half the slots crosses over at ~2x the
-            # measured token count, so scale the threshold accordingly
-            thresh = _AUTO_DENSE_TOKENS * _AUTO_MEASURED_TOPK_CF / \
-                max(self.top_k * self.capacity_factor, 1e-6)
-            mode = "dense" if b * l < thresh else "index"
+            mode = "dense" if b * l < _AUTO_DENSE_TOKENS else "index"
         if mode == "index":
             from .moe_dispatch import moe_forward_indices
 

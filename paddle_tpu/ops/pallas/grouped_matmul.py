@@ -73,10 +73,13 @@ def _dot_precision(dtype):
 
 def _gmm_kernel(ids_ref, lhs_ref, rhs_ref, out_ref):
     # one token tile x one (prefetch-selected) expert weight: plain MXU
-    # dot in the operands' own dtype with fp32 accumulation
+    # dot in the operands' own dtype with fp32 accumulation. Precision
+    # keys on the PROMOTED dtype: a bf16 x fp32 call promotes to fp32,
+    # which must not silently run single-pass bf16 multiplies.
+    prec = _dot_precision(
+        jnp.promote_types(lhs_ref.dtype, rhs_ref.dtype))
     out_ref[...] = jnp.dot(
-        lhs_ref[...], rhs_ref[0],
-        precision=_dot_precision(lhs_ref.dtype),
+        lhs_ref[...], rhs_ref[0], precision=prec,
         preferred_element_type=jnp.float32).astype(out_ref.dtype)
 
 
@@ -92,7 +95,8 @@ def _gmm_drhs_kernel(ids_ref, lhs_ref, g_ref, out_ref):
     # Mosaic compiler; contraction-dim choice is free on the MXU)
     contrib = jax.lax.dot_general(
         lhs_ref[...], g_ref[...], (((0,), (0,)), ((), ())),
-        precision=_dot_precision(lhs_ref.dtype),
+        precision=_dot_precision(
+            jnp.promote_types(lhs_ref.dtype, g_ref.dtype)),
         preferred_element_type=jnp.float32).astype(out_ref.dtype)
 
     @pl.when(is_first)
