@@ -1,4 +1,4 @@
 """paddle.autograd equivalent. ref: python/paddle/autograd/__init__.py"""
 from ..core.autograd import backward, grad, no_grad, enable_grad  # noqa: F401
-from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext, saved_tensors_hooks  # noqa: F401
 from .functional import jacobian, hessian, vjp, jvp  # noqa: F401

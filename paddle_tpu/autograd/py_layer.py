@@ -13,15 +13,59 @@ from ..core.autograd import GradNode, is_grad_enabled, no_grad
 from ..core.tensor import Tensor
 
 
+# (pack, unpack) hook stack installed by autograd.saved_tensors_hooks;
+# consulted by PyLayerContext.save_for_backward / saved_tensor (ref:
+# python/paddle/autograd/saved_tensors_hooks.py — same contract: pack
+# runs at save time, unpack at first backward use)
+_saved_tensor_hooks: list = []
+
+
+class saved_tensors_hooks:
+    """Context manager registering a pack/unpack hook pair for tensors
+    saved for backward (ref: autograd/saved_tensors_hooks.py). pack_hook
+    maps each saved tensor to stored info (e.g. a host copy); unpack_hook
+    reconstructs the tensor when backward needs it. Applies to the
+    PyLayer save_for_backward path — the compiled/vjp tape stores its
+    residuals inside the XLA program where per-tensor hooks cannot
+    reach (rematerialization is the knob there: fleet recompute /
+    jax.checkpoint)."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensor_hooks.pop()
+        return False
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
+        self._saved_packed = False
+        self._unpack_hook = None
         self._materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        if _saved_tensor_hooks:
+            pack, unpack = _saved_tensor_hooks[-1]
+            self._saved = tuple(pack(t) for t in tensors)
+            self._saved_packed = True
+            self._unpack_hook = unpack
+        else:
+            self._saved = tensors
 
     def saved_tensor(self):
+        if self._saved_packed:
+            unpacked = tuple(self._unpack_hook(p) for p in self._saved)
+            # unpack once: repeated backward reads must not re-run hooks
+            self._saved = unpacked
+            self._saved_packed = False
+            return unpacked
         return self._saved
 
     def mark_not_inplace(self, *args):

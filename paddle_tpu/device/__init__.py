@@ -1,0 +1,170 @@
+"""paddle.device equivalent (ref: python/paddle/device/__init__.py).
+
+TPU build notes: PJRT owns devices; streams/events are XLA's async
+dispatch, so Stream/Event/synchronize are thin wrappers over the
+dispatch queue (the reference's CUDA stream objects have no TPU
+analog — XLA schedules).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..core.device import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, device_count, get_device,
+    is_compiled_with_cuda, is_compiled_with_tpu, set_device)
+
+__all__ = [
+    "get_cudnn_version", "set_device", "get_device", "XPUPlace",
+    "IPUPlace", "is_compiled_with_xpu", "is_compiled_with_ipu",
+    "is_compiled_with_cinn", "is_compiled_with_cuda",
+    "is_compiled_with_rocm", "is_compiled_with_distribute",
+    "is_compiled_with_custom_device", "get_all_device_type",
+    "get_all_custom_device_type", "get_available_device",
+    "get_available_custom_device", "Stream", "Event", "current_stream",
+    "set_stream", "stream_guard", "synchronize",
+]
+
+
+def get_cudnn_version():
+    """None on non-CUDA builds (ref: device/__init__.py)."""
+    return None
+
+
+def XPUPlace(dev_id: int = 0):
+    raise RuntimeError("this build has no XPU backend (TPU-native)")
+
+
+def IPUPlace():
+    raise RuntimeError("this build has no IPU backend (TPU-native)")
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role and is always present
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    """TPU is this build's 'custom device' in reference terms."""
+    return device_type in ("tpu", "axon")
+
+
+def _platforms():
+    plats = []
+    for d in jax.devices():
+        p = "tpu" if d.platform in ("tpu", "axon") else d.platform
+        if p not in plats:
+            plats.append(p)
+    return plats
+
+
+def get_all_device_type():
+    return ["cpu"] + [p for p in _platforms() if p != "cpu"]
+
+
+def get_all_custom_device_type():
+    return [p for p in _platforms() if p not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    out = []
+    for i, d in enumerate(jax.devices()):
+        p = "tpu" if d.platform in ("tpu", "axon") else d.platform
+        out.append(f"{p}:{i}")
+    return out or ["cpu"]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if d.split(":")[0] not in ("cpu", "gpu")]
+
+
+class Stream:
+    """Execution stream handle (ref: device/__init__.py Stream). XLA
+    owns scheduling on TPU; the object carries identity + sync only."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device or get_device()
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+
+class Event:
+    """Cross-stream sync point (ref: device/__init__.py Event)."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device or get_device()
+        self._recorded_on = None
+
+    def record(self, stream=None):
+        self._recorded_on = stream
+
+    def query(self) -> bool:
+        return True  # XLA dispatch: enqueued work completes in order
+
+    def synchronize(self):
+        synchronize(self.device)
+
+
+_current_streams: dict = {}
+
+
+def current_stream(device=None):
+    key = device or get_device()
+    if key not in _current_streams:
+        _current_streams[key] = Stream(key)
+    return _current_streams[key]
+
+
+def set_stream(stream):
+    prev = current_stream(stream.device)
+    _current_streams[stream.device] = stream
+    return prev
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    prev = set_stream(stream)
+    try:
+        yield
+    finally:
+        set_stream(prev)
+
+
+def synchronize(device=None):
+    """Block until enqueued device work completes (ref: device
+    synchronize): realized by fetching a tiny value through the same
+    queue — the only ordered barrier XLA exposes."""
+    import jax.numpy as jnp
+    jax.block_until_ready(jnp.zeros(()))

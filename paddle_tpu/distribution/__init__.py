@@ -9,6 +9,9 @@ from .distributions import (  # noqa: F401
     Bernoulli, Categorical, Distribution, Exponential, Gumbel, Laplace,
     LogNormal, Normal, Uniform, kl_divergence, register_kl,
 )
+from .more import (  # noqa: F401
+    ContinuousBernoulli, ExponentialFamily, LKJCholesky,
+)
 from .extra import (  # noqa: F401
     AbsTransform, AffineTransform, Beta, Binomial, Cauchy, ChainTransform,
     Chi2, Dirichlet, ExpTransform, Gamma, Geometric, Independent,

@@ -1,0 +1,223 @@
+"""Distribution long tail: ContinuousBernoulli, ExponentialFamily,
+LKJCholesky (ref: python/paddle/distribution/continuous_bernoulli.py,
+exponential_family.py, lkj_cholesky.py).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_mod
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from .distributions import Distribution
+
+__all__ = ["ContinuousBernoulli", "ExponentialFamily", "LKJCholesky"]
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32))
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (ref:
+    exponential_family.py): subclasses provide ``_natural_parameters``
+    and ``_log_normalizer``; entropy comes from the Bregman-divergence
+    identity H = log A(θ) - <θ, ∇A(θ)> - E[carrier]."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nat = [p._data if isinstance(p, Tensor) else jnp.asarray(p)
+               for p in self._natural_parameters]
+
+        def f(*np_):
+            log_norm, grads = jax.value_and_grad(
+                lambda ps: jnp.sum(self._log_normalizer(*ps)),
+                argnums=0)(tuple(np_))
+            ent = jnp.sum(log_norm) - sum(
+                jnp.sum(t * g) for t, g in zip(np_, grads))
+            return ent - self._mean_carrier_measure
+        return apply_op(f, *[Tensor(n) for n in nat],
+                        op_name="ef_entropy")
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous Bernoulli on [0, 1] (ref: continuous_bernoulli.py;
+    Loaiza-Ganem & Cunningham 2019): density
+    C(p) * p^x (1-p)^(1-x), C(p) = 2 atanh(1-2p)/(1-2p) with a Taylor
+    patch inside ``lims`` around p=0.5."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(self.probs._data.shape)
+
+    def _stable(self, p):
+        lo, hi = self._lims
+        return jnp.where((p > lo) & (p < hi), jnp.float32(lo), p)
+
+    def _log_C(self, p):
+        # log C: Taylor around 0.5 inside lims (atanh(1-2p)/(1-2p) -> 2)
+        safe = self._stable(p)
+        x = 1.0 - 2.0 * safe
+        exact = jnp.log(2.0 * jnp.arctanh(x) / x)
+        mid = jnp.log(2.0) + jnp.log1p(
+            (1.0 - 2.0 * p) ** 2 / 3.0)  # 2(1 + x^2/3 + ...)
+        lo, hi = self._lims
+        return jnp.where((p > lo) & (p < hi), mid, exact)
+
+    @property
+    def mean(self):
+        def f(p):
+            safe = self._stable(p)
+            exact = safe / (2.0 * safe - 1.0) + \
+                1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+            mid = 0.5 + (p - 0.5) / 3.0  # Taylor at p=0.5
+            lo, hi = self._lims
+            return jnp.where((p > lo) & (p < hi), mid, exact)
+        return apply_op(f, self.probs, op_name="cb_mean")
+
+    @property
+    def variance(self):
+        def f(p):
+            safe = self._stable(p)
+            x = 1.0 - 2.0 * safe
+            exact = safe * (safe - 1.0) / (x * x) + \
+                1.0 / (2.0 * jnp.arctanh(x)) ** 2
+            mid = jnp.float32(1.0 / 12.0) - (p - 0.5) ** 2 / 3.0
+            lo, hi = self._lims
+            return jnp.where((p > lo) & (p < hi), mid, exact)
+        return apply_op(f, self.probs, op_name="cb_variance")
+
+    def log_prob(self, value):
+        def f(v, p):
+            return (self._log_C(p) + v * jnp.log(p)
+                    + (1.0 - v) * jnp.log1p(-p))
+        return apply_op(f, _t(value), self.probs, op_name="cb_log_prob")
+
+    def prob(self, value):
+        return apply_op(lambda lp: jnp.exp(lp), self.log_prob(value),
+                        op_name="cb_prob")
+
+    def cdf(self, value):
+        def f(v, p):
+            safe = self._stable(p)
+            num = safe ** v * (1.0 - safe) ** (1.0 - v) + safe - 1.0
+            exact = num / (2.0 * safe - 1.0)
+            lo, hi = self._lims
+            out = jnp.where((p > lo) & (p < hi), v, exact)
+            return jnp.clip(out, 0.0, 1.0)
+        return apply_op(f, _t(value), self.probs, op_name="cb_cdf")
+
+    def icdf(self, value):
+        def f(u, p):
+            safe = self._stable(p)
+            exact = (jnp.log1p((2.0 * safe - 1.0) * u / (1.0 - safe))
+                     / (jnp.log(safe) - jnp.log1p(-safe)))
+            lo, hi = self._lims
+            return jnp.where((p > lo) & (p < hi), u, exact)
+        return apply_op(f, _t(value), self.probs, op_name="cb_icdf")
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + tuple(self.batch_shape)
+        u = jax.random.uniform(key, shp, jnp.float32)
+        return self.icdf(Tensor(u))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def entropy(self):
+        def f(p):
+            mean = self.mean._data if isinstance(self.mean, Tensor) \
+                else self.mean
+            return -(self._log_C(p) + mean * jnp.log(p)
+                     + (1.0 - mean) * jnp.log1p(-p))
+        return apply_op(f, self.probs, op_name="cb_entropy")
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices (ref:
+    lkj_cholesky.py; Lewandowski-Kurowicka-Joe 2009). ``sample`` uses
+    the onion construction; ``log_prob`` evaluates the exact density of
+    the lower-triangular parametrization by inverting that construction
+    (y_i = |row_i|^2 ~ Beta(i/2, eta + (d-1-i)/2), direction uniform on
+    the sphere, polar-coordinates Jacobian)."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion"):
+        if dim < 2:
+            raise ValueError("LKJCholesky needs dim >= 2")
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError(f"unknown sample_method {sample_method!r}")
+        self.dim = int(dim)
+        self.concentration = _t(concentration)
+        self.sample_method = sample_method
+        super().__init__(self.concentration._data.shape)
+
+    def _beta_params(self):
+        d = self.dim
+        eta = self.concentration._data
+        rows = jnp.arange(1, d, dtype=jnp.float32)       # i = 1..d-1
+        a = rows / 2.0
+        b = eta + (d - 1.0 - rows) / 2.0
+        return a, b
+
+    def sample(self, shape=()):
+        d = self.dim
+        key = random_mod.next_key()
+        shp = tuple(shape)
+        a, b = self._beta_params()
+        k1, k2 = jax.random.split(key)
+        y = jax.random.beta(k1, a, b, shp + (d - 1,))     # row norms^2
+        normal = jax.random.normal(k2, shp + (d - 1, d - 1), jnp.float32)
+        L = jnp.zeros(shp + (d, d), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            u = normal[..., i - 1, :i]
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            r = jnp.sqrt(y[..., i - 1])
+            L = L.at[..., i, :i].set(r[..., None] * u)
+            L = L.at[..., i, i].set(jnp.sqrt(1.0 - y[..., i - 1]))
+        return Tensor(L)
+
+    def log_prob(self, value):
+        d = self.dim
+        a, b = self._beta_params()
+
+        def f(L, a_, b_):
+            total = jnp.zeros(L.shape[:-2], jnp.float32)
+            for i in range(1, d):
+                row = L[..., i, :i]
+                y = jnp.sum(row * row, axis=-1)
+                lbeta = (jax.scipy.special.gammaln(a_[i - 1])
+                         + jax.scipy.special.gammaln(b_[i - 1])
+                         - jax.scipy.special.gammaln(a_[i - 1]
+                                                     + b_[i - 1]))
+                # Beta_pdf(y) has a (a-1)*log(y) term and the polar
+                # Jacobian (density over the row = Beta_pdf * 2 /
+                # (A_{i-1} * r^{i-2})) contributes -(i-2)/2*log(y);
+                # with a = i/2 the log(y) exponents cancel EXACTLY, so
+                # only the (1-y) power and constants remain (also
+                # avoids 0*inf at y=0).
+                log_area = (math.log(2.0)
+                            + (i / 2.0) * math.log(math.pi)
+                            - jax.scipy.special.gammaln(i / 2.0))
+                total = total + ((b_[i - 1] - 1.0) * jnp.log1p(-y)
+                                 - lbeta + math.log(2.0) - log_area)
+            return total
+        return apply_op(f, _t(value), Tensor(a), Tensor(b),
+                        op_name="lkj_log_prob")

@@ -13,7 +13,8 @@ from .core.tensor import Tensor
 __all__ = [
     "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
     "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
-    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
 ]
 
 
@@ -61,6 +62,52 @@ fftn = _wrapn(jnp.fft.fftn)
 ifftn = _wrapn(jnp.fft.ifftn)
 rfftn = _wrapn(jnp.fft.rfftn)
 irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def _hermitian_nd(h_1d, cfftn, default_axes, op_name, h_first):
+    """N-d hermitian transforms composed from the separable pieces:
+    complex fft over the leading axes + the 1-D hermitian transform on
+    the last axis (ref: paddle/fft.py hfftn/ihfftn, which lower to
+    fft_c2r/r2c the same way). Order depends on direction: ihfft (r2c)
+    must see the REAL input, so it runs first; hfft (c2r) produces the
+    real output, so it runs last. Per-call norms multiply into the
+    correct total factor because the transform is separable."""
+
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        def f(a):
+            ax = list(axes) if axes is not None else (
+                list(default_axes) if default_axes is not None
+                else list(range(a.ndim)))
+            ss = list(s) if s is not None else None
+            if ss is not None and len(ss) != len(ax):
+                raise ValueError(
+                    f"{op_name}: len(s)={len(ss)} must match "
+                    f"len(axes)={len(ax)}")
+            head, last = ax[:-1], ax[-1]
+            n_last = ss[-1] if ss is not None else None
+            s_head = ss[:-1] if ss is not None else None
+            if h_first:
+                a = h_1d(a, n=n_last, axis=last, norm=_norm(norm))
+                if head:
+                    a = cfftn(a, s=s_head, axes=head, norm=_norm(norm))
+                return a
+            if head:
+                a = cfftn(a, s=s_head, axes=head, norm=_norm(norm))
+            return h_1d(a, n=n_last, axis=last, norm=_norm(norm))
+
+        return apply_op(f, x, op_name=op_name)
+
+    return op
+
+
+hfft2 = _hermitian_nd(jnp.fft.hfft, jnp.fft.fftn, (-2, -1), "hfft2",
+                      h_first=False)
+ihfft2 = _hermitian_nd(jnp.fft.ihfft, jnp.fft.ifftn, (-2, -1), "ihfft2",
+                       h_first=True)
+hfftn = _hermitian_nd(jnp.fft.hfft, jnp.fft.fftn, None, "hfftn",
+                      h_first=False)
+ihfftn = _hermitian_nd(jnp.fft.ihfft, jnp.fft.ifftn, None, "ihfftn",
+                       h_first=True)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

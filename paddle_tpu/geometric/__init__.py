@@ -129,3 +129,131 @@ def reindex_graph(x, neighbors, count, name=None):
     return (Tensor(jnp.asarray(reindex_src)),
             Tensor(jnp.asarray(reindex_dst)),
             Tensor(jnp.asarray(out_nodes)))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Edge features from gathered node pairs: x[src] op y[dst]
+    (ref: message_passing/send_recv.py send_uv)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    return apply_op(
+        lambda a, b, s, d: ops[message_op](jnp.take(a, s, axis=0),
+                                           jnp.take(b, d, axis=0)),
+        x, y, src_index, dst_index, op_name="send_uv")
+
+
+def _np_of(t):
+    import numpy as np
+    return np.asarray(t.numpy() if isinstance(t, Tensor) else t)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (ref:
+    sampling/neighbors.py sample_neighbors). Host-side like
+    reindex_graph: output sizes are data-dependent (ragged), which is
+    not a compilable TPU shape — graph sampling belongs to the input
+    pipeline (the reference's GPU kernel serves the same stage)."""
+    import numpy as np
+
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs eids")
+    rowv, colv = _np_of(row).reshape(-1), _np_of(colptr).reshape(-1)
+    nodes = _np_of(input_nodes).reshape(-1)
+    eidv = _np_of(eids).reshape(-1) if eids is not None else None
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    for n in nodes:
+        lo, hi = int(colv[n]), int(colv[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(rowv[sel])
+        out_c.append(len(sel))
+        if return_eids:
+            out_e.append(eidv[sel])
+    neigh = np.concatenate(out_n) if out_n else np.empty(0, rowv.dtype)
+    cnt = np.asarray(out_c, dtype=rowv.dtype)
+    res = (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        ev = np.concatenate(out_e) if out_e else np.empty(0, rowv.dtype)
+        return res + (Tensor(jnp.asarray(ev)),)
+    return res
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weight-proportional neighbor sampling without replacement (ref:
+    sampling/neighbors.py weighted_sample_neighbors); host-side, see
+    sample_neighbors."""
+    import numpy as np
+
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs eids")
+    rowv, colv = _np_of(row).reshape(-1), _np_of(colptr).reshape(-1)
+    wv = _np_of(edge_weight).reshape(-1).astype(np.float64)
+    nodes = _np_of(input_nodes).reshape(-1)
+    eidv = _np_of(eids).reshape(-1) if eids is not None else None
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    for n in nodes:
+        lo, hi = int(colv[n]), int(colv[n + 1])
+        deg = hi - lo
+        if deg == 0:
+            out_c.append(0)
+            continue
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            w = wv[lo:hi]
+            p = w / w.sum() if w.sum() > 0 else None
+            sel = lo + rng.choice(deg, size=sample_size, replace=False,
+                                  p=p)
+        out_n.append(rowv[sel])
+        out_c.append(len(sel))
+        if return_eids:
+            out_e.append(eidv[sel])
+    neigh = np.concatenate(out_n) if out_n else np.empty(0, rowv.dtype)
+    cnt = np.asarray(out_c, dtype=rowv.dtype)
+    res = (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        ev = np.concatenate(out_e) if out_e else np.empty(0, rowv.dtype)
+        return res + (Tensor(jnp.asarray(ev)),)
+    return res
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reindex_graph over multiple edge types sharing one id space
+    (ref: reindex.py reindex_heter_graph): ids are renumbered once
+    across all graphs; per-graph edges are concatenated."""
+    import numpy as np
+
+    xv = _np_of(x).reshape(-1)
+    order = {int(v): i for i, v in enumerate(xv)}
+    nodes = list(xv)
+    srcs, dsts = [], []
+    for nb, ct in zip(neighbors, count):
+        nv = _np_of(nb).reshape(-1)
+        cv = _np_of(ct).reshape(-1)
+        for v in nv:
+            if int(v) not in order:
+                order[int(v)] = len(nodes)
+                nodes.append(v)
+        srcs.append(np.array([order[int(v)] for v in nv], np.int64))
+        dsts.append(np.repeat(np.arange(len(cv), dtype=np.int64), cv))
+    reindex_src = np.concatenate(srcs) if srcs else np.empty(0, np.int64)
+    reindex_dst = np.concatenate(dsts) if dsts else np.empty(0, np.int64)
+    out_nodes = np.asarray(nodes, dtype=xv.dtype)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+__all__ += ["send_uv", "sample_neighbors", "weighted_sample_neighbors",
+            "reindex_heter_graph"]

@@ -7,3 +7,9 @@ stack (incubate/distributed/models/moe/) and fused transformer layers
 from . import moe  # noqa: F401
 from . import asp  # noqa: F401
 from . import nn  # noqa: F401
+from . import inference  # noqa: F401
+from .extras import (  # noqa: F401
+    LookAhead, ModelAverage, graph_khop_sampler, graph_reindex,
+    graph_sample_neighbors, graph_send_recv, identity_loss, segment_max,
+    segment_mean, segment_min, segment_sum, softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle)

@@ -1,0 +1,262 @@
+"""incubate long-tail surface: LookAhead / ModelAverage optimizers,
+fused masked softmax, identity_loss, and the graph/segment aliases.
+
+ref: python/paddle/incubate/__init__.py __all__; impls under
+incubate/optimizer/lookahead.py, optimizer/modelaverage.py,
+operators/softmax_mask_fuse*.py, nn/loss.py identity_loss, and the
+graph_* names that alias paddle.geometric's ops.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "LookAhead", "ModelAverage", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "identity_loss",
+    "graph_send_recv", "graph_khop_sampler", "graph_sample_neighbors",
+    "graph_reindex", "segment_sum", "segment_mean", "segment_max",
+    "segment_min",
+]
+
+# segment ops are the geometric primitives under their legacy incubate
+# names (the reference re-exports the same functions); the graph_* ops
+# keep the reference incubate SIGNATURES, which differ from the
+# geometric ones (positional order / parameter names), so they are thin
+# wrappers rather than aliases.
+from ..geometric import (  # noqa: E402,F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy name of geometric.send_u_recv with the reference's
+    ``pool_type`` parameter (ref: incubate/operators/graph_send_recv)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    """Legacy name of geometric.reindex_graph (ref:
+    incubate/operators/graph_reindex; the buffer args are a GPU
+    hashtable optimization with no host-side analog)."""
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Legacy name of geometric.sample_neighbors with the reference's
+    positional order — eids/perm_buffer BEFORE sample_size (ref:
+    incubate/operators/graph_sample_neighbors)."""
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (ref: incubate/graph_khop_sampler):
+    chained sample_neighbors over ``sample_sizes`` hops with one id
+    space — dst ids come from each edge's actual frontier node (a
+    revisited node keeps its id), not from positional numbering.
+    Host-side like every sampling op here."""
+    from ..geometric import sample_neighbors
+
+    base = np.asarray(input_nodes.numpy()
+                      if isinstance(input_nodes, Tensor) else input_nodes
+                      ).reshape(-1)
+    order = {int(v): i for i, v in enumerate(base)}
+    nodes = list(base)
+    srcs, dsts, cnts = [], [], []
+    frontier = base
+    for size in sample_sizes:
+        neigh, cnt = sample_neighbors(
+            row, colptr, Tensor(jnp.asarray(frontier)),
+            sample_size=size)
+        nv = np.asarray(neigh.numpy()).reshape(-1)
+        cv = np.asarray(cnt.numpy()).reshape(-1)
+        dsts.append(np.repeat(
+            np.array([order[int(v)] for v in frontier], np.int64), cv))
+        for v in nv:
+            if int(v) not in order:
+                order[int(v)] = len(nodes)
+                nodes.append(v)
+        srcs.append(np.array([order[int(v)] for v in nv], np.int64))
+        cnts.append(cv)
+        frontier = nv
+    src = np.concatenate(srcs) if srcs else np.empty(0, np.int64)
+    dst = np.concatenate(dsts) if dsts else np.empty(0, np.int64)
+    cnt_all = np.concatenate(cnts) if cnts else np.empty(0, np.int64)
+    out_nodes = np.asarray(nodes, dtype=base.dtype)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(out_nodes)),
+            Tensor(jnp.asarray(cnt_all)))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused computation (ref:
+    incubate/operators/softmax_mask_fuse.py — a CUDA fusion there; one
+    XLA fusion here)."""
+    import jax
+
+    def f(a, m):
+        return jax.nn.softmax(a.astype(jnp.float32) + m.astype(
+            jnp.float32), axis=-1).astype(a.dtype)
+    return apply_op(f, x, mask, op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal (upper-triangle masked) softmax over the last two dims
+    (ref: incubate/operators/softmax_mask_fuse_upper_triangle.py)."""
+    import jax
+
+    def f(a):
+        q, k = a.shape[-2], a.shape[-1]
+        keep = jnp.tril(jnp.ones((q, k), bool), k=k - q)
+        logits = jnp.where(keep, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(logits, axis=-1).astype(a.dtype)
+    return apply_op(f, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """Marks (and optionally reduces) the final loss (ref:
+    incubate/nn/loss.py identity_loss; int codes 0/1/2 = sum/mean/none
+    accepted like the reference)."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "none":
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if red == "mean":
+        return apply_op(lambda a: jnp.mean(a), x, op_name="identity_loss")
+    if red == "sum":
+        return apply_op(lambda a: jnp.sum(a), x, op_name="identity_loss")
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (ref: incubate/optimizer/lookahead.py,
+    Zhang et al. 2019): the inner optimizer updates fast weights every
+    step; every k steps the slow weights move alpha of the way to the
+    fast weights and the fast weights reset onto them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        if not self._slow:
+            for p in self._params():
+                self._slow[id(p)] = p._data
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self._params():
+                slow = self._slow[id(p)]
+                new_slow = (slow.astype(jnp.float32) + self.alpha *
+                            (p._data.astype(jnp.float32) -
+                             slow.astype(jnp.float32))).astype(p._data.dtype)
+                self._slow[id(p)] = new_slow
+                p._data = new_slow
+
+    def minimize(self, loss, *args, **kwargs):
+        loss.backward()
+        self.step()
+        self.inner_optimizer.clear_grad()
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step_count": self._step_count}
+
+
+class ModelAverage:
+    """Running parameter average with a growing window (ref:
+    incubate/optimizer/modelaverage.py): accumulates parameter sums;
+    apply() swaps averaged weights in (optionally restorable),
+    restore() swaps the trained weights back. The window restarts when
+    num_accumulates exceeds min(max_average_window,
+    num_updates * average_window_rate) — the reference's contract."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window_rate = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._params = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(p._data, jnp.float32)
+                     for p in self._params}
+        self._num_accumulates = 0
+        self._num_updates = 0
+        self._backup = None
+
+    def step(self):
+        self._num_updates += 1
+        self._num_accumulates += 1
+        window = min(self.max_average_window,
+                     self._num_updates * self.average_window_rate)
+        if (self._num_accumulates >= self.min_average_window
+                and self._num_accumulates >= window):
+            # restart the window: keep only the latest value
+            for p in self._params:
+                self._sum[id(p)] = p._data.astype(jnp.float32)
+            self._num_accumulates = 1
+        else:
+            for p in self._params:
+                self._sum[id(p)] = (self._sum[id(p)]
+                                    + p._data.astype(jnp.float32))
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        n = max(self._num_accumulates, 1)
+        for p in self._params:
+            p._data = (self._sum[id(p)] / n).astype(p._data.dtype)
+        if not need_restore:
+            self._backup = None
+        return _RestoreCtx(self)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+
+class _RestoreCtx:
+    """apply() is usable as a context manager (with ma.apply(): ...)."""
+
+    def __init__(self, ma):
+        self._ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ma.restore()
+        return False

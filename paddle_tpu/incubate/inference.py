@@ -1,0 +1,6 @@
+"""paddle.incubate.inference namespace (ref:
+python/paddle/incubate/__init__.py exports ``inference``): the
+inference API re-exported — the predictor/serving stack lives in
+paddle_tpu.inference."""
+from ..inference import (  # noqa: F401
+    Config, Predictor, load_inference_model, save_inference_model)

@@ -119,6 +119,9 @@ def _embedding_lookup_fwd(idx, w):
 # vs ~21 ms for the equivalent matmul); for small tables the scatter
 # still wins because the one-hot contraction pays the full T*V*H flops.
 _EMBED_MATMUL_DGRAD_BYTES = 256 * 1024 * 1024
+# minimum token-chunk size for the chunked one-hot dgrad (module-level
+# so tests can force the multi-chunk accumulation path)
+_EMBED_CHUNK_FLOOR = 1024
 
 
 def _embedding_lookup_bwd(res, g):
@@ -131,8 +134,9 @@ def _embedding_lookup_bwd(res, g):
     flat_idx = idx.reshape(-1)
     flat_g = g.reshape(-1, h)
     t = flat_idx.shape[0]
-    chunk = max(1024, (_EMBED_MATMUL_DGRAD_BYTES
-                       // max(v * flat_g.dtype.itemsize, 1)))
+    chunk = max(_EMBED_CHUNK_FLOOR,
+                (_EMBED_MATMUL_DGRAD_BYTES
+                 // max(v * flat_g.dtype.itemsize, 1)))
     dw = jnp.zeros((v, h), jnp.float32)
     for start in range(0, t, chunk):
         end = min(start + chunk, t)

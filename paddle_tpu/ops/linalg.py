@@ -248,3 +248,206 @@ def householder_product(x, tau, name=None):
             q = q @ h
         return q[..., :, :n]
     return apply_op(f, x, tau, op_name="householder_product")
+
+
+# --- long-tail linalg surface (ref: python/paddle/linalg.py __all__) ----
+
+
+def inv(x, name=None):
+    """Alias of inverse (ref: linalg.py exposes both)."""
+    return inverse(x, name=name)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (ref: tensor/linalg.py
+    cholesky_inverse): A^-1 = (LLᵀ)^-1 solved against identity."""
+    def f(L):
+        eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+        return jax.scipy.linalg.cho_solve((L, not upper), eye)
+    return apply_op(f, x, op_name="cholesky_inverse")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """ref: tensor/linalg.py vector_norm — p-norm treating the input
+    (or the given axes) as a flat vector."""
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jnp.linalg.norm(a.astype(jnp.float32), ord=p, axis=ax,
+                               keepdims=keepdim)
+    return apply_op(f, x, op_name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """ref: tensor/linalg.py matrix_norm — fro / nuc / ±1 / ±2 / ±inf
+    over the two matrix axes."""
+    def f(a):
+        return jnp.linalg.norm(a.astype(jnp.float32), ord=p, axis=axis,
+                               keepdims=keepdim)
+    return apply_op(f, x, op_name="matrix_norm")
+
+
+def cond(x, p=None, name=None):
+    """Condition number (ref: tensor/linalg.py cond)."""
+    def f(a):
+        return jnp.linalg.cond(a.astype(jnp.float32), p=p)
+    return apply_op(f, x, op_name="cond")
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (ref: tensor/linalg.py matrix_exp)."""
+    def f(a):
+        if a.ndim > 2:
+            flat = a.reshape((-1,) + a.shape[-2:])
+            out = jax.vmap(jax.scipy.linalg.expm)(flat)
+            return out.reshape(a.shape)
+        return jax.scipy.linalg.expm(a)
+    return apply_op(f, x, op_name="matrix_exp")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization, compact form (ref: tensor/linalg.py lu):
+    returns (LU, pivots[, info]) — LU packs L (unit lower) and U;
+    pivots are 1-based row-swap indices like the reference/LAPACK."""
+    if not pivot:
+        raise NotImplementedError(
+            "lu(pivot=False) is unsupported (XLA's LU always pivots)")
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    lu_mat, piv, _ = jax.lax.linalg.lu(xd.astype(jnp.float32))
+    piv1 = (piv + 1).astype(jnp.int32)
+    if get_infos:
+        info = jnp.zeros(xd.shape[:-2], jnp.int32)
+        return Tensor(lu_mat), Tensor(piv1), Tensor(info)
+    return Tensor(lu_mat), Tensor(piv1)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu()'s compact result into (P, L, U)
+    (ref: tensor/linalg.py lu_unpack)."""
+    lu_mat = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    piv = (y._data if isinstance(y, Tensor) else jnp.asarray(y)) - 1
+    m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(
+            m, k, dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat[..., :k, :])
+    if unpack_pivots:
+        # pivots are sequential row swaps; replay them on an identity
+        perm = jnp.broadcast_to(jnp.arange(m), lu_mat.shape[:-2] + (m,))
+
+        def one(perm_row, piv_row):
+            def body(i, p):
+                j = piv_row[i]
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+            return jax.lax.fori_loop(0, piv_row.shape[0], body, perm_row)
+
+        flat_perm = perm.reshape(-1, m)
+        flat_piv = piv.reshape(-1, piv.shape[-1])
+        out = jax.vmap(one)(flat_perm, flat_piv)
+        perm = out.reshape(lu_mat.shape[:-2] + (m,))
+        P = jax.nn.one_hot(perm, m, dtype=lu_mat.dtype)
+        P = jnp.swapaxes(P, -1, -2)
+    return (Tensor(P) if P is not None else None,
+            Tensor(L) if L is not None else None,
+            Tensor(U) if U is not None else None)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by Q (from the householder factorization (x, tau)):
+    op(Q) @ y or y @ op(Q) (ref: tensor/linalg.py ormqr). LAPACK's
+    ormqr applies the implicit FULL m x m Q, so the k reflectors are
+    zero-padded to m before the householder product — XLA has no
+    direct ormqr primitive and the explicit product is MXU-friendly."""
+    def f(hm, tm, ym):
+        m, k = hm.shape[-2], hm.shape[-1]
+        if k < m:
+            pad_h = [(0, 0)] * (hm.ndim - 1) + [(0, m - k)]
+            hm = jnp.pad(hm, pad_h)
+            pad_t = [(0, 0)] * (tm.ndim - 1) + [(0, m - k)]
+            tm = jnp.pad(tm, pad_t)  # tau=0 => identity reflector
+        qm = jax.lax.linalg.householder_product(hm, tm)
+        qop = jnp.swapaxes(qm, -1, -2) if transpose else qm
+        return jnp.matmul(qop, ym) if left else jnp.matmul(ym, qop)
+    return apply_op(f, x, tau, y, op_name="ormqr")
+
+
+def _lowrank_q(a, q_size, niter, key):
+    """Randomized range finder (Halko et al.): Q spans approx the top
+    q_size-dim column space of a after ``niter`` power iterations."""
+    m, n = a.shape[-2], a.shape[-1]
+    omega = jax.random.normal(key, a.shape[:-2] + (n, q_size),
+                              dtype=jnp.float32)
+    y = a @ omega
+    q, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        z = jnp.swapaxes(a, -1, -2) @ q
+        z, _ = jnp.linalg.qr(z)
+        y = a @ z
+        q, _ = jnp.linalg.qr(y)
+    return q
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized truncated SVD (ref: tensor/linalg.py svd_lowrank;
+    Halko-Martinsson-Tropp). Returns (U, S, V) with V (not Vᵀ),
+    matching the reference."""
+    from ..core import random as random_mod
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    a = xd.astype(jnp.float32)
+    if M is not None:
+        a = a - (M._data if isinstance(M, Tensor) else jnp.asarray(M))
+    qmat = _lowrank_q(a, min(q, *a.shape[-2:]), niter,
+                      random_mod.next_key())
+    b = jnp.swapaxes(qmat, -1, -2) @ a
+    u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_b
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (ref: tensor/linalg.py pca_lowrank): low-rank SVD
+    of the (optionally centered) data."""
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    a = xd.astype(jnp.float32)
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    return svd_lowrank(Tensor(a), q=q, niter=niter)
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="bfloat16",
+                            act="identity", name=None):
+    """fp8 x fp8 -> half GEMM (ref: linalg.py fp8_fp8_half_gemm_fused,
+    a Hopper cutlass kernel). TPU v5e has no fp8 MXU mode, so the
+    contract is kept by computing in bf16 with the fp8 inputs upcast —
+    numerically a superset of the reference (which quantizes to e4m3).
+    Inputs may be float8_e4m3fn/e5m2 or any float dtype."""
+    def f(a, b, *maybe_bias):
+        a16 = a.astype(jnp.bfloat16)
+        b16 = b.astype(jnp.bfloat16)
+        if transpose_x:
+            a16 = jnp.swapaxes(a16, -1, -2)
+        if transpose_y:
+            b16 = jnp.swapaxes(b16, -1, -2)
+        out = jnp.matmul(a16, b16) * jnp.bfloat16(scale)
+        # cutlass epilogue order: act(x @ y * scale + bias)
+        if maybe_bias:
+            out = out + maybe_bias[0].astype(out.dtype)
+        if act == "gelu":
+            out = jax.nn.gelu(out)
+        elif act == "relu":
+            out = jax.nn.relu(out)
+        elif act != "identity":
+            raise ValueError(f"unknown act {act!r}")
+        return out.astype(output_dtype)
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return apply_op(f, *args, op_name="fp8_gemm")

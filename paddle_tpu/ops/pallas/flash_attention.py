@@ -628,7 +628,11 @@ def _flash_fwd_res(q, k, v, causal, scale, dropout_p=0.0, seed=None):
                 seed=_as_seed(seed) if dropout_p > 0.0 else None)
             return out, (out, lse)
         # d=64 (BERT-class): Mosaic needs the minor block dim % 128, so
-        # this path keeps the [B*H, L, D] layout with transposes
+        # this path keeps the [B*H, L, D] layout with transposes.
+        # Zero-padding d to 128 to ride the layout-native path was
+        # measured and LOST (BERT-base MLM 113.0K -> 106.4K tok/s): the
+        # pad/slice pairs move 2x the bytes the transposes do, more
+        # than the half-lane kernel inefficiency costs.
         qb, kb, vb = _to_bhld(q), _to_bhld(k), _to_bhld(v)
         blk = _pick_block(l, d, sample=(qb, kb, vb))
         out_bhld, lse = _flash_fwd_pallas(

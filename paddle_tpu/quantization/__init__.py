@@ -8,6 +8,10 @@ deployment lowering is a compiler concern (XLA int8 matmul) — this module
 provides the calibration/training semantics.
 """
 from .quantize import (  # noqa: F401
-    AbsmaxObserver, FakeQuantAbsMax, MovingAverageAbsmaxObserver, PTQ, QAT,
-    QuantConfig, QuantedLinear, fake_quantize_abs_max, quant_absmax,
+    AbsmaxObserver, BaseObserver, BaseQuanter, FakeQuantAbsMax,
+    MovingAverageAbsmaxObserver, PTQ, QAT, QuantConfig, QuantedLinear,
+    fake_quantize_abs_max, quant_absmax, quanter,
 )
+
+__all__ = ["QuantConfig", "BaseQuanter", "BaseObserver", "quanter",
+           "QAT", "PTQ"]

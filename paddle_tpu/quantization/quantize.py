@@ -221,3 +221,56 @@ class PTQ:
                                  act_scale=l.obs.scale())
 
         return _swap_layers(m, pred, make)
+
+
+class BaseQuanter(Layer):
+    """Abstract base for quanters (ref: quantization/base_quanter.py):
+    subclasses implement forward (the fake-quant transform) plus the
+    scales/zero-point/bit-length accessors the exporters read."""
+
+    def forward(self, x):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return 8
+
+
+class BaseObserver(BaseQuanter):
+    """Abstract base for observers (ref: quantization/base_observer.py):
+    quanters that first watch tensors to calibrate their scales."""
+
+    def cal_thresholds(self):
+        raise NotImplementedError
+
+
+def quanter(class_name: str):
+    """Class decorator declaring a quanter factory under ``class_name``
+    (ref: quantization/factory.py quanter): the factory captures ctor
+    args and instantiates the quanter per-layer when the QuantConfig is
+    applied."""
+
+    def decorator(cls):
+        class _Factory:
+            def __init__(self, *args, **kwargs):
+                self._args = args
+                self._kwargs = kwargs
+
+            def _instance(self, layer=None):
+                return cls(*self._args, **self._kwargs)
+
+        _Factory.__name__ = class_name
+        _Factory._quanter_cls = cls
+        import sys
+        setattr(sys.modules[cls.__module__], class_name, _Factory)
+        return cls
+
+    return decorator

@@ -14,7 +14,12 @@ from ..core.autograd import apply_op
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16)
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Conll05st",
+           "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14",
+           "WMT16"]
 
 
 def viterbi_decode(potentials, transition, lengths=None,

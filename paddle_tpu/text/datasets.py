@@ -14,7 +14,7 @@ import numpy as np
 from ..io.dataset import Dataset
 
 __all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05",
-           "WMT14", "WMT16"]
+           "Conll05st", "WMT14", "WMT16"]
 
 _WORDS = ["the", "a", "of", "to", "and", "in", "movie", "film", "good",
           "bad", "great", "plot", "actor", "scene", "story", "time",
@@ -189,3 +189,8 @@ class WMT14(_WMTBase):
 
 class WMT16(_WMTBase):
     """ref: text/datasets/wmt16.py."""
+
+
+# the reference exports this dataset as Conll05st
+# (python/paddle/text/__init__.py)
+Conll05st = Conll05

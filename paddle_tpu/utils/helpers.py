@@ -1,0 +1,107 @@
+"""General helpers: deprecation decorator, env check, version gates.
+
+ref: python/paddle/utils/__init__.py __all__ = ['deprecated',
+'run_check', 'require_version', 'try_import'] (impls in
+utils/deprecated.py, utils/install_check.py, utils/lazy_import.py).
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+from types import ModuleType
+from typing import Callable, Optional
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0) -> Callable:
+    """Mark an API deprecated (ref: utils/deprecated.py): appends a
+    deprecation notice to the docstring and warns on call. level 0 =
+    note only, 1 = also warn at call time, 2 = raise (API removed)."""
+
+    def decorator(fn):
+        note = "\n\n.. warning:: Deprecated"
+        if since:
+            note += f" since {since}"
+        note += "."
+        if update_to:
+            note += f" Use :ref:`{update_to}` instead."
+        if reason:
+            note += f" Reason: {reason}"
+        fn.__doc__ = (fn.__doc__ or "") + note
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(
+                    f"API {fn.__name__} has been deprecated"
+                    + (f"; use {update_to} instead" if update_to else ""))
+            if level >= 1:
+                warnings.warn(
+                    f"API {fn.__name__} is deprecated"
+                    + (f" since {since}" if since else "")
+                    + (f"; use {update_to} instead" if update_to else ""),
+                    DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def run_check() -> None:
+    """Sanity-check the installation on the available device: one tiny
+    matmul + grad must execute (ref: utils/install_check.py run_check —
+    same contract, prints the verdict)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.asarray(np.ones((4, 4), np.float32))
+    y = jax.grad(lambda a: jnp.sum(a @ a))(x)
+    assert y.shape == (4, 4)
+    backend = jax.default_backend()
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! backend={backend}, "
+          f"{n} device(s) visible.")
+
+
+def require_version(min_version: str,
+                    max_version: Optional[str] = None) -> None:
+    """Raise unless the installed version is within [min, max]
+    (ref: utils/__init__ require_version)."""
+    from .. import __version__
+
+    def key(v: str):
+        parts = []
+        for p in str(v).split("."):
+            digits = "".join(ch for ch in p if ch.isdigit())
+            parts.append(int(digits) if digits else 0)
+        return tuple(parts + [0] * (4 - len(parts)))
+
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("version arguments must be strings")
+    cur = key(__version__)
+    if cur < key(min_version):
+        raise Exception(
+            f"installed version {__version__} < required minimum "
+            f"{min_version}")
+    if max_version is not None and cur > key(max_version):
+        raise Exception(
+            f"installed version {__version__} > required maximum "
+            f"{max_version}")
+
+
+def try_import(module_name: str,
+               err_msg: Optional[str] = None) -> ModuleType:
+    """Import a module, raising a friendlier install hint on failure
+    (ref: utils/lazy_import.py)."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"module {module_name!r} is required but not "
+            f"installed; pip install {module_name.split('.')[0]}") from e

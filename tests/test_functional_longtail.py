@@ -645,8 +645,12 @@ class TestEmbeddingMatmulDgrad:
 
         native = grads()
         monkeypatch.setattr(C, "_EMBED_MATMUL_DGRAD_BYTES", 0)
-        # chunk floor (1024) > 24 tokens: single chunk; also force tiny
-        # chunks to exercise the accumulation loop
         matmul_dw = grads()
         np.testing.assert_allclose(matmul_dw, native, rtol=1e-5,
+                                   atol=1e-6)
+        # tiny chunk floor: 24 tokens -> 3 chunks, exercising the
+        # multi-chunk fp32 accumulation loop
+        monkeypatch.setattr(C, "_EMBED_CHUNK_FLOOR", 8)
+        chunked_dw = grads()
+        np.testing.assert_allclose(chunked_dw, native, rtol=1e-5,
                                    atol=1e-6)
