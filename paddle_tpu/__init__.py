@@ -80,6 +80,9 @@ from . import callbacks  # noqa: F401
 from .ops import inplace as _inplace_ops  # noqa: F401  (installs op_ variants)
 from . import static  # noqa: F401
 from . import geometric  # noqa: F401
+from . import device as device  # noqa: F401
+from . import hub  # noqa: F401
+from . import onnx  # noqa: F401
 
 
 def disable_static(place=None):

@@ -1,0 +1,15 @@
+"""paddle.audio equivalent (ref: python/paddle/audio/__init__.py):
+functional / features / datasets / backends submodules plus the
+module-level load / info / save IO entry points."""
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+from ._impl import (  # noqa: F401
+    LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
+    compute_fbank_matrix, create_dct, fft_frequencies, get_window,
+    hz_to_mel, mel_frequencies, mel_to_hz, power_to_db)
+from .backends import info, load, save  # noqa: F401
+
+__all__ = ["functional", "features", "datasets", "backends", "load",
+           "info", "save"]

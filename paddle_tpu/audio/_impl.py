@@ -13,10 +13,10 @@ import math
 import jax.numpy as jnp
 import numpy as np
 
-from .core.autograd import apply_op
-from .core.tensor import Tensor
-from .nn.layer import Layer
-from . import signal as _signal
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .. import signal as _signal
 
 __all__ = [
     "hz_to_mel", "mel_to_hz", "compute_fbank_matrix", "create_dct",
@@ -177,3 +177,82 @@ class MFCC(Layer):
         lm = self.log_mel(x)         # [..., n_mels, time]
         return apply_op(lambda s, d: jnp.einsum("...mt,mk->...kt", s, d),
                         lm, self.dct, op_name="mfcc_dct")
+
+
+# --- functional long tail (ref: audio/functional/functional.py) --------
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32"):
+    """Center frequencies of rfft bins (ref: functional.py
+    fft_frequencies)."""
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype="float32"):
+    """n_mels frequencies evenly spaced on the mel scale (ref:
+    functional.py mel_frequencies)."""
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray(
+        np.asarray([mel_to_hz(m, htk) for m in mels]).astype(dtype)))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    """Power spectrogram -> dB with optional dynamic-range clamp (ref:
+    functional.py power_to_db)."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+
+    def f(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            if top_db < 0:
+                raise ValueError("top_db must be non-negative")
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    return apply_op(f, spect, op_name="power_to_db")
+
+
+def get_window(window, win_length: int, fftbins: bool = True,
+               dtype="float32"):
+    """Window function by name (ref: functional/window.py get_window):
+    hamming/hann/blackman/bartlett/... periodic when fftbins=True."""
+    if isinstance(window, tuple):
+        name, args = window[0], window[1:]
+    else:
+        name, args = window, ()
+    n = win_length + (0 if fftbins else -1)
+    k = np.arange(win_length, dtype=np.float64)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / max(n, 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / max(n, 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / max(n, 1))
+             + 0.08 * np.cos(4 * np.pi * k / max(n, 1)))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * k / max(n, 1) - 1.0)
+    elif name in ("rect", "rectangular", "boxcar", "ones"):
+        w = np.ones(win_length)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((k - (win_length - 1) / 2) / std) ** 2)
+    elif name == "triang":
+        m = (win_length + 1) // 2
+        up = (np.arange(1, m + 1) - 0.5 if win_length % 2 == 0
+              else np.arange(1, m + 1))
+        denom = (win_length if win_length % 2 == 0
+                 else (win_length + 1) / 2)
+        half = up / denom if win_length % 2 == 0 else up / denom
+        w = np.concatenate([half, half[::-1][win_length % 2:]])
+        w = w[:win_length]
+    else:
+        raise ValueError(f"unknown window {name!r}")
+    return Tensor(jnp.asarray(w.astype(dtype)))

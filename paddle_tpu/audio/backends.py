@@ -1,0 +1,101 @@
+"""Audio IO backends (ref: python/paddle/audio/backends/): the wave
+backend reads/writes 16-bit PCM WAV via the stdlib — the role the
+reference's 'wave_backend' plays without soundfile installed."""
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["get_current_backend", "list_available_backends",
+           "set_backend"]
+
+_current = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend() -> str:
+    return _current
+
+
+def set_backend(backend_name: str) -> None:
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable (have "
+            f"{list_available_backends()})")
+    global _current
+    _current = backend_name
+
+
+@dataclass
+class AudioInfo:
+    """ref: backends metadata object (sample_rate, num_samples,
+    num_channels, bits_per_sample, encoding)."""
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """WAV -> (waveform Tensor, sample_rate); float32 in [-1, 1] when
+    normalize=True (ref: backends load contract)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else \
+            num_frames
+        raw = f.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, np.int16).astype(np.float32)
+        scale = 32768.0
+    elif width == 4:
+        data = np.frombuffer(raw, np.int32).astype(np.float32)
+        scale = 2147483648.0
+    elif width == 1:
+        data = np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0
+        scale = 128.0
+    else:
+        raise ValueError(f"unsupported sample width {width}")
+    data = data.reshape(-1, nch)
+    if normalize:
+        data = data / scale
+    wavef = data.T if channels_first else data
+    return Tensor(jnp.asarray(wavef)), sr
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_16",
+         bits_per_sample: int = 16) -> None:
+    """float waveform -> 16-bit PCM WAV (ref: backends save)."""
+    arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T                       # -> [frames, channels]
+    pcm = np.clip(arr, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(pcm.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

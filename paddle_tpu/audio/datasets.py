@@ -1,0 +1,61 @@
+"""paddle.audio.datasets (ref: python/paddle/audio/datasets/): ESC50
+and TESS. Served synthetically offline like the vision/text zoos —
+deterministic waveforms with the datasets' real label spaces, loud
+docstrings, identical (waveform, label) contract."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["ESC50", "TESS"]
+
+
+class _SyntheticAudioDataset(Dataset):
+    SR = 16000
+    SECONDS = 1
+    N = 64
+    N_CLASSES = 2
+
+    def __init__(self, mode: str = "train", split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        self.mode = mode
+        self.feat_type = feat_type
+        seed = 0 if mode == "train" else 1
+        rng = np.random.default_rng(seed)
+        t = np.arange(self.SR * self.SECONDS) / self.SR
+        self._labels = rng.integers(0, self.N_CLASSES, self.N)
+        # per-sample tone at a label-dependent frequency + noise: real
+        # waveform shapes, deterministic, classifiable
+        freqs = 200.0 + 120.0 * self._labels
+        phase = rng.random(self.N)[:, None]
+        self._waves = (
+            0.5 * np.sin(2 * np.pi * (freqs[:, None] * t[None] + phase))
+            + 0.05 * rng.standard_normal((self.N, t.size))
+        ).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self._waves[idx], int(self._labels[idx])
+
+    def __len__(self):
+        return self.N
+
+
+class ESC50(_SyntheticAudioDataset):
+    """ESC-50 environmental sounds (ref: audio/datasets/esc50.py; 50
+    classes, 5-fold). Offline build: synthetic waveforms over the real
+    label space."""
+    N_CLASSES = 50
+    N = 100
+
+
+class TESS(_SyntheticAudioDataset):
+    """TESS emotional speech (ref: audio/datasets/tess.py; 7 emotion
+    classes). Offline build: synthetic waveforms over the real label
+    space."""
+    N_CLASSES = 7
+    N = 70
+
+    def __init__(self, mode: str = "train", n_folds=5, split=1,
+                 feat_type="raw", archive=None, **kwargs):
+        super().__init__(mode=mode, split=split, feat_type=feat_type)

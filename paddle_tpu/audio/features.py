@@ -1,0 +1,5 @@
+"""paddle.audio.features (ref: python/paddle/audio/features/layers.py)."""
+from ._impl import (  # noqa: F401
+    LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram)
+
+__all__ = ["LogMelSpectrogram", "MelSpectrogram", "MFCC", "Spectrogram"]

@@ -45,11 +45,14 @@ class ExponentialFamily(Distribution):
                for p in self._natural_parameters]
 
         def f(*np_):
-            log_norm, grads = jax.value_and_grad(
-                lambda ps: jnp.sum(self._log_normalizer(*ps)),
-                argnums=0)(tuple(np_))
-            ent = jnp.sum(log_norm) - sum(
-                jnp.sum(t * g) for t, g in zip(np_, grads))
+            # grad of the SUMMED log-normalizer gives per-element
+            # partials (each output depends on its own parameters), so
+            # the entropy stays per-distribution over the batch shape
+            grads = jax.grad(
+                lambda ps: jnp.sum(self._log_normalizer(*ps)))(
+                    tuple(np_))
+            log_norm = self._log_normalizer(*np_)
+            ent = log_norm - sum(t * g for t, g in zip(np_, grads))
             return ent - self._mean_carrier_measure
         return apply_op(f, *[Tensor(n) for n in nat],
                         op_name="ef_entropy")
@@ -143,8 +146,15 @@ class ContinuousBernoulli(Distribution):
 
     def entropy(self):
         def f(p):
-            mean = self.mean._data if isinstance(self.mean, Tensor) \
-                else self.mean
+            # mean recomputed from p INSIDE the trace: pulling the
+            # cached self.mean in as a constant silently zeroes the
+            # entropy's gradient w.r.t. probs
+            safe = self._stable(p)
+            mean = safe / (2.0 * safe - 1.0) + \
+                1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+            mid = 0.5 + (p - 0.5) / 3.0
+            lo, hi = self._lims
+            mean = jnp.where((p > lo) & (p < hi), mid, mean)
             return -(self._log_C(p) + mean * jnp.log(p)
                      + (1.0 - mean) * jnp.log1p(-p))
         return apply_op(f, self.probs, op_name="cb_entropy")

@@ -149,6 +149,18 @@ def _np_of(t):
     return np.asarray(t.numpy() if isinstance(t, Tensor) else t)
 
 
+def _host_rng():
+    """Host-side RNG seeded from the framework key stream so
+    paddle.seed() makes graph sampling reproducible like every other
+    random op."""
+    import numpy as np
+
+    from ..core import random as random_mod
+    seed = int(jax.device_get(
+        random_mod.derive_seed(random_mod.next_key())))
+    return np.random.default_rng(seed & 0x7FFFFFFF)
+
+
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
                      return_eids=False, perm_buffer=None, name=None):
     """Uniform neighbor sampling over a CSC graph (ref:
@@ -163,7 +175,7 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
     rowv, colv = _np_of(row).reshape(-1), _np_of(colptr).reshape(-1)
     nodes = _np_of(input_nodes).reshape(-1)
     eidv = _np_of(eids).reshape(-1) if eids is not None else None
-    rng = np.random.default_rng()
+    rng = _host_rng()
     out_n, out_c, out_e = [], [], []
     for n in nodes:
         lo, hi = int(colv[n]), int(colv[n + 1])
@@ -199,7 +211,7 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
     wv = _np_of(edge_weight).reshape(-1).astype(np.float64)
     nodes = _np_of(input_nodes).reshape(-1)
     eidv = _np_of(eids).reshape(-1) if eids is not None else None
-    rng = np.random.default_rng()
+    rng = _host_rng()
     out_n, out_c, out_e = [], [], []
     for n in nodes:
         lo, hi = int(colv[n]), int(colv[n + 1])

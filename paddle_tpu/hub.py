@@ -1,0 +1,62 @@
+"""paddle.hub equivalent (ref: python/paddle/hub.py): list/help/load
+model entrypoints from a ``hubconf.py``. Local directories work fully;
+github/gitee sources need network and fail loudly on this offline
+build, naming the local alternative."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str, source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access (github/gitee "
+            f"clone); this build is offline — clone the repo yourself "
+            f"and use source='local' with its path")
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def list(repo_dir: str, source: str = "github",
+         force_reload: bool = False):  # noqa: A001 - reference name
+    """Entrypoint names exposed by the repo's hubconf (ref: hub.py
+    list)."""
+    mod = _load_hubconf(repo_dir, source)
+    return [name for name, v in vars(mod).items()
+            if callable(v) and not name.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):  # noqa: A001 - reference name
+    """Docstring of one entrypoint (ref: hub.py help)."""
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Build one entrypoint (ref: hub.py load)."""
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn(**kwargs)

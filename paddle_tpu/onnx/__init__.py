@@ -1,0 +1,29 @@
+"""paddle.onnx equivalent (ref: python/paddle/onnx/__init__.py).
+
+The reference's export delegates to the external ``paddle2onnx``
+package and raises if it's missing; this build mirrors that contract.
+The TPU-native serialized format is paddle_tpu.jit.save /
+inference.save_inference_model (StableHLO AOT artifacts), which serve
+the deployment role ONNX plays in the reference stack.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """ref: onnx/export.py export — requires paddle2onnx, exactly as
+    the reference does."""
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle.onnx.export requires the paddle2onnx package "
+            "(unavailable in this build). For a deployable serialized "
+            "model use paddle_tpu.jit.save or "
+            "paddle_tpu.inference.save_inference_model(aot=True) — the "
+            "StableHLO artifact serves without the model class "
+            "importable.") from e
+    raise NotImplementedError(
+        "paddle2onnx found, but ONNX emission from the TPU build's "
+        "StableHLO programs is not implemented")

@@ -269,15 +269,24 @@ def cholesky_inverse(x, upper=False, name=None):
 
 def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
     """ref: tensor/linalg.py vector_norm — p-norm treating the input
-    (or the given axes) as a flat vector."""
+    (or the given axes, collapsed together) as a flat vector; multi-axis
+    input is FLATTENED, never treated as a matrix norm."""
+    axes = (tuple(axis) if isinstance(axis, (list, tuple))
+            else None if axis is None else (int(axis),))
+
     def f(a):
-        if axis is None:
-            a = a.reshape(-1)
-            ax = 0
-        else:
-            ax = axis
-        return jnp.linalg.norm(a.astype(jnp.float32), ord=p, axis=ax,
-                               keepdims=keepdim)
+        a32 = a.astype(jnp.float32)
+        if axes is None:
+            return jnp.linalg.norm(a32.reshape(-1), ord=p)
+        ax = tuple(d % a.ndim for d in axes)
+        rest = tuple(d for d in range(a.ndim) if d not in ax)
+        moved = jnp.transpose(a32, rest + ax)
+        flat = moved.reshape(moved.shape[:len(rest)] + (-1,))
+        out = jnp.linalg.norm(flat, ord=p, axis=-1)
+        if keepdim:
+            for d in sorted(ax):
+                out = jnp.expand_dims(out, d)
+        return out
     return apply_op(f, x, op_name="vector_norm")
 
 

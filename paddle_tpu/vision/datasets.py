@@ -103,3 +103,92 @@ class VOC2012(Dataset):
 
     def __len__(self):
         return len(self.images)
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                   ".tif", ".tiff", ".webp")
+
+
+def _scan_files(root, extensions, is_valid_file):
+    """Sorted recursive file scan shared by DatasetFolder/ImageFolder:
+    is_valid_file wins when given, else the extension allowlist."""
+    import os
+
+    exts = tuple(e.lower() for e in (extensions or _IMG_EXTENSIONS))
+    found = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            path = os.path.join(dirpath, fname)
+            ok = (is_valid_file(path) if is_valid_file
+                  else fname.lower().endswith(exts))
+            if ok:
+                found.append(path)
+    return found
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class dataset (ref:
+    vision/datasets/folder.py DatasetFolder): root/<class>/<file>,
+    classes sorted alphabetically, loaded via the configured image
+    backend (PIL here)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        import os
+
+        self.root = root
+        self.transform = transform
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = []
+        for c in self.classes:
+            for path in _scan_files(os.path.join(root, c), extensions,
+                                    is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"found no valid files under {root}")
+        self.loader = loader or self._pil_loader
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat image-file dataset, no labels (ref:
+    vision/datasets/folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.samples = _scan_files(root, extensions, is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f"found no valid files under {root}")
+        self.loader = loader or DatasetFolder._pil_loader
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+__all__ += ["DatasetFolder", "ImageFolder"]

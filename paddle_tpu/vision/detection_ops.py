@@ -1,0 +1,692 @@
+"""Detection op suite: YOLO decode/loss, SSD priors, ROI pooling
+variants, deformable conv, FPN routing, RPN proposals, matrix NMS,
+image IO.
+
+ref: python/paddle/vision/ops.py (yolo_loss :69, yolo_box :277,
+prior_box :438, deform_conv2d :766, distribute_fpn_proposals :1175,
+read_file :1345, decode_jpeg :1388, psroi_pool :1441, roi_pool :1572,
+matrix_nms, generate_proposals). Design split: dense decode/loss math
+runs on device (jnp, differentiable); ops with data-dependent output
+sizes (proposal generation, FPN routing, NMS) are host-side like the
+rest of this build's ragged ops.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = [
+    "yolo_loss", "yolo_box", "prior_box", "deform_conv2d",
+    "DeformConv2D", "distribute_fpn_proposals", "generate_proposals",
+    "read_file", "decode_jpeg", "roi_pool", "RoIPool", "psroi_pool",
+    "PSRoIPool", "RoIAlign", "matrix_nms",
+]
+
+
+def _np_of(t):
+    return np.asarray(t.numpy() if isinstance(t, Tensor) else t)
+
+
+# ---------------------------------------------------------------------------
+# YOLO
+# ---------------------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLOv3 head [N, S*(5+C), H, W] into boxes + scores
+    (ref: ops.py yolo_box). Returns (boxes [N, H*W*S, 4] in xyxy image
+    coords, scores [N, H*W*S, C]); predictions below conf_thresh get
+    zeroed scores."""
+    s = len(anchors) // 2
+
+    def f(xa, imgs):
+        n, _, h, w = xa.shape
+        an = jnp.asarray(anchors, jnp.float32).reshape(s, 2)
+        if iou_aware:
+            ioup, xa_ = xa[:, :s], xa[:, s:]
+        else:
+            ioup, xa_ = None, xa
+        p = xa_.reshape(n, s, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        bias = 0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y - bias
+              + gx[None, None, None, :]) / w
+        cy = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y - bias
+              + gy[None, None, :, None]) / h
+        bw = (jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None]
+              / (w * downsample_ratio))
+        bh = (jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None]
+              / (h * downsample_ratio))
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        if iou_aware:
+            iou_p = jax.nn.sigmoid(ioup.reshape(n, s, h, w))
+            conf = conf ** (1 - iou_aware_factor) * \
+                iou_p ** iou_aware_factor
+        cls = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+        im_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        im_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * im_w
+        y1 = (cy - bh / 2) * im_h
+        x2 = (cx + bw / 2) * im_w
+        y2 = (cy + bh / 2) * im_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, im_w - 1)
+            y1 = jnp.clip(y1, 0, im_h - 1)
+            x2 = jnp.clip(x2, 0, im_w - 1)
+            y2 = jnp.clip(y2, 0, im_h - 1)
+        keep = conf > conf_thresh                   # [N, S, H, W]
+        boxes = jnp.stack([x1, y1, x2, y2], axis=2)  # [N, S, 4, H, W]
+        boxes = jnp.where(keep[:, :, None], boxes, 0.0)
+        cls = jnp.where(keep[:, :, None], cls, 0.0)
+        # [N, S, 4, H, W] -> [N, H*W*S, 4]
+        boxes = jnp.transpose(boxes, (0, 3, 4, 1, 2)).reshape(n, -1, 4)
+        cls = jnp.transpose(cls, (0, 3, 4, 1, 2)).reshape(
+            n, -1, class_num)
+        return boxes, cls
+
+    return apply_op(f, x, img_size, op_name="yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (ref: ops.py yolo_loss): per-anchor box
+    regression (BCE on sigmoid x/y, L1 on w/h), objectness BCE with an
+    ignore region above ``ignore_thresh`` IoU, and class BCE. gt_box is
+    [N, B, 4] (cx, cy, w, h in image units), gt_label [N, B]; ground
+    truths are matched to the best-IoU anchor of this head's mask."""
+    s = len(anchor_mask)
+    all_an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_an = all_an[np.asarray(anchor_mask)]
+
+    def f(xa, gb, gl, *maybe_score):
+        n, _, h, w = xa.shape
+        p = xa.reshape(n, s, 5 + class_num, h, w)
+        stride = downsample_ratio
+        img_w = w * stride
+        img_h = h * stride
+        an = jnp.asarray(mask_an)
+        # ground-truth grid placement
+        gx = gb[..., 0] / img_w          # [N, B] in [0,1]
+        gy = gb[..., 1] / img_h
+        gw = gb[..., 2] / img_w
+        gh = gb[..., 3] / img_h
+        valid = (gw > 0) & (gh > 0)
+        gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+        # best anchor (across the FULL anchor set, matched into this
+        # mask — the YOLOv3 assignment rule)
+        awh = jnp.asarray(all_an) / jnp.asarray(
+            [img_w, img_h], jnp.float32)
+        inter = (jnp.minimum(gw[..., None], awh[None, None, :, 0])
+                 * jnp.minimum(gh[..., None], awh[None, None, :, 1]))
+        union = (gw * gh)[..., None] + awh[:, 0] * awh[:, 1] - inter
+        an_iou = inter / jnp.maximum(union, 1e-10)
+        best = jnp.argmax(an_iou, axis=-1)              # [N, B]
+        mask_arr = jnp.asarray(np.asarray(anchor_mask))
+        in_mask = (best[..., None] == mask_arr).any(-1) & valid
+        slot = jnp.argmax(
+            (best[..., None] == mask_arr).astype(jnp.int32), -1)
+
+        # build dense targets via scatter (B is small)
+        obj_t = jnp.zeros((n, s, h, w))
+        tx = jnp.zeros((n, s, h, w))
+        ty = jnp.zeros((n, s, h, w))
+        tw = jnp.zeros((n, s, h, w))
+        th = jnp.zeros((n, s, h, w))
+        tcls = jnp.zeros((n, s, class_num, h, w))
+        tscale = jnp.zeros((n, s, h, w))
+        bidx = jnp.arange(n)[:, None] * jnp.ones_like(gi)
+        wgt = maybe_score[0] if maybe_score else jnp.ones_like(gx)
+        sel = (bidx, slot, gj, gi)
+        upd = lambda t, v: t.at[sel].add(  # noqa: E731
+            jnp.where(in_mask, v, 0.0))
+        obj_t = upd(obj_t, jnp.ones_like(gx) * wgt)
+        tx = upd(tx, gx * w - gi)
+        ty = upd(ty, gy * h - gj)
+        tw = upd(tw, jnp.log(jnp.maximum(
+            gw * img_w / jnp.maximum(an[slot, 0], 1e-6), 1e-6)))
+        th = upd(th, jnp.log(jnp.maximum(
+            gh * img_h / jnp.maximum(an[slot, 1], 1e-6), 1e-6)))
+        tscale = upd(tscale, 2.0 - gw * gh)
+        cls_sel = (bidx, slot, gl.astype(jnp.int32), gj, gi)
+        tcls = tcls.at[cls_sel].add(jnp.where(in_mask, 1.0, 0.0))
+        obj_mask = (obj_t > 0).astype(jnp.float32)
+
+        # ignore mask: predictions whose best IoU with any gt exceeds
+        # the threshold are not penalized as background
+        px = (jax.nn.sigmoid(p[:, :, 0])
+              + jnp.arange(w, dtype=jnp.float32)) / w
+        py = (jax.nn.sigmoid(p[:, :, 1])
+              + jnp.arange(h, dtype=jnp.float32)[:, None]) / h
+        pw = jnp.exp(jnp.clip(p[:, :, 2], -10, 10)) * \
+            an[None, :, 0, None, None] / img_w
+        ph = jnp.exp(jnp.clip(p[:, :, 3], -10, 10)) * \
+            an[None, :, 1, None, None] / img_h
+
+        def box_iou(ax, ay, aw2, ah2, bx, by, bw2, bh2):
+            ax1, ax2 = ax - aw2 / 2, ax + aw2 / 2
+            ay1, ay2 = ay - ah2 / 2, ay + ah2 / 2
+            bx1, bx2 = bx - bw2 / 2, bx + bw2 / 2
+            by1, by2 = by - bh2 / 2, by + bh2 / 2
+            iw = jnp.maximum(
+                jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+            ih = jnp.maximum(
+                jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+            inter2 = iw * ih
+            return inter2 / jnp.maximum(
+                aw2 * ah2 + bw2 * bh2 - inter2, 1e-10)
+
+        ious = box_iou(px[..., None], py[..., None], pw[..., None],
+                       ph[..., None],
+                       gx[:, None, None, None, :],
+                       gy[:, None, None, None, :],
+                       gw[:, None, None, None, :],
+                       gh[:, None, None, None, :])
+        ious = jnp.where(valid[:, None, None, None, :], ious, 0.0)
+        best_iou = jnp.max(ious, axis=-1)
+        noobj_mask = ((best_iou < ignore_thresh).astype(jnp.float32)
+                      * (1.0 - obj_mask))
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        delta = 0.1 / class_num if (use_label_smooth
+                                    and class_num > 1) else 0.0
+        tcls_s = tcls * (1.0 - delta) + delta / max(class_num, 1)
+        loss_xy = jnp.sum(
+            (bce(p[:, :, 0], tx) + bce(p[:, :, 1], ty))
+            * obj_mask * tscale, axis=(1, 2, 3))
+        loss_wh = jnp.sum(
+            (jnp.abs(p[:, :, 2] - tw) + jnp.abs(p[:, :, 3] - th))
+            * obj_mask * tscale, axis=(1, 2, 3))
+        loss_obj = jnp.sum(
+            bce(p[:, :, 4], obj_t) * (obj_mask + noobj_mask),
+            axis=(1, 2, 3))
+        loss_cls = jnp.sum(
+            bce(p[:, :, 5:], tcls_s)
+            * obj_mask[:, :, None], axis=(1, 2, 3, 4))
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    args = (x, gt_box, gt_label) + ((gt_score,)
+                                    if gt_score is not None else ())
+    return apply_op(f, *args, op_name="yolo_loss")
+
+
+# ---------------------------------------------------------------------------
+# SSD priors
+# ---------------------------------------------------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) generation (ref: ops.py prior_box). Returns
+    (boxes [H, W, P, 4] normalized xyxy, variances same shape)."""
+    feat = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    img = image._data if isinstance(image, Tensor) else jnp.asarray(image)
+    h, w = int(feat.shape[2]), int(feat.shape[3])
+    im_h, im_w = int(img.shape[2]), int(img.shape[3])
+    if isinstance(min_sizes, (int, float)):
+        min_sizes = [min_sizes]
+    if isinstance(max_sizes, (int, float)):
+        max_sizes = [max_sizes]
+    if isinstance(aspect_ratios, (int, float)):
+        aspect_ratios = [aspect_ratios]
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    step_w = steps[0] or im_w / w
+    step_h = steps[1] or im_h / h
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                big = math.sqrt(ms * max_sizes[k])
+                whs.append((big, big))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                big = math.sqrt(ms * max_sizes[k])
+                whs.append((big, big))
+    whs_np = np.asarray(whs, np.float32)
+    cx = (np.arange(w, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(h, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)                   # [H, W]
+    boxes = np.empty((h, w, len(whs), 4), np.float32)
+    boxes[..., 0] = (cxg[:, :, None] - whs_np[:, 0] / 2) / im_w
+    boxes[..., 1] = (cyg[:, :, None] - whs_np[:, 1] / 2) / im_h
+    boxes[..., 2] = (cxg[:, :, None] + whs_np[:, 0] / 2) / im_w
+    boxes[..., 3] = (cyg[:, :, None] + whs_np[:, 1] / 2) / im_h
+    if clip:
+        boxes = boxes.clip(0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (ref: ops.py deform_conv2d; Dai et al.
+    2017 / Zhu et al. 2019): each kernel tap samples the input at its
+    grid position plus a learned offset (bilinear), optionally
+    modulated by ``mask``; the result contracts with the weights as a
+    dense matmul — gather + MXU, no scatter."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else dilation
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d: groups/deformable_groups > 1 unsupported")
+
+    def f(xa, off, wgt, *rest):
+        n, c, h, w = xa.shape
+        co, ci, kh, kw = wgt.shape
+        oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        xp = jnp.pad(xa, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        hp, wp = h + 2 * ph, w + 2 * pw
+        off_r = off.reshape(n, kh * kw, 2, oh, ow)
+        base_y = (jnp.arange(oh) * sh)[None, :, None]
+        base_x = (jnp.arange(ow) * sw)[None, None, :]
+        ky = (jnp.arange(kh) * dh).repeat(kw)[:, None, None]
+        kx = jnp.tile(jnp.arange(kw) * dw, kh)[:, None, None]
+        ys = base_y + ky + off_r[:, :, 0]           # [N, K, OH, OW]
+        xs = base_x + kx + off_r[:, :, 1]
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy = ys - y0
+        wx = xs - x0
+        valid = ((ys > -1) & (ys < hp) & (xs > -1) & (xs < wp))
+
+        def gather(yy, xx):
+            yc = jnp.clip(yy, 0, hp - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, wp - 1).astype(jnp.int32)
+            # per-image gather -> [N, C, K, OH, OW]
+            return jax.vmap(
+                lambda img, yv, xv: img[:, yv, xv])(xp, yc, xc)
+
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x0 + 1)
+        v10 = gather(y0 + 1, x0)
+        v11 = gather(y0 + 1, x0 + 1)
+        wy_ = wy[:, None]
+        wx_ = wx[:, None]
+        sampled = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                   + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        sampled = jnp.where(valid[:, None], sampled, 0.0)
+        if rest:  # v2 modulation mask [N, K, OH, OW]
+            m = rest[0].reshape(n, 1, kh * kw, oh, ow)
+            sampled = sampled * m
+        # contract [N, C, K, OH, OW] x [CO, C, K] -> [N, CO, OH, OW]
+        wk = wgt.reshape(co, ci * kh * kw)
+        cols = sampled.reshape(n, c * kh * kw, oh * ow)
+        out = jnp.einsum("ok,nkp->nop", wk, cols).reshape(n, co, oh, ow)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    out = apply_op(f, *args, op_name="deform_conv2d")
+    if bias is not None:
+        b = bias if isinstance(bias, Tensor) else Tensor(jnp.asarray(bias))
+        out = out + b.reshape([1, -1, 1, 1])
+    return out
+
+
+class DeformConv2D(Layer):
+    """Layer wrapper over deform_conv2d (ref: ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from .. import nn
+        kh, kw = (kernel_size, kernel_size) if isinstance(
+            kernel_size, int) else kernel_size
+        bound = 1.0 / math.sqrt(in_channels * kh * kw)
+        init = nn.initializer.Uniform(-bound, bound)
+        from ..core.tensor import Parameter
+        self.weight = Parameter(init(
+            (out_channels, in_channels // groups, kh, kw), jnp.float32))
+        self.bias = (Parameter(jnp.zeros((out_channels,), jnp.float32))
+                     if bias_attr is not False else None)
+        self._cfg = dict(stride=stride, padding=padding,
+                         dilation=dilation,
+                         deformable_groups=deformable_groups,
+                         groups=groups)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling family
+# ---------------------------------------------------------------------------
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max-pool ROI bins (ref: ops.py roi_pool). Bins are sampled on a
+    fixed dense grid then max-reduced — static shapes for XLA; exact
+    when the grid resolution covers every integer cell, near-exact
+    otherwise."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    samples = 4  # sub-samples per bin edge
+
+    def f(feat, bxs):
+        img = feat[0]
+        c, h, w = img.shape
+        x1 = jnp.round(bxs[:, 0] * spatial_scale)
+        y1 = jnp.round(bxs[:, 1] * spatial_scale)
+        x2 = jnp.maximum(jnp.round(bxs[:, 2] * spatial_scale), x1 + 1)
+        y2 = jnp.maximum(jnp.round(bxs[:, 3] * spatial_scale), y1 + 1)
+        bh = (y2 - y1) / oh
+        bw = (x2 - x1) / ow
+        sy = (jnp.arange(oh * samples) + 0.5) / samples
+        sx = (jnp.arange(ow * samples) + 0.5) / samples
+        ys = y1[:, None] + sy[None, :] * bh[:, None]   # [R, OH*S]
+        xs = x1[:, None] + sx[None, :] * bw[:, None]
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        vals = img[:, yi[:, :, None], xi[:, None, :]]  # [C,R,OHS,OWS]
+        r = vals.shape[1]
+        vals = vals.reshape(c, r, oh, samples, ow, samples)
+        out = jnp.max(vals, axis=(3, 5))               # [C, R, OH, OW]
+        return jnp.transpose(out, (1, 0, 2, 3))
+
+    return apply_op(f, x, boxes, op_name="roi_pool")
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive ROI average pooling (ref: ops.py psroi_pool;
+    R-FCN): input channels C = out_c * oh * ow; bin (i, j) of output
+    channel k averages input channel k*oh*ow + i*ow + j."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    samples = 4
+
+    def f(feat, bxs):
+        img = feat[0]
+        c, h, w = img.shape
+        out_c = c // (oh * ow)
+        x1 = bxs[:, 0] * spatial_scale
+        y1 = bxs[:, 1] * spatial_scale
+        x2 = bxs[:, 2] * spatial_scale
+        y2 = bxs[:, 3] * spatial_scale
+        bh = (y2 - y1) / oh
+        bw = (x2 - x1) / ow
+        sy = (jnp.arange(oh * samples) + 0.5) / samples
+        sx = (jnp.arange(ow * samples) + 0.5) / samples
+        ys = y1[:, None] + sy[None, :] * bh[:, None]
+        xs = x1[:, None] + sx[None, :] * bw[:, None]
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        vals = img[:, yi[:, :, None], xi[:, None, :]]
+        r = vals.shape[1]
+        vals = vals.reshape(c, r, oh, samples, ow, samples)
+        avg = jnp.mean(vals, axis=(3, 5))              # [C, R, OH, OW]
+        # pick the position-sensitive channel per output bin
+        avg = avg.reshape(out_c, oh, ow, r, oh, ow)
+        ii = jnp.arange(oh)
+        jj = jnp.arange(ow)
+        out = avg[:, ii[:, None], jj[None, :], :,
+                  ii[:, None], jj[None, :]]
+        # [OH, OW, OUT_C, R] -> [R, OUT_C, OH, OW]
+        return jnp.transpose(out, (3, 2, 0, 1))
+
+    return apply_op(f, x, boxes, op_name="psroi_pool")
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+class RoIAlign(Layer):
+    """Layer wrapper over roi_align (ref: ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        from .ops import roi_align
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# host-side proposal machinery
+# ---------------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Route ROIs to FPN levels by scale (ref: ops.py
+    distribute_fpn_proposals): level = floor(refer_level +
+    log2(sqrt(area) / refer_scale)). Host-side (ragged outputs)."""
+    rois = _np_of(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 1e-12))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-12))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, restore_parts = [], []
+    nums = []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        outs.append(Tensor(jnp.asarray(rois[idx])))
+        nums.append(Tensor(jnp.asarray(
+            np.asarray([len(idx)], np.int32))))
+        restore_parts.append(idx)
+    order = np.concatenate(restore_parts) if restore_parts else \
+        np.empty(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    return outs, Tensor(jnp.asarray(restore.reshape(-1, 1))), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True,
+                       name=None):
+    """RPN proposal generation (ref: ops.py generate_proposals): decode
+    anchor deltas, clip to image, filter small, NMS, top-k. Host-side
+    ragged op; single-image (N=1) like the build's other proposal ops."""
+    from .ops import nms as nms_op
+    sc = _np_of(scores)
+    bd = _np_of(bbox_deltas)
+    im = _np_of(img_size)
+    an = _np_of(anchors).reshape(-1, 4)
+    var = _np_of(variances).reshape(-1, 4)
+    n = sc.shape[0]
+    all_rois, all_nums = [], []
+    off = 1.0 if pixel_offset else 0.0
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].reshape(-1, 4, *bd.shape[2:]) if False else \
+            bd[i].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:int(pre_nms_top_n)]
+        s, d, a, v = s[order], d[order], an[order % len(an)], \
+            var[order % len(var)]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0))
+        bh = ah * np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0))
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], axis=1)
+        ih, iw = im[i, 0], im[i, 1]
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, iw - off)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, ih - off)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                   & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep_sz], s[keep_sz]
+        keep = _np_of(nms_op(Tensor(jnp.asarray(boxes)),
+                             iou_threshold=nms_thresh,
+                             scores=Tensor(jnp.asarray(s))))
+        keep = keep[:int(post_nms_top_n)]
+        all_rois.append(boxes[keep])
+        all_nums.append(len(keep))
+    rois = np.concatenate(all_rois) if all_rois else np.empty((0, 4))
+    rois_t = Tensor(jnp.asarray(rois.astype(np.float32)))
+    scores_out = Tensor(jnp.asarray(
+        np.concatenate([sc[i].transpose(1, 2, 0).reshape(-1)[:nn]
+                        for i, nn in enumerate(all_nums)])
+        if all_nums else np.empty(0, np.float32)))
+    if return_rois_num:
+        return rois_t, scores_out, Tensor(jnp.asarray(
+            np.asarray(all_nums, np.int32)))
+    return rois_t, scores_out
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (ref: ops.py matrix_nms; SOLOv2): instead of hard
+    suppression, each box's score decays by its IoU with higher-scored
+    boxes of the same class. Host-side."""
+    b = _np_of(bboxes)
+    s = _np_of(scores)
+    n, num_cls = s.shape[0], s.shape[1]
+    outs, idxs, nums = [], [], []
+    for i in range(n):
+        dets = []
+        for c in range(num_cls):
+            if c == background_label:
+                continue
+            sc = s[i, c]
+            sel = np.nonzero(sc > score_threshold)[0]
+            if len(sel) == 0:
+                continue
+            order = sel[np.argsort(-sc[sel])][:int(nms_top_k)]
+            bs, ss = b[i][order], sc[order]
+            x1 = np.maximum(bs[:, None, 0], bs[None, :, 0])
+            y1 = np.maximum(bs[:, None, 1], bs[None, :, 1])
+            x2 = np.minimum(bs[:, None, 2], bs[None, :, 2])
+            y2 = np.minimum(bs[:, None, 3], bs[None, :, 3])
+            off = 0.0 if normalized else 1.0
+            iw = np.maximum(x2 - x1 + off, 0)
+            ih = np.maximum(y2 - y1 + off, 0)
+            inter = iw * ih
+            area = ((bs[:, 2] - bs[:, 0] + off)
+                    * (bs[:, 3] - bs[:, 1] + off))
+            iou = inter / np.maximum(
+                area[:, None] + area[None, :] - inter, 1e-10)
+            iou = np.triu(iou, 1)                 # j suppressed by i<j
+            max_iou = iou.max(axis=0)             # per column
+            comp = iou.max(axis=1, initial=0.0)   # compensation
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - comp[:, None],
+                                                1e-10)).min(axis=0)
+            del max_iou
+            new_s = ss * decay
+            keep = new_s > post_threshold
+            for j in np.nonzero(keep)[0]:
+                dets.append((c, new_s[j], *bs[j], order[j]))
+        dets.sort(key=lambda t: -t[1])
+        if keep_top_k > 0:
+            dets = dets[:int(keep_top_k)]
+        outs.append(np.asarray([d[:6] for d in dets], np.float32)
+                    if dets else np.empty((0, 6), np.float32))
+        idxs.append(np.asarray([d[6] for d in dets], np.int64)
+                    if dets else np.empty(0, np.int64))
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs)
+                             if outs else np.empty((0, 6), np.float32)))
+    rois_num = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    index = Tensor(jnp.asarray(np.concatenate(idxs)
+                               if idxs else np.empty(0, np.int64)))
+    if return_index:
+        return (out, index, rois_num) if return_rois_num else \
+            (out, index)
+    return (out, None, rois_num) if return_rois_num else (out, None)
+
+
+# ---------------------------------------------------------------------------
+# image IO
+# ---------------------------------------------------------------------------
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 tensor (ref: ops.py read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG bytes -> CHW uint8 tensor (ref: ops.py decode_jpeg; the
+    reference uses nvjpeg — PIL serves the host-side role here)."""
+    import io
+
+    from PIL import Image
+    data = _np_of(x).tobytes()
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

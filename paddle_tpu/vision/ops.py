@@ -10,7 +10,17 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..core.autograd import apply_op
 
-__all__ = ["nms", "box_coder", "roi_align"]
+from .detection_ops import (  # noqa: F401
+    DeformConv2D, PSRoIPool, RoIAlign, RoIPool, decode_jpeg,
+    deform_conv2d, distribute_fpn_proposals, generate_proposals,
+    matrix_nms, prior_box, psroi_pool, read_file, roi_pool, yolo_box,
+    yolo_loss)
+
+__all__ = ["nms", "box_coder", "roi_align", "yolo_loss", "yolo_box",
+           "prior_box", "deform_conv2d", "DeformConv2D",
+           "distribute_fpn_proposals", "generate_proposals",
+           "read_file", "decode_jpeg", "roi_pool", "RoIPool",
+           "psroi_pool", "PSRoIPool", "RoIAlign", "matrix_nms"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,

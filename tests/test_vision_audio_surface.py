@@ -1,0 +1,238 @@
+"""Vision transforms/ops + audio package + hub/onnx surface tests
+(ref: python/paddle/vision/transforms/, vision/ops.py, audio/,
+hub.py, onnx/)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestTransformsFunctional:
+    def _img(self, rng):
+        return (rng.random((8, 10, 3)) * 255).astype(np.uint8)
+
+    def test_flips_resize_pad_crop(self, rng):
+        import paddle_tpu.vision.transforms as T
+        img = self._img(rng)
+        np.testing.assert_array_equal(T.vflip(T.vflip(img)), img)
+        np.testing.assert_array_equal(T.hflip(T.hflip(img)), img)
+        assert T.resize(img, (16, 20)).shape == (16, 20, 3)
+        np.testing.assert_allclose(
+            T.resize(img.astype(np.float32), (8, 10)),
+            img.astype(np.float32), atol=1e-3)
+        assert T.pad(img, 2).shape == (12, 14, 3)
+        assert T.crop(img, 1, 2, 4, 5).shape == (4, 5, 3)
+        assert T.center_crop(img, 4).shape == (4, 4, 3)
+
+    def test_geometric_warps_identity(self, rng):
+        import paddle_tpu.vision.transforms as T
+        img = self._img(rng).astype(np.float32)
+        np.testing.assert_allclose(T.rotate(img, 0.0), img, atol=1e-3)
+        np.testing.assert_allclose(
+            T.affine(img, 0, (0, 0), 1.0, (0, 0)), img, atol=1e-3)
+        h, w = img.shape[:2]
+        corners = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        np.testing.assert_allclose(
+            T.perspective(img, corners, corners), img, atol=1e-2)
+
+    def test_photometric_identities(self, rng):
+        import paddle_tpu.vision.transforms as T
+        img = self._img(rng)
+        np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+        np.testing.assert_array_equal(T.adjust_contrast(img, 1.0), img)
+        np.testing.assert_array_equal(T.adjust_saturation(img, 1.0), img)
+        # hue: zero shift ~= identity, full cycle ~= identity
+        assert np.abs(T.adjust_hue(img, 0.0).astype(int)
+                      - img.astype(int)).max() <= 1
+        cyc = T.adjust_hue(T.adjust_hue(img, 0.5), 0.5)
+        assert np.abs(cyc.astype(int) - img.astype(int)).max() <= 2
+        g = T.to_grayscale(img)
+        assert g.shape == (8, 10, 1)
+
+    def test_erase_and_random_classes(self, rng):
+        import random as pyrandom
+
+        import paddle_tpu.vision.transforms as T
+        pyrandom.seed(0)
+        img = self._img(rng)
+        er = T.erase(img, 1, 1, 3, 3, 0)
+        assert er[1:4, 1:4].sum() == 0 and img[1:4, 1:4].sum() > 0
+        assert T.RandomResizedCrop(6)(img).shape == (6, 6, 3)
+        assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img).shape == img.shape
+        assert T.RandomAffine(10, (0.1, 0.1), (0.9, 1.1), 5)(
+            img).shape == img.shape
+        assert T.RandomRotation(15)(img).shape == img.shape
+        assert T.RandomPerspective(1.0, 0.3)(img).shape == img.shape
+        assert T.Grayscale(3)(img).shape == (8, 10, 3)
+        assert T.RandomErasing(1.0)(img).shape == img.shape
+
+
+class TestDetectionOps:
+    def test_yolo_box_and_loss(self, rng):
+        import paddle_tpu.vision.ops as V
+        S, C = 3, 4
+        anchors = [10, 13, 16, 30, 33, 23]
+        x = paddle.to_tensor(
+            rng.normal(size=(2, S * (5 + C), 4, 4)).astype(np.float32))
+        img = paddle.to_tensor(np.array([[128, 128]] * 2, np.int32))
+        boxes, scores = V.yolo_box(x, img, anchors, C, 0.5, 32)
+        assert boxes.shape == [2, 48, 4] and scores.shape == [2, 48, C]
+        gtb = paddle.to_tensor(
+            (rng.random((2, 3, 4)) * 64 + 16).astype(np.float32))
+        gtl = paddle.to_tensor(rng.integers(0, C, (2, 3)).astype(np.int32))
+        xt = paddle.to_tensor(
+            rng.normal(size=(2, S * (5 + C), 4, 4)).astype(np.float32)
+            * 0.1, stop_gradient=False)
+        loss = V.yolo_loss(xt, gtb, gtl, anchors, [0, 1, 2], C, 0.7, 32)
+        assert loss.shape == [2] and np.isfinite(loss.numpy()).all()
+        loss.sum().backward()
+        assert np.isfinite(xt.grad.numpy()).all()
+
+    def test_deform_conv_zero_offsets_is_conv(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        import paddle_tpu.vision.ops as V
+        xa = rng.normal(size=(1, 2, 6, 6)).astype(np.float32)
+        wt = rng.normal(size=(3, 2, 3, 3)).astype(np.float32)
+        off = np.zeros((1, 18, 4, 4), np.float32)
+        out = V.deform_conv2d(paddle.to_tensor(xa),
+                              paddle.to_tensor(off),
+                              paddle.to_tensor(wt))
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(xa), jnp.asarray(wt), (1, 1), "VALID")
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                                   atol=1e-4)
+        layer = V.DeformConv2D(2, 3, 3)
+        assert layer(paddle.to_tensor(xa),
+                     paddle.to_tensor(off)).shape == [1, 3, 4, 4]
+
+    def test_roi_pool_family(self):
+        import paddle_tpu.vision.ops as V
+        feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 4, 4]], np.float32)
+        rp = V.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                        None, 2)
+        np.testing.assert_allclose(rp.numpy()[0, 0],
+                                   [[5, 7], [13, 15]])
+        featp = np.stack([np.full((4, 4), i, np.float32)
+                          for i in range(4)])[None]
+        pp = V.psroi_pool(paddle.to_tensor(featp),
+                          paddle.to_tensor(rois), None, 2)
+        np.testing.assert_allclose(pp.numpy()[0, 0], [[0, 1], [2, 3]])
+        ra = V.RoIAlign(2)(paddle.to_tensor(feat),
+                           paddle.to_tensor(rois), None)
+        assert ra.shape == [1, 1, 2, 2]
+
+    def test_prior_box_fpn_proposals_matrix_nms(self, rng):
+        import paddle_tpu.vision.ops as V
+        feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        imgT = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        pb, pv = V.prior_box(feat, imgT, [16.0], [32.0], [2.0],
+                             flip=True)
+        assert pb.shape[:2] == [4, 4] and pb.shape[3] == 4
+        rois4 = np.array([[0, 0, 32, 32], [0, 0, 200, 200],
+                          [0, 0, 64, 64]], np.float32)
+        outs, restore, nums = V.distribute_fpn_proposals(
+            paddle.to_tensor(rois4), 2, 5, 4, 224)
+        assert sum(int(n.numpy()[0]) for n in nums) == 3
+        assert sorted(restore.numpy().reshape(-1).tolist()) == [0, 1, 2]
+        bb = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                        [20, 20, 30, 30]]], np.float32)
+        ss = np.array([[[0, 0, 0], [0.9, 0.85, 0.8]]], np.float32)
+        out, idx, nn_ = V.matrix_nms(paddle.to_tensor(bb),
+                                     paddle.to_tensor(ss), 0.1, 0.05,
+                                     10, 10, return_index=True)
+        assert out.shape[1] == 6 and int(nn_.numpy()[0]) >= 2
+
+    def test_generate_proposals_and_jpeg_io(self, rng, tmp_path):
+        import paddle_tpu.vision.ops as V
+        an = np.tile(np.array([[0, 0, 16, 16], [8, 8, 24, 24]],
+                              np.float32), (9, 1))
+        sc = rng.random((1, 2, 3, 3)).astype(np.float32)
+        bd = (rng.random((1, 8, 3, 3)).astype(np.float32) - 0.5)
+        var = np.tile(np.ones((2, 4), np.float32), (9, 1))
+        r, s2, n2 = V.generate_proposals(
+            paddle.to_tensor(sc), paddle.to_tensor(bd),
+            paddle.to_tensor(np.array([[64, 64]], np.float32)),
+            paddle.to_tensor(an), paddle.to_tensor(var))
+        assert r.shape[1] == 4 and int(n2.numpy()[0]) == r.shape[0]
+        from PIL import Image
+        arr = (rng.random((8, 8, 3)) * 255).astype(np.uint8)
+        p = str(tmp_path / "t.jpg")
+        Image.fromarray(arr).save(p, quality=95)
+        dec = V.decode_jpeg(V.read_file(p), mode="rgb")
+        assert dec.shape == [3, 8, 8]
+
+
+class TestAudioPackage:
+    def test_functional_tail(self):
+        import paddle_tpu.audio as A
+        f = A.functional.fft_frequencies(16000, 512)
+        assert f.shape == [257]
+        assert abs(float(f.numpy()[-1]) - 8000) < 1e-3
+        mf = A.functional.mel_frequencies(10, 0, 8000)
+        assert np.all(np.diff(mf.numpy()) > 0)
+        db = A.functional.power_to_db(
+            paddle.to_tensor(np.array([1.0, 0.1], np.float32)))
+        np.testing.assert_allclose(db.numpy(), [0.0, -10.0], atol=1e-4)
+        w = A.functional.get_window("hamming", 16)
+        assert w.shape == [16]
+
+    def test_wav_io_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as A
+        wav = np.sin(np.linspace(0, 20, 1600)).astype(np.float32)[None]
+        p = str(tmp_path / "t.wav")
+        A.save(p, paddle.to_tensor(wav), 16000)
+        meta = A.info(p)
+        assert meta.sample_rate == 16000 and meta.num_channels == 1
+        back, sr = A.load(p)
+        assert sr == 16000
+        np.testing.assert_allclose(back.numpy(), wav, atol=1e-3)
+        assert A.backends.get_current_backend() == "wave_backend"
+        with pytest.raises(NotImplementedError):
+            A.backends.set_backend("soundfile")
+
+    def test_datasets(self):
+        import paddle_tpu.audio as A
+        ds = A.datasets.ESC50(mode="train")
+        wv, lbl = ds[0]
+        assert wv.shape == (16000,) and 0 <= lbl < 50
+        assert len(A.datasets.TESS()) == 70
+
+
+class TestFolderDatasetsHubOnnx:
+    def test_folder_datasets(self, tmp_path, rng):
+        from PIL import Image
+
+        from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+        for cls in ("cat", "dog"):
+            os.makedirs(str(tmp_path / cls))
+            Image.fromarray(
+                (rng.random((6, 6, 3)) * 255).astype(np.uint8)).save(
+                str(tmp_path / cls / "a.png"))
+        df = DatasetFolder(str(tmp_path))
+        assert len(df) == 2 and df.classes == ["cat", "dog"]
+        _, target = df[0]
+        assert target == 0
+        assert len(ImageFolder(str(tmp_path))) == 2
+
+    def test_hub_local_and_offline_gate(self, tmp_path):
+        import paddle_tpu.hub as hub
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(n=3):\n    'a tiny model'\n"
+            "    return list(range(n))\n")
+        d = str(tmp_path)
+        assert "tiny" in hub.list(d, source="local")
+        assert "tiny model" in hub.help(d, "tiny", source="local")
+        assert hub.load(d, "tiny", source="local", n=2) == [0, 1]
+        with pytest.raises(RuntimeError, match="offline"):
+            hub.load("user/repo", "m")
+
+    def test_onnx_export_gate(self):
+        import paddle_tpu.onnx as onnx
+        with pytest.raises(ImportError, match="save_inference_model"):
+            onnx.export(None, "x")
