@@ -277,7 +277,10 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
     def f(a):
         a32 = a.astype(jnp.float32)
         if axes is None:
-            return jnp.linalg.norm(a32.reshape(-1), ord=p)
+            out = jnp.linalg.norm(a32.reshape(-1), ord=p)
+            if keepdim:
+                out = out.reshape((1,) * a.ndim)
+            return out
         ax = tuple(d % a.ndim for d in axes)
         rest = tuple(d for d in range(a.ndim) if d not in ax)
         moved = jnp.transpose(a32, rest + ax)

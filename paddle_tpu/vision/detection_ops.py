@@ -551,7 +551,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     an = _np_of(anchors).reshape(-1, 4)
     var = _np_of(variances).reshape(-1, 4)
     n = sc.shape[0]
-    all_rois, all_nums = [], []
+    all_rois, all_scores, all_nums = [], [], []
     off = 1.0 if pixel_offset else 0.0
     for i in range(n):
         s = sc[i].transpose(1, 2, 0).reshape(-1)
@@ -581,13 +581,13 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                              scores=Tensor(jnp.asarray(s))))
         keep = keep[:int(post_nms_top_n)]
         all_rois.append(boxes[keep])
+        all_scores.append(s[keep])
         all_nums.append(len(keep))
     rois = np.concatenate(all_rois) if all_rois else np.empty((0, 4))
     rois_t = Tensor(jnp.asarray(rois.astype(np.float32)))
     scores_out = Tensor(jnp.asarray(
-        np.concatenate([sc[i].transpose(1, 2, 0).reshape(-1)[:nn]
-                        for i, nn in enumerate(all_nums)])
-        if all_nums else np.empty(0, np.float32)))
+        np.concatenate(all_scores).astype(np.float32)
+        if all_scores else np.empty(0, np.float32)))
     if return_rois_num:
         return rois_t, scores_out, Tensor(jnp.asarray(
             np.asarray(all_nums, np.int32)))
@@ -629,15 +629,17 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
             iou = inter / np.maximum(
                 area[:, None] + area[None, :] - inter, 1e-10)
             iou = np.triu(iou, 1)                 # j suppressed by i<j
-            max_iou = iou.max(axis=0)             # per column
-            comp = iou.max(axis=1, initial=0.0)   # compensation
+            # compensation per suppressor i = its own max IoU with
+            # higher-scored boxes (column max; iou_max[0] == 0, which
+            # also bounds the min-decay below at <= 1)
+            comp = iou.max(axis=0)
             if use_gaussian:
                 decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
                                / gaussian_sigma).min(axis=0)
             else:
                 decay = ((1 - iou) / np.maximum(1 - comp[:, None],
                                                 1e-10)).min(axis=0)
-            del max_iou
+            decay = np.minimum(decay, 1.0)
             new_s = ss * decay
             keep = new_s > post_threshold
             for j in np.nonzero(keep)[0]:

@@ -98,6 +98,12 @@ class TestLinalgTail:
         np.testing.assert_allclose(
             float(L.vector_norm(a).numpy()),
             np.linalg.norm(a_np.ravel()), rtol=1e-5)
+        # keepdim with axis=None keeps every reduced dim as size-1
+        kd = L.vector_norm(a, keepdim=True)
+        assert kd.shape == [1, 1]
+        np.testing.assert_allclose(float(kd.numpy()[0, 0]),
+                                   np.linalg.norm(a_np.ravel()),
+                                   rtol=1e-5)
         lu_m, piv = L.lu(a)
         P, Lo, U = L.lu_unpack(lu_m, piv)
         np.testing.assert_allclose(

@@ -147,6 +147,11 @@ class TestDetectionOps:
                                      paddle.to_tensor(ss), 0.1, 0.05,
                                      10, 10, return_index=True)
         assert out.shape[1] == 6 and int(nn_.numpy()[0]) >= 2
+        # decay semantics (ref matrix_nms_kernel.cc iou_max over j<i):
+        # b1 duplicates b0 (iou 1 -> decayed to 0, dropped); b2 has no
+        # overlap and comp of the top box is 0, so decay==1 exactly.
+        kept = sorted(out.numpy()[:, 1].tolist(), reverse=True)
+        np.testing.assert_allclose(kept, [0.9, 0.8], atol=1e-6)
 
     def test_generate_proposals_and_jpeg_io(self, rng, tmp_path):
         import paddle_tpu.vision.ops as V
@@ -160,6 +165,13 @@ class TestDetectionOps:
             paddle.to_tensor(np.array([[64, 64]], np.float32)),
             paddle.to_tensor(an), paddle.to_tensor(var))
         assert r.shape[1] == 4 and int(n2.numpy()[0]) == r.shape[0]
+        # scores align with the kept ROIs: NMS keep order is descending
+        # by score, and every returned score is from the score map
+        sv = s2.numpy()
+        assert sv.shape[0] == r.shape[0]
+        assert np.all(np.diff(sv) <= 1e-7)
+        assert np.isin(np.round(sv, 5),
+                       np.round(sc.reshape(-1), 5)).all()
         from PIL import Image
         arr = (rng.random((8, 8, 3)) * 255).astype(np.uint8)
         p = str(tmp_path / "t.jpg")
