@@ -210,6 +210,24 @@ class MemStats {
     return true;
   }
 
+  // Reset the peak watermark to the current value (the reference's
+  // reset_max_memory_allocated / ResetPeakValue semantics,
+  // ref: paddle/phi/core/memory/stats.h).
+  void ResetPeak(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = stats_.find(key);
+    if (it != stats_.end()) it->second.peak = it->second.current;
+  }
+
+  // Force current to an externally-measured value (used to reconcile the
+  // op-boundary tracker against an exact live-buffer scan).
+  void SetCurrent(const std::string& key, long long cur) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto& e = stats_[key];
+    e.current = cur;
+    if (e.current > e.peak) e.peak = e.current;
+  }
+
  private:
   struct Entry {
     long long current = 0, peak = 0;
@@ -750,6 +768,21 @@ static PyObject* py_stat_get(PyObject*, PyObject* args) {
   return Py_BuildValue("(LL)", cur, peak);
 }
 
+static PyObject* py_stat_reset_peak(PyObject*, PyObject* args) {
+  const char* key;
+  if (!PyArg_ParseTuple(args, "s", &key)) return nullptr;
+  MemStats::Instance().ResetPeak(key);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_stat_set_current(PyObject*, PyObject* args) {
+  const char* key;
+  long long cur;
+  if (!PyArg_ParseTuple(args, "sL", &key, &cur)) return nullptr;
+  MemStats::Instance().SetCurrent(key, cur);
+  Py_RETURN_NONE;
+}
+
 // --- TCPStore capsules ---
 static void server_capsule_destructor(PyObject* cap) {
   auto* s = static_cast<TCPStoreServer*>(
@@ -921,6 +954,10 @@ static PyMethodDef Methods[] = {
     {"tracer_size", py_tracer_size, METH_NOARGS, "event count"},
     {"stat_update", py_stat_update, METH_VARARGS, "update mem stat"},
     {"stat_get", py_stat_get, METH_VARARGS, "(current, peak)"},
+    {"stat_reset_peak", py_stat_reset_peak, METH_VARARGS,
+     "peak = current"},
+    {"stat_set_current", py_stat_set_current, METH_VARARGS,
+     "current = value (reconcile)"},
     {"store_server_start", py_store_server_start, METH_VARARGS,
      "start TCPStore server"},
     {"store_server_stop", py_store_server_stop, METH_VARARGS,

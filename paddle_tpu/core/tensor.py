@@ -265,16 +265,19 @@ class Parameter(Tensor):
 
 def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
     """paddle.to_tensor. ref: python/paddle/tensor/creation.py to_tensor"""
+    from . import memory as _memory
     d = dtype_mod.convert_dtype(dtype)
     if isinstance(data, Tensor):
         arr = data._data
         if d is not None and arr.dtype != d:
             arr = arr.astype(d)
+        _memory.track(arr)
         return Tensor(arr, stop_gradient=stop_gradient)
     if isinstance(data, (jax.Array,)) or hasattr(data, "aval"):
         arr = data
         if d is not None and arr.dtype != d:
             arr = arr.astype(d)
+        _memory.track(arr)
         return Tensor(arr, stop_gradient=stop_gradient)
     np_arr = np.asarray(data)
     if d is None:
@@ -284,7 +287,9 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
             pass  # keep int64 like paddle
     else:
         np_arr = np_arr.astype(d)
-    return Tensor(jnp.asarray(np_arr), stop_gradient=stop_gradient)
+    arr = jnp.asarray(np_arr)
+    _memory.track(arr)
+    return Tensor(arr, stop_gradient=stop_gradient)
 
 
 def unwrap(x):

@@ -24,6 +24,10 @@ __all__ = [
     "get_all_custom_device_type", "get_available_device",
     "get_available_custom_device", "Stream", "Event", "current_stream",
     "set_stream", "stream_guard", "synchronize",
+    "memory_stats", "memory_allocated", "max_memory_allocated",
+    "memory_reserved", "max_memory_reserved",
+    "reset_max_memory_allocated", "reset_peak_memory_stats",
+    "empty_cache", "program_memory_analysis",
 ]
 
 
@@ -168,3 +172,101 @@ def synchronize(device=None):
     queue — the only ordered barrier XLA exposes."""
     import jax.numpy as jnp
     jax.block_until_ready(jnp.zeros(()))
+
+
+# ---------------------------------------------------------------------------
+# live device-memory observability
+# (ref: python/paddle/device/cuda/__init__.py:233 max_memory_allocated over
+#  paddle/phi/core/memory/stats.h current/peak counters; here the counters
+#  come from PJRT memory_stats when the platform reports them, else from
+#  the framework's op-boundary tracker in core/memory.py backed by the
+#  native MemStats registry)
+# ---------------------------------------------------------------------------
+
+def _resolve_device(device=None):
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    if hasattr(device, "platform"):  # already a jax device
+        return device
+    spec = str(device)
+    if ":" in spec:
+        return devs[int(spec.split(":")[1])]
+    return devs[0]
+
+
+def memory_stats(device=None):
+    """Full stat dict for one device: allocated/reserved current+peak,
+    plus the raw PJRT dict under ``"pjrt"`` when the backend has one."""
+    from ..core import memory as _memory
+    return _memory.stats_for(_resolve_device(device))
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes of live device buffers right now (exact: PJRT counters or a
+    live-array scan). ref: device/cuda/__init__.py memory_allocated."""
+    return memory_stats(device)["allocated.current"]
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-water mark of allocated bytes since start / last reset.
+    ref: device/cuda/__init__.py:233."""
+    return memory_stats(device)["allocated.peak"]
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved from the platform allocator (== allocated where
+    PJRT doesn't report a separate reservation pool)."""
+    return memory_stats(device)["reserved.current"]
+
+
+def max_memory_reserved(device=None) -> int:
+    return memory_stats(device)["reserved.peak"]
+
+
+def reset_max_memory_allocated(device=None) -> None:
+    """Peak watermark := current (reference ResetPeakValue semantics)."""
+    from ..core import memory as _memory
+    d = _resolve_device(device)
+    _memory.reconcile(d)
+    _memory.reset_peak(d)
+
+
+def reset_peak_memory_stats(device=None) -> None:
+    reset_max_memory_allocated(device)
+
+
+def empty_cache() -> None:
+    """Release cached host-side objects (PJRT owns device memory; the
+    analog of the reference's allocator-cache flush is dropping dead
+    Python references + XLA's compilation caches stay warm)."""
+    import gc
+    gc.collect()
+
+
+def program_memory_analysis(compiled_or_fn, *example_args):
+    """Per-device memory breakdown of a compiled XLA program: dict with
+    argument/output/temp/alias/generated-code bytes and a ``peak_hbm``
+    estimate (args + outputs + temps - aliased). jit-internal temps are
+    invisible to the live counters — this is the API that sees them.
+
+    Accepts a ``jax.stages.Compiled``, a jitted fn + example args (will
+    lower+compile), or any object with ``memory_analysis()``.
+    """
+    obj = compiled_or_fn
+    if example_args:
+        obj = jax.jit(obj) if not hasattr(obj, "lower") else obj
+        obj = obj.lower(*example_args).compile()
+    ma = obj.memory_analysis()
+    out = {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+    out["peak_hbm"] = (out["argument_bytes"] + out["output_bytes"]
+                       + out["temp_bytes"] - out["alias_bytes"])
+    return out

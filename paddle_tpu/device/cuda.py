@@ -1,0 +1,48 @@
+"""Compat shim for ``paddle.device.cuda`` memory APIs
+(ref: python/paddle/device/cuda/__init__.py).
+
+This build has no CUDA backend; the reference raises on such builds.
+For drop-in friendliness the memory observability functions forward to
+the device-agnostic implementations in ``paddle_tpu.device`` (they
+report the default accelerator — the TPU), while device-management
+functions keep the reference's raise-on-non-CUDA contract.
+"""
+from __future__ import annotations
+
+from . import (  # noqa: F401
+    empty_cache, max_memory_allocated, max_memory_reserved,
+    memory_allocated, memory_reserved, memory_stats,
+    reset_max_memory_allocated, reset_peak_memory_stats, synchronize)
+
+__all__ = [
+    "Stream", "Event", "current_stream", "synchronize", "device_count",
+    "empty_cache", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "reset_max_memory_allocated",
+    "reset_peak_memory_stats", "stream_guard", "get_device_properties",
+    "get_device_name", "get_device_capability",
+]
+
+
+def device_count() -> int:
+    return 0  # no CUDA devices in this build
+
+
+def get_device_properties(device=None):
+    raise ValueError(
+        "paddle_tpu is not compiled with CUDA; use paddle_tpu.device "
+        "for the TPU device APIs")
+
+
+def get_device_name(device=None):
+    raise ValueError(
+        "paddle_tpu is not compiled with CUDA; use paddle_tpu.device "
+        "for the TPU device APIs")
+
+
+def get_device_capability(device=None):
+    raise ValueError(
+        "paddle_tpu is not compiled with CUDA; use paddle_tpu.device "
+        "for the TPU device APIs")
+
+
+from . import Stream, Event, current_stream, stream_guard  # noqa: F401,E402

@@ -353,6 +353,25 @@ class TrainStep:
         donate = (0, 1, 2, 4) if self._donate else ()
         self._jitted = jax.jit(step_fn, donate_argnums=donate)
 
+    def compile_stats(self, *batch):
+        """Compile the step for these batch shapes without running it and
+        return XLA's per-device memory analysis (same contract as
+        DistTrainStep.compile_stats; bench emits it as peak_hbm_bytes)."""
+        if self._jitted is None:
+            self._build()
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        raw = tuple(
+            _tree_unwrap(b) if isinstance(b, Tensor)
+            else b if isinstance(b, jax.Array)
+            else jnp.asarray(np.asarray(b)) for b in batch)
+        params = {k: t._data for k, t in self._params.items()}
+        buffers = {k: t._data for k, t in self._swap.buffers.items()}
+        probe_rng = (jax.random.key(0), jnp.uint32(0))
+        return self._jitted.lower(
+            params, buffers, self._opt_state, jnp.float32(0.0),
+            probe_rng, *raw).compile().memory_analysis()
+
     def __call__(self, *batch):
         if self._jitted is None:
             self._build()
