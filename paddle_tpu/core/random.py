@@ -23,7 +23,12 @@ class Generator:
     def manual_seed(self, seed: int):
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
-            self._key = jax.random.key(int(seed))
+            # key creation is LAZY: jax.random.key initializes the XLA
+            # backend, and the default generator is built at import time
+            # — an eager key here would make `import paddle_tpu` claim
+            # the backend before jax.distributed.initialize can run
+            # (the multi-controller bootstrap would silently fall back)
+            self._key = None
             self._counter = 0
         _bump_seed_epoch()
         return self
@@ -31,18 +36,23 @@ class Generator:
     def initial_seed(self) -> int:
         return self._seed
 
+    def _root_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
     def next_key(self):
         """A fresh subkey; each call advances the stream."""
         with self._lock:
             self._counter += 1
-            return jax.random.fold_in(self._key, self._counter)
+            return jax.random.fold_in(self._root_key(), self._counter)
 
     def get_state(self):
         return (self._seed, self._counter)
 
     def set_state(self, state):
         self._seed, self._counter = state
-        self._key = jax.random.key(self._seed)
+        self._key = None
         _bump_seed_epoch()
 
 

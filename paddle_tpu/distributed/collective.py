@@ -214,14 +214,13 @@ def _allgather_bytes(g: Group, payload: bytes, tag: str) -> List[bytes]:
             st.delete(f"{base}/rc")
         return parts
     buf = np.frombuffer(payload, dtype=np.uint8)
-    sizes = np.asarray(_cross_process(
+    sizes = _cross_process(
         "all_gather", jnp.asarray(np.array([buf.size], np.int32)),
-        g)).reshape(g.nranks)
+        g).reshape(g.nranks)
     maxlen = int(sizes.max())
     padded = np.zeros(maxlen, np.uint8)
     padded[:buf.size] = buf
-    gathered = np.asarray(_cross_process(
-        "all_gather", jnp.asarray(padded), g))
+    gathered = _cross_process("all_gather", jnp.asarray(padded), g)
     return [gathered[i][:sizes[i]].tobytes() for i in range(g.nranks)]
 
 
@@ -298,27 +297,45 @@ _mailbox: Dict[Tuple[int, int, int], List] = {}
 
 
 # -- multi-process compiled collectives --------------------------------------
-# One device per process is assumed for the cross-process eager path (the
-# launch CLI sets this up); a global 1-D mesh over process-local device 0 of
-# every process carries the collective.
+# The production (regime-2) transport: a one-collective XLA program over a
+# mesh of one device per participating process — psum/all_gather ride the
+# interconnect (ICI/DCN on TPU pods, gloo on the CPU test backend) inside
+# the compiled program, exactly like the reference's per-ring NCCL comm
+# (ref: process_group_nccl.cc:732 CreateNCCLEnvCache per place). Every
+# group member must call in (same SPMD contract as NCCL).
 
 @functools.lru_cache(maxsize=None)
-def _proc_mesh(nranks: int):
+def _rank_device(rank: int):
+    """The device owned by global rank ``rank`` (multi-controller: one
+    process per rank, first local device of that process)."""
+    for d in jax.devices():
+        if d.process_index == rank:
+            return d
+    raise RuntimeError(
+        f"no device owned by process {rank}; "
+        f"process_count={jax.process_count()}")
+
+
+@functools.lru_cache(maxsize=None)
+def _group_mesh(ranks: tuple):
     from jax.sharding import Mesh
-    devs = np.asarray(jax.devices()[:nranks], dtype=object)
+    devs = np.asarray([_rank_device(r) for r in ranks], dtype=object)
     return Mesh(devs, axis_names=("r",))
 
 
 def _cross_process(op_name, arr, group: Group, **kw):
-    """Run a one-collective compiled program over the group's ranks."""
+    """Run a one-collective compiled program over the group's ranks and
+    return this rank's result as a host numpy array
+    (all_reduce -> arr.shape, all_gather -> (nranks,) + arr.shape)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
-    mesh = _proc_mesh(group.nranks)
+    mesh = _group_mesh(tuple(group.ranks))
+    arr = jnp.asarray(arr)
     x = jax.make_array_from_single_device_arrays(
         (group.nranks,) + arr.shape,
         NamedSharding(mesh, P("r")),
-        [jax.device_put(arr[None], jax.devices()[0])])
+        [jax.device_put(arr[None], jax.local_devices()[0])])
 
     if op_name == "all_reduce":
         red = kw.get("op", ReduceOp.SUM)
@@ -341,10 +358,13 @@ def _cross_process(op_name, arr, group: Group, **kw):
     else:
         raise NotImplementedError(op_name)
 
-    spec = P("r")
-    fn = jax.jit(shard_map(f, mesh=mesh, in_specs=(spec,),
-                           out_specs=spec if op_name == "all_reduce" else P("r")))
-    return fn(x)
+    fn = jax.jit(shard_map(f, mesh=mesh, in_specs=(P("r"),),
+                           out_specs=P("r")))
+    out = fn(x)
+    # this rank's shard IS its result; a global np.asarray would need
+    # non-addressable remote shards and fail in multi-controller mode
+    local = np.asarray(out.addressable_shards[0].data)
+    return local[0] if op_name == "all_reduce" else local
 
 
 # -- public API ---------------------------------------------------------------
@@ -364,8 +384,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
         tensor._data = jnp.asarray(_reduce_parts(parts, op, g.nranks))
         return Task([tensor._data])
     out = _cross_process("all_reduce", _unwrap(tensor), g, op=op)
-    local = out[jax.process_index() % out.shape[0]] if out.ndim > _unwrap(tensor).ndim else out
-    tensor._data = jnp.asarray(local)
+    tensor._data = jnp.asarray(out)
     return Task([tensor._data])
 
 
@@ -383,8 +402,7 @@ def all_gather(tensor_list: List, tensor, group: Optional[Group] = None,
         parts = _store_gather_all(g, arr, "ag")
         tensor_list.extend(Tensor(jnp.asarray(p)) for p in parts)
         return Task([arr])
-    out = _cross_process("all_gather", arr, g)
-    host = np.asarray(out)
+    host = _cross_process("all_gather", arr, g)
     for i in range(g.nranks):
         tensor_list.append(Tensor(jnp.asarray(host[i])))
     return Task([arr])
@@ -574,7 +592,7 @@ def alltoall(out_tensor_list: List, in_tensor_list: List,
                     st.take(f"{base}/{s}>{r}"))))
         return Task([])
     stacked = jnp.stack([_unwrap(t) for t in in_tensor_list])
-    gathered = np.asarray(_cross_process("all_gather", stacked, g))
+    gathered = _cross_process("all_gather", stacked, g)
     r = g.rank
     for i in range(g.nranks):
         out_tensor_list.append(Tensor(jnp.asarray(gathered[i][r])))

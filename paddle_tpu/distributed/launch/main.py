@@ -9,6 +9,18 @@ workers that exit with the restart code.
 TPU note: on a TPU pod each *host* is one worker (jax distributed
 single-process-per-host), so --nproc_per_node defaults to 1; the CPU-mesh
 test path uses --devices to emulate N single-chip workers.
+
+Pod bootstrap (the production multi-controller regime): every launched
+worker that calls ``paddle_tpu.distributed.init_parallel_env()`` brings
+up the global JAX runtime via ``jax.distributed.initialize`` using the
+injected env (coordinator = PADDLE_MASTER, process_id =
+PADDLE_TRAINER_ID, num_processes = PADDLE_TRAINERS_NUM) BEFORE first
+backend use. After that, ``jax.devices()`` spans all hosts' chips and
+every collective — eager ones through the compiled one-collective
+programs in ``distributed.collective``, and all collectives inside
+jitted train steps — rides ICI/DCN. On the CPU backend the same path
+uses gloo cross-process collectives (set automatically); this is what
+tests/test_multicontroller.py exercises with real processes.
 """
 from __future__ import annotations
 

@@ -41,10 +41,23 @@ def init_parallel_env() -> Group:
     nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     master = os.environ.get("PADDLE_MASTER",
                             os.environ.get("MASTER_ADDR", ""))
-    if nranks > 1 and jax.process_count() == 1:
+    # probe WITHOUT touching the backend: jax.process_count() would
+    # initialize XLA right here, making the initialize() below a
+    # guaranteed too-late failure (silent store-transport fallback)
+    if nranks > 1 and not jax.distributed.is_initialized():
         port = os.environ.get("MASTER_PORT", "")
         addr = master if ":" in master or not port else f"{master}:{port}"
         try:
+            # CPU backend: cross-process collectives need a real CPU
+            # collectives implementation (gloo) — the analog of the
+            # reference picking ProcessGroupGloo for CPU places
+            # (ref: parallel.py:978 _new_process_group_impl backend map)
+            if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except Exception:
+                    pass  # older jaxlib: option absent
             jax.distributed.initialize(
                 coordinator_address=addr, num_processes=nranks,
                 process_id=rank)

@@ -1,0 +1,146 @@
+"""Multi-controller (regime-2) compiled collectives: the production
+transport — jax.distributed.initialize spanning processes, collectives
+riding the interconnect inside compiled programs.
+
+Matches the reference's real-transport distributed tests, which shell
+out actual worker processes and run NCCL rings
+(ref: test/collective/test_communication_api_base.py:28,58-79,
+process_group_nccl.cc:732). Here: 2 processes on the CPU backend with
+gloo cross-process collectives, wired through the launch CLI.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(tmp_path, script_body, nproc=2, env=None):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--log_dir", str(tmp_path / "log"),
+           "--nproc_per_node", str(nproc), str(script)]
+    e = dict(os.environ, PYTHONPATH=_REPO_ROOT, JAX_PLATFORMS="cpu")
+    # the conftest's 8-virtual-device XLA_FLAGS must NOT leak into the
+    # workers: each controller owns exactly its own devices
+    e.pop("XLA_FLAGS", None)
+    if env:
+        e.update(env)
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=240,
+                          env=e, cwd=_REPO_ROOT), tmp_path / "log"
+
+
+MC_PRELUDE = """
+    import os
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    import jax
+    # the whole point: a REAL multi-controller runtime, not the
+    # host-staged store fallback
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2
+    assert len(jax.local_devices()) == 1
+    r, n = dist.get_rank(), dist.get_world_size()
+"""
+
+
+class TestMultiController:
+    def test_compiled_psum_allgather_spans_processes(self, tmp_path):
+        proc, log = _run_launch(tmp_path, MC_PRELUDE + """
+    # compiled all_reduce (psum over the 2-process gloo ring)
+    t = paddle.to_tensor(np.full((4,), float(r + 1), np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), np.full((4,), 3.0))
+
+    # compiled all_gather
+    outs = []
+    dist.all_gather(outs, paddle.to_tensor(
+        np.full((2,), float(10 * (r + 1)), np.float32)))
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0].numpy(), [10.0, 10.0])
+    np.testing.assert_allclose(outs[1].numpy(), [20.0, 20.0])
+
+    # max / avg reductions
+    t2 = paddle.to_tensor(np.full((3,), float(r), np.float32))
+    dist.all_reduce(t2, dist.ReduceOp.MAX)
+    np.testing.assert_allclose(t2.numpy(), np.full((3,), 1.0))
+
+    # reduce_scatter through the compiled path
+    parts = [paddle.to_tensor(np.full((2,), float(r + 1 + i), np.float32))
+             for i in range(n)]
+    out = paddle.to_tensor(np.zeros((2,), np.float32))
+    dist.reduce_scatter(out, parts)
+    # rank k gets sum_r (r+1+k) = (1+k) + (2+k)
+    np.testing.assert_allclose(out.numpy(), np.full((2,), 3.0 + 2 * r))
+
+    # barrier rides the same compiled ring
+    dist.barrier()
+    print("MC_COLLECTIVES_OK", r)
+        """)
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+        for i in range(2):
+            body = (log / f"workerlog.{i}").read_text()
+            assert f"MC_COLLECTIVES_OK {i}" in body, body
+
+    def test_dp_train_step_spans_processes(self, tmp_path):
+        """One DP train step over a mesh spanning both processes; loss
+        and updated params must match the single-controller oracle (the
+        reference's acc-align contract, test/collective/fleet)."""
+        proc, log = _run_launch(tmp_path, MC_PRELUDE + """
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    rep = NamedSharding(mesh, P())
+    dsh = NamedSharding(mesh, P("dp", None))
+
+    rng = np.random.default_rng(0)
+    W0 = rng.normal(size=(8, 4)).astype(np.float32)
+    Xg = rng.normal(size=(4, 8)).astype(np.float32)   # global batch
+    Yg = rng.normal(size=(4, 4)).astype(np.float32)
+
+    # each process feeds ITS batch shard (rows r*2:(r+1)*2)
+    Xl = Xg[r * 2:(r + 1) * 2]
+    Yl = Yg[r * 2:(r + 1) * 2]
+    X = jax.make_array_from_single_device_arrays(
+        Xg.shape, dsh, [jax.device_put(Xl, jax.local_devices()[0])])
+    Y = jax.make_array_from_single_device_arrays(
+        Yg.shape, dsh, [jax.device_put(Yl, jax.local_devices()[0])])
+    W = jax.device_put(jnp.asarray(W0), rep)
+
+    @jax.jit
+    def step(W, X, Y):
+        def loss_fn(W):
+            return jnp.mean((X @ W - Y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(W)
+        return loss, W - 0.1 * g
+
+    loss, W1 = step(W, X, Y)
+    loss = float(jax.device_get(loss))
+
+    # single-controller oracle computed locally (pure numpy)
+    def np_step(W, X, Y):
+        pred = X @ W
+        loss = ((pred - Y) ** 2).mean()
+        g = 2 * X.T @ (pred - Y) / pred.size
+        return loss, W - 0.1 * g
+
+    eloss, eW1 = np_step(W0, Xg, Yg)
+    assert abs(loss - eloss) < 1e-5, (loss, eloss)
+    W1h = np.asarray(jax.device_get(W1))
+    np.testing.assert_allclose(W1h, eW1, rtol=1e-5, atol=1e-6)
+    print("MC_DP_STEP_OK", r, round(loss, 6))
+        """)
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+        for i in range(2):
+            body = (log / f"workerlog.{i}").read_text()
+            assert f"MC_DP_STEP_OK {i}" in body, body
