@@ -18,8 +18,10 @@ vs_baseline: the reference publishes no numbers (BASELINE.md); for the
 transformer workloads the agreed bar is "A100+NCCL MFU" ~0.45, so
 vs_baseline = our_MFU / 0.45 with bf16 peak detected per chip. For
 ResNet-50 the bar is the public A100 fp16 training rate (~2500 img/s).
-For the MoE dispatch the baseline is the reference-parity dense one-hot
-dispatch (global_scatter semantics), so vs_baseline = speedup over it.
+For the MoE dispatch vs_baseline = measured useful-FLOPs MFU / 0.40
+(absolute expert-FFN utilization bar; the dense one-hot dispatch
+oracle's speedup stays in detail.dense_speedup). The dispatch
+micro-bench's bar is the stated µs/op budget.
 
 Prints ONE json line per workload:
 {"metric", "value", "unit", "vs_baseline", "detail"}.
@@ -59,6 +61,29 @@ def _emit(metric, value, unit, vs_baseline, detail):
         "metric": metric, "value": round(value, 2), "unit": unit,
         "vs_baseline": round(vs_baseline, 4), "detail": detail,
     }), flush=True)
+
+
+def _hbm_detail(step, *args, **kw):
+    """peak_hbm_bytes of the compiled train step (args + outputs + temps
+    - donation aliases, from XLA's per-device memory analysis via
+    TrainStep/DistTrainStep.compile_stats). Best-effort: an analysis
+    failure must not kill a bench line.
+
+    Cost note: the AOT lower().compile() here does NOT share the jit
+    dispatch cache the timed warmup filled, so each workload pays a
+    second XLA compile (outside the timed window). Accepted: the driver
+    runs bench once per round and the memory-parity artifact is worth
+    the extra minutes; driving the returned Compiled for the timed loop
+    instead would bypass __call__'s donation/rng handling."""
+    try:
+        ma = step.compile_stats(*args, **kw)
+        peak = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+        return {"peak_hbm_bytes": int(peak),
+                "hbm_temp_bytes": int(ma.temp_size_in_bytes)}
+    except Exception as e:  # noqa: BLE001
+        return {"peak_hbm_bytes": None,
+                "hbm_error": f"{type(e).__name__}: {e}"[:120]}
 
 
 def bench_llama():
@@ -124,7 +149,8 @@ def bench_llama():
           "tokens/s", mfu / _BASELINE_MFU, {
               "params": n_params, "batch": batch, "seq": seq,
               "mfu": round(mfu, 4), "loss": loss,
-              "backend": jax.default_backend()})
+              "backend": jax.default_backend(),
+              **_hbm_detail(step, ids, ids)})
 
 
 def bench_resnet50():
@@ -174,7 +200,8 @@ def bench_resnet50():
           imgs / baseline_imgs, {
               "batch": batch, "hw": hw, "loss": round(loss, 4),
               "baseline": "A100 fp16 ~2500 img/s",
-              "backend": jax.default_backend()})
+              "backend": jax.default_backend(),
+              **_hbm_detail(step, x, y)})
 
 
 def bench_bert_base():
@@ -229,7 +256,8 @@ def bench_bert_base():
           mfu / _BASELINE_MFU, {
               "params": n_params, "batch": batch, "seq": seq,
               "mfu": round(mfu, 4), "loss": round(loss, 4),
-              "backend": jax.default_backend()})
+              "backend": jax.default_backend(),
+              **_hbm_detail(step, ids, ids)})
 
 
 def bench_gpt13b_geometry():
@@ -289,14 +317,18 @@ def bench_gpt13b_geometry():
               cfg.num_hidden_layers, "mfu": round(mfu, 4),
               "loss": round(loss, 4),
               "mesh_validated_by": "MULTICHIP dryrun (tp x pp x fsdp)",
-              "backend": jax.default_backend()})
+              "backend": jax.default_backend(),
+              **_hbm_detail(step, ids, ids)})
 
 
 def bench_moe_dispatch():
     """BASELINE workload 5: ERNIE-MoE expert dispatch throughput.
-    Baseline = the reference-parity dense one-hot dispatch algebra
-    (global_scatter semantics); value = index-dispatch tokens/s fwd+bwd,
-    vs_baseline = speedup over dense."""
+    vs_baseline is an ABSOLUTE bar: measured MFU over the useful MoE
+    FLOPs (gate + dispatched tokens' expert FFNs, fwd+bwd) against 0.40
+    — the utilization the reference's CUTLASS fused MoE GEMM exists to
+    deliver (ref: phi/kernels/fusion/cutlass/fused_moe_kernel.cu).
+    The dense one-hot dispatch oracle (reference global_scatter algebra)
+    is kept in detail as dense_oracle_ms/dense_speedup."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.incubate.moe import _gshard_dispatch
@@ -361,20 +393,68 @@ def bench_moe_dispatch():
     t_dense = timeit(train(dense_fwd))
     t_index = timeit(train(index_fwd))
     tok_s = T / t_index
-    # absolute utilization, not just the relative speedup: useful MoE
-    # FLOPs = gate matmul + the dispatched tokens' expert FFNs, fwd ~1x
-    # + bwd ~2x (dx through combine + dw for wi/wo)
+    # absolute utilization: useful MoE FLOPs = gate matmul + the
+    # dispatched tokens' expert FFNs, fwd ~1x + bwd ~2x (dx through
+    # combine + dw for wi/wo). Capacity padding is NOT counted useful.
+    moe_bar = 0.40
     dispatched = min(T * 2, E * cap)
     flops_fwd = 2 * T * H * E + dispatched * 2 * (2 * H * F)
     mfu = 3 * flops_fwd / t_index / _peak_flops()
     _emit("ernie_moe_dispatch_tokens_per_sec", tok_s, "tokens/s",
-          t_dense / t_index, {
+          mfu / moe_bar, {
               "tokens": T, "experts": E, "capacity": cap,
               "index_ms": round(t_index * 1e3, 2),
               "dense_oracle_ms": round(t_dense * 1e3, 2),
-              "mfu": round(mfu, 4),
-              "baseline": "dense one-hot dispatch (reference algebra)",
+              "dense_speedup": round(t_dense / t_index, 2),
+              "mfu": round(mfu, 4), "mfu_bar": moe_bar,
+              "baseline": "absolute expert-FFN utilization bar 0.40 "
+                          "(CUTLASS fused MoE GEMM role)",
               "backend": "tpu" if _on_tpu() else "cpu"})
+
+
+def bench_dispatch_overhead():
+    """Eager dispatch µs/op on the cached-hit path (VERDICT r3 item 6;
+    ref: the reference's sub-10µs eager hot loop, SURVEY §3.1 +
+    test/cpp/eager/performance_tests/benchmark_eager_cuda.cc). Measures
+    the grad-recording path — forward through the cached jitted pair +
+    GradNode wiring — which was 1.5 ms/op before the fast path. Budget:
+    150 µs/op on the tunneled dev chip (raw jnp dispatch itself is
+    ~32 µs there); vs_baseline = budget / measured."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    budget_us = 150.0
+    a = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((128, 128))
+        .astype(np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((128, 128), np.float32))
+
+    def one():
+        return paddle.add(a, b)
+
+    for _ in range(5):
+        one()
+    jax.block_until_ready(jnp.zeros(()))
+    n = 500
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            one()
+        best = min(best, (time.perf_counter() - t0) / n)
+    us = best * 1e6
+    raw = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            jnp.add(a._data, b._data)
+        raw = min(raw, (time.perf_counter() - t0) / n)
+    _emit("eager_dispatch_overhead_us", us, "us/op", budget_us / us, {
+        "path": "grad-recording add, cached jit pair",
+        "raw_jnp_dispatch_us": round(raw * 1e6, 1),
+        "budget_us": budget_us,
+        "backend": jax.default_backend()})
 
 
 def main(argv=None):
@@ -388,7 +468,7 @@ def main(argv=None):
     # emits an error line instead of killing the artifact.
     bench_llama()
     for fn in (bench_resnet50, bench_bert_base, bench_gpt13b_geometry,
-               bench_moe_dispatch):
+               bench_moe_dispatch, bench_dispatch_overhead):
         try:
             fn()
         except Exception as e:  # noqa: BLE001 - record, keep going

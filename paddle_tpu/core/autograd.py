@@ -14,12 +14,15 @@ entirely: gradients come from ``jax.grad`` over the functionalized program.
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from . import memory as _memory
 
 __all__ = [
     "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
@@ -207,6 +210,146 @@ def _op_gate(name: str, n_args: int) -> bool:
     return has_vjp
 
 
+# -- eager dispatch fast path -------------------------------------------------
+# The reference engineers its eager hot loop to sub-10µs/op (generated
+# ad_funcs + cached kernel selection, ref: test/cpp/eager/performance_tests/
+# benchmark_eager_cuda.cc, SURVEY §3.1). Here the dominant cost is
+# jax.vjp's per-call retrace (~1.4 ms/op measured on v5e): this cache keys
+# (fn identity, static args, kwargs) to a jitted forward and a jitted vjp
+# program, so the steady-state recorded op is two C++-jit-cache dispatches.
+# Engaged only for concrete (non-tracer) eager calls; anything unusual
+# (unhashable statics, tracers, exotic cotangents) falls back to plain
+# jax.vjp with identical semantics.
+
+import weakref as _weakref
+
+_pair_cache_weak: "_weakref.WeakKeyDictionary" = _weakref.WeakKeyDictionary()
+_pair_cache_strong: Dict[Any, dict] = {}
+_FAST_DISPATCH = os.environ.get(
+    "PADDLE_TPU_DISABLE_FAST_DISPATCH", "0") != "1"
+
+
+def _fn_pair_cache(fn):
+    try:
+        d = _pair_cache_weak.get(fn)
+        if d is None:
+            d = {}
+            _pair_cache_weak[fn] = d
+        return d
+    except TypeError:  # fn doesn't support weakrefs (e.g. jnp ufunc objs)
+        d = _pair_cache_strong.get(fn)
+        if d is None:
+            if len(_pair_cache_strong) > 1024:
+                _pair_cache_strong.clear()
+            d = _pair_cache_strong.setdefault(fn, {})
+        return d
+
+
+def _freeze(v):
+    """Hashable cache-key form of a static value; TypeError if impossible."""
+    if isinstance(v, (list, tuple)):
+        return (type(v).__name__,) + tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (jax.Array, np.ndarray)):
+        raise TypeError("array is not a static value")
+    hash(v)
+    return v
+
+
+def _build_pair(fn, kwargs, datas, dyn_idx, diff_idx):
+    """(jitted fwd, jitted vjp, meta) for this op configuration. Static
+    (non-array) positional args are baked in; dynamic args are passed, so
+    jit's own aval-keyed cache handles shape/dtype polymorphism."""
+    template = [None if i in dyn_idx else datas[i]
+                for i in range(len(datas))]
+    dyn_idx_t = tuple(dyn_idx)
+    meta = {"multi": False}
+
+    def _call(dyn_args, overrides=()):
+        call = list(template)
+        for p, i in zip(dyn_args, dyn_idx_t):
+            call[i] = p
+        for i, p in overrides:
+            call[i] = p
+        return fn(*call, **kwargs)
+
+    @jax.jit
+    def jfwd(*dyn_args):
+        res = _call(dyn_args)
+        multi = isinstance(res, (tuple, list))
+        meta["multi"] = multi  # set at trace time, read after first call
+        return tuple(res) if multi else (res,)
+
+    @jax.jit
+    def jbwd(dyn_args, cts):
+        prims = [datas_i for i, datas_i in zip(dyn_idx_t, dyn_args)
+                 if i in diff_idx]
+
+        def g(*ps):
+            res = _call(dyn_args, overrides=tuple(zip(diff_idx, ps)))
+            return tuple(res) if isinstance(res, (tuple, list)) else (res,)
+
+        return jax.vjp(g, *prims)[1](cts)
+
+    return jfwd, jbwd, meta
+
+
+_NOJIT = "nojit"  # sentinel: this (fn, config) must not run under jit
+
+
+def _fast_pair(fn, kwargs, datas, diff_idx):
+    """Cache lookup/build; None when this call can't take the fast path.
+
+    Build policy: a pair is only built for an fn OBJECT seen on a second
+    dispatch — per-call fresh closures (whose jit compile would cost
+    hundreds of ms every call) die with their first sighting marker and
+    never compile; module-level fns and ufuncs pay one deferred build.
+    """
+    if not _FAST_DISPATCH:
+        return None
+    dyn_idx, static_key = [], []
+    try:
+        for i, d in enumerate(datas):
+            if isinstance(d, jax.core.Tracer):
+                return None  # under an outer trace: plain path
+            if isinstance(d, (jax.Array, np.ndarray)):
+                dyn_idx.append(i)
+            elif isinstance(d, (float, np.floating)):
+                # python floats are numeric operands (scales, epsilons),
+                # not structure: pass them as (weak-typed) jit arguments
+                # so a host-varying scalar — `x * lr` in a loop — hits
+                # the same compiled pair instead of compiling per value.
+                # A fn that branches on the value fails the trace once
+                # and is marked nojit (plain path) below.
+                dyn_idx.append(i)
+            else:
+                static_key.append((i, _freeze(d)))
+        key = (tuple(diff_idx), tuple(static_key), _freeze(kwargs))
+    except TypeError:
+        return None
+    cache = _fn_pair_cache(fn)
+    pair = cache.get(key)
+    if pair is _NOJIT:
+        return None
+    if pair is None:
+        if "_seen" not in cache:
+            cache["_seen"] = True
+            return None
+        if len(cache) > 32:
+            # static args that keep changing value (novel key per call)
+            # would compile a fresh pair every time — stop building; the
+            # existing entries keep serving their own keys
+            return None
+        pair = _build_pair(fn, kwargs, datas, set(dyn_idx), tuple(diff_idx))
+        cache[key] = pair
+    return pair, tuple(dyn_idx), cache, key
+
+
+def _mark_nojit(cache, key):
+    cache[key] = _NOJIT
+
+
 # When paddle_tpu.static is recording (enable_static / program_guard), this
 # holds a callable(fn, args, kwargs, outs, name) appending to the Program
 # tape; None in the (default) eager mode — one global check per op.
@@ -250,29 +393,81 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     record = _state.enabled and bool(diff_idx) and has_vjp
 
     if not record:
-        out = fn(*datas, **kwargs)
-        multi = isinstance(out, (tuple, list))
-        outs = tuple(out) if multi else (out,)
+        outs = multi = None
+        fast = _fast_pair(fn, kwargs, datas, ())
+        if fast is not None:
+            (jfwd, _, meta), dyn_idx, cache, ckey = fast
+            try:
+                outs = jfwd(*(datas[i] for i in dyn_idx))
+                multi = meta["multi"]
+            except FloatingPointError:
+                raise
+            except Exception:
+                # fn isn't jittable here (host-side numpy, value-dependent
+                # control flow): run it eagerly from now on
+                _mark_nojit(cache, ckey)
+                outs = None
+        if outs is None:
+            out = fn(*datas, **kwargs)
+            multi = isinstance(out, (tuple, list))
+            outs = tuple(out) if multi else (out,)
         _maybe_check_nan_inf(name, outs)
+        for o in outs:
+            _memory.track(o)
         wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
         if _op_recorder is not None:
             _op_recorder(record_fn, args, kwargs, wrapped, name)
         return wrapped if multi else wrapped[0]
 
-    struct = {"multi": False}
+    outs = None
+    fast = _fast_pair(fn, kwargs, datas, diff_idx)
+    if fast is not None:
+        (jfwd, jbwd, meta), dyn_idx, cache, ckey = fast
+        dyn_args = tuple(datas[i] for i in dyn_idx)
+        try:
+            outs = jfwd(*dyn_args)
+            multi = meta["multi"]
+        except FloatingPointError:
+            raise
+        except Exception:
+            _mark_nojit(cache, ckey)
+            outs = None
+        else:
+            def vjp_fn(cts, _dyn=dyn_args, _jb=jbwd):
+                try:
+                    return _jb(_dyn, cts)
+                except FloatingPointError:
+                    raise
+                except Exception:
+                    # exotic cotangent (float0/sparse) the jitted vjp
+                    # can't take as an argument: one plain retrace
+                    def f2(*primals):
+                        call = list(datas)
+                        for i, p in zip(diff_idx, primals):
+                            call[i] = p
+                        res = fn(*call, **kwargs)
+                        return (tuple(res)
+                                if isinstance(res, (tuple, list))
+                                else (res,))
+                    return jax.vjp(
+                        f2, *[datas[i] for i in diff_idx])[1](cts)
+    if outs is None:
+        struct = {"multi": False}
 
-    def f(*primals):
-        call = list(datas)
-        for i, p in zip(diff_idx, primals):
-            call[i] = p
-        res = fn(*call, **kwargs)
-        struct["multi"] = isinstance(res, (tuple, list))
-        return tuple(res) if struct["multi"] else (res,)
+        def f(*primals):
+            call = list(datas)
+            for i, p in zip(diff_idx, primals):
+                call[i] = p
+            res = fn(*call, **kwargs)
+            struct["multi"] = isinstance(res, (tuple, list))
+            return tuple(res) if struct["multi"] else (res,)
 
-    primals = [datas[i] for i in diff_idx]
-    outs, vjp_fn = jax.vjp(f, *primals)
-    multi = struct["multi"]
+        primals = [datas[i] for i in diff_idx]
+        outs, vjp_fn = jax.vjp(f, *primals)
+        multi = struct["multi"]
     _maybe_check_nan_inf(name, outs)
+    for o in outs:
+        _memory.track(o)
 
     out_avals = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs)
     node = GradNode(vjp_fn, tuple(args[i] for i in diff_idx), out_avals, name,

@@ -13,14 +13,19 @@ from ..core.autograd import apply_op
 from ..core.tensor import Tensor
 
 
+def _matmul_impl(a, b, transpose_x=False, transpose_y=False):
+    # module-level (stable identity) so the eager dispatch fast path can
+    # cache its jitted fwd/vjp pair; the flags ride as static kwargs
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    return jnp.matmul(a, b)
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
-    def f(a, b):
-        if transpose_x:
-            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
-        if transpose_y:
-            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b)
-    return apply_op(f, x, y, op_name="matmul")
+    return apply_op(_matmul_impl, x, y, op_name="matmul",
+                    transpose_x=transpose_x, transpose_y=transpose_y)
 
 
 def mm(input, mat2, name=None):
