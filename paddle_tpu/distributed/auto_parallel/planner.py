@@ -7,14 +7,21 @@ distributed program with per-op FLOPs/bytes models over a cluster
 description, prunes infeasible ones, and picks the cheapest. This
 planner does the TPU-native equivalent:
 
-1. enumerate mesh factorizations of n_devices over (dp, fsdp, mp);
+1. enumerate mesh factorizations of n_devices over (dp, fsdp, mp) and —
+   when ``max_pp`` allows — a pipeline axis pp (the reference prices
+   pipeline candidates through its schedule passes,
+   ref: passes/pipeline_scheduler_pass/ + static/cost/);
 2. price each with a roofline model — MXU time from model FLOPs,
    ICI time per axis from the collective bytes its sharding implies
    (dp: grad allreduce; fsdp: param allgather fwd+bwd + grad
-   reduce-scatter; mp: per-layer activation allreduces);
+   reduce-scatter; mp: per-layer activation allreduces; pp: boundary
+   p2p bytes plus a bubble factor REPLAYED from the repo's own
+   1F1B / ZB-H1 schedule simulators — the cheaper schedule wins and is
+   recorded on the candidate);
 3. prune configs whose per-chip memory (params + grads + optimizer
-   state + activations) exceeds the HBM budget — the compile-free OOM
-   verdict (the reference's prune-by-memory, auto_tuner/prune.py);
+   state + activation checkpoints, with pipeline in-flight accounting)
+   exceeds the HBM budget — the compile-free OOM verdict (the
+   reference's prune-by-memory, auto_tuner/prune.py);
 4. (optional) hand the top-k survivors to the auto_tuner trial runner,
    which compiles and TIMES each candidate (distributed/auto_tuner/
    runner.py) — measurement beats modeling for the final pick.
@@ -24,6 +31,7 @@ v5e and is overridable — the analog of static/cluster.py.
 """
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
@@ -104,6 +112,9 @@ class PlanCandidate:
     dp: int
     fsdp: int
     mp: int
+    pp: int = 1
+    schedule: str = ""            # "1f1b" | "zb_h1" when pp > 1
+    bubble_fraction: float = 0.0
     est_step_time: float = 0.0
     est_mem_bytes: float = 0.0
     feasible: bool = True
@@ -114,9 +125,36 @@ class PlanCandidate:
     def mesh_shape(self) -> Tuple[int, int, int]:
         return (self.dp, self.fsdp, self.mp)
 
+    @property
+    def full_shape(self) -> Tuple[int, int, int, int]:
+        return (self.dp, self.fsdp, self.mp, self.pp)
+
 
 def _ring_factor(n: int) -> float:
     return (n - 1) / n if n > 1 else 0.0
+
+
+@functools.lru_cache(maxsize=None)
+def _bubble_fractions(pp: int, micro: int) -> Tuple[float, float]:
+    """(1F1B, ZB-H1) bubble fractions for a pp-stage pipeline with
+    ``micro`` micro-batches, replayed through the repo's own schedule
+    simulator (fleet/pipeline_zero_bubble.py) — the same event/dependency
+    model the real schedules execute, not a closed-form guess."""
+    from ..fleet.pipeline_zero_bubble import (
+        one_f_one_b_schedule, simulate_schedule, zb_h1_schedule)
+
+    busy = 3 * micro  # per-stage work slots: micro * (t_f + t_b + t_w)
+
+    def frac(idle_by_stage):
+        worst = max(idle_by_stage.values())
+        return worst / (worst + busy)
+
+    f1b = frac(simulate_schedule(
+        {s: one_f_one_b_schedule(pp, s, micro) for s in range(pp)},
+        fused_bw=True))
+    zb = frac(simulate_schedule(
+        {s: zb_h1_schedule(pp, s, micro) for s in range(pp)}))
+    return f1b, zb
 
 
 class Planner:
@@ -128,39 +166,61 @@ class Planner:
     cost-model-then-trials flow (auto_tuner/tuner.py)."""
 
     def __init__(self, n_devices: int, cluster: Optional[Cluster] = None,
-                 max_mp: Optional[int] = None):
+                 max_mp: Optional[int] = None, max_pp: int = 1,
+                 micro_batches: Optional[int] = None):
         self.n = n_devices
         self.cluster = cluster or Cluster()
         self.max_mp = max_mp or n_devices
+        # pp candidates are enumerated only up to max_pp: the caller must
+        # be able to REALIZE a pipeline plan (Engine's executor currently
+        # drives flat meshes, so it passes 1; the standalone planner and
+        # the pipeline-capable trial runner pass n)
+        self.max_pp = max(int(max_pp), 1)
+        self.micro_batches = micro_batches  # default: 2*pp per candidate
 
     def candidates(self) -> List[PlanCandidate]:
         out = []
         n = self.n
-        for dp in range(1, n + 1):
-            if n % dp:
+        for pp in range(1, min(self.max_pp, n) + 1):
+            if n % pp:
                 continue
-            rem = n // dp
-            for fsdp in range(1, rem + 1):
-                if rem % fsdp:
+            nn = n // pp
+            for dp in range(1, nn + 1):
+                if nn % dp:
                     continue
-                mp = rem // fsdp
-                if mp > self.max_mp:
-                    continue
-                out.append(PlanCandidate(dp=dp, fsdp=fsdp, mp=mp))
+                rem = nn // dp
+                for fsdp in range(1, rem + 1):
+                    if rem % fsdp:
+                        continue
+                    mp = rem // fsdp
+                    if mp > self.max_mp:
+                        continue
+                    out.append(PlanCandidate(dp=dp, fsdp=fsdp, mp=mp,
+                                             pp=pp))
         return out
 
     def price(self, cand: PlanCandidate, prof: ModelProfile
               ) -> PlanCandidate:
         c = self.cluster
-        n_shard = cand.fsdp * cand.mp
-        # -- memory: params+grads+opt sharded by fsdp*mp; live
-        # activations assume per-layer rematerialization (the training
-        # step checkpoints between layers), so ONE layer's activations
-        # count. dp AND fsdp both split the batch (fsdp = data parallel
-        # with sharded state); mp splits hidden.
+        micro = self.micro_batches or max(2 * cand.pp, 1)
+        n_shard = cand.fsdp * cand.mp * cand.pp
+        # -- memory: params+grads+opt sharded by fsdp*mp, and by pp too
+        # (each stage owns only its layers). Activations: per-layer
+        # rematerialization keeps ONE layer's working set live, but the
+        # remat CHECKPOINTS (one [tokens, hidden] boundary per layer,
+        # batch split over dp*fsdp) are stored — pipeline stages store
+        # them only for their own layers and in-flight micro-batches,
+        # which is the memory lever pp has that fsdp doesn't: fsdp can
+        # never shard a batch it can't split, pp shards the LAYERS.
         state_bytes = prof.param_bytes * (1 + prof.bytes_per_param_state)
         act_live = prof.activation_bytes / max(prof.layer_count, 1)
-        mem = state_bytes / n_shard + act_live / self.n
+        ckpt_all = (prof.layer_count * prof.batch_tokens * prof.hidden *
+                    prof.act_dtype_bytes)
+        ckpt = ckpt_all / (cand.dp * cand.fsdp)
+        if cand.pp > 1:
+            in_flight = min(cand.pp, micro)
+            ckpt = ckpt * in_flight / (micro * cand.pp)
+        mem = state_bytes / n_shard + act_live / self.n + ckpt
         cand.est_mem_bytes = mem
         if mem > c.hbm_bytes:
             cand.feasible = False
@@ -174,11 +234,19 @@ class Planner:
         mp_eff = min(1.0, width / c.mp_min_width)
         t_compute = prof.flops_per_step / self.n / \
             (c.chip_flops * c.mfu_ceiling * mp_eff)
+        # -- pipeline bubble: replay the candidate's schedules through
+        # the repo's own simulator and take the better of 1F1B / ZB-H1
+        # (the executable schedules in fleet/pipeline_zero_bubble.py)
+        if cand.pp > 1:
+            f1b, zb = _bubble_fractions(cand.pp, micro)
+            cand.schedule, cand.bubble_fraction = (
+                ("zb_h1", zb) if zb <= f1b else ("1f1b", f1b))
+            t_compute = t_compute / max(1.0 - cand.bubble_fraction, 1e-3)
         # -- communication per step (ring costs over ICI):
         bw = c.ici_bandwidth
         shard_param_bytes = prof.param_bytes / n_shard
         t_dp = 2 * shard_param_bytes * _ring_factor(cand.dp) / bw
-        t_fsdp = 3 * (prof.param_bytes / cand.mp) * \
+        t_fsdp = 3 * (prof.param_bytes / (cand.mp * cand.pp)) * \
             _ring_factor(cand.fsdp) / bw
         # Megatron mp: two activation allreduces fwd + two bwd per layer
         # over this dp-shard's [tokens, hidden] tensor
@@ -186,6 +254,14 @@ class Planner:
                     (prof.batch_tokens / (cand.dp * cand.fsdp)) *
                     prof.hidden * prof.act_dtype_bytes)
         t_mp = mp_bytes * _ring_factor(cand.mp) / bw
+        # pp boundary p2p: one [tokens_micro, hidden] activation fwd and
+        # one grad bwd per stage boundary per micro-batch
+        t_pp = 0.0
+        if cand.pp > 1:
+            tokens_micro = prof.batch_tokens / (cand.dp * cand.fsdp *
+                                                micro)
+            hop_bytes = tokens_micro * prof.hidden * prof.act_dtype_bytes
+            t_pp = 2 * (cand.pp - 1) * micro * hop_bytes / bw
         # per-COLLECTIVE launch latency (ring transfers pipeline, so
         # the launch cost is ~independent of ring length): dp's grad
         # allreduce is one fused pair; fsdp gathers/scatters and mp
@@ -194,8 +270,11 @@ class Planner:
         lat = c.ici_latency
         t_lat = ((2 * lat if cand.dp > 1 else 0.0) +
                  (3 * prof.layer_count * lat if cand.fsdp > 1 else 0.0) +
-                 (4 * prof.layer_count * lat if cand.mp > 1 else 0.0))
-        cand.est_step_time = t_compute + t_dp + t_fsdp + t_mp + t_lat
+                 (4 * prof.layer_count * lat if cand.mp > 1 else 0.0) +
+                 (2 * (cand.pp - 1) * micro * lat if cand.pp > 1
+                  else 0.0))
+        cand.est_step_time = (t_compute + t_dp + t_fsdp + t_mp + t_pp +
+                              t_lat)
         return cand
 
     def plan(self, prof: ModelProfile, top_k: int = 1
@@ -221,6 +300,9 @@ class Planner:
         for cand in self.plan(prof, top_k=top_k):
             cfg = {"dp_degree": cand.dp, "fsdp_degree": cand.fsdp,
                    "mp_degree": cand.mp}
+            if cand.pp > 1:
+                cfg["pp_degree"] = cand.pp
+                cfg["pp_schedule"] = cand.schedule
             try:
                 cand.measured_items_per_s = float(trial_fn(cfg))
             except Exception as e:  # noqa: BLE001 — a failed trial is data

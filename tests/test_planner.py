@@ -68,6 +68,71 @@ class TestCandidatesAndPricing:
         assert best.mp == 1, best
         assert best.fsdp > 1, best
 
+    def test_pp_axis_enumerated_and_priced(self):
+        """max_pp opens the pipeline axis: 4-tuples cover dp*fsdp*mp*pp
+        = n, and pp candidates carry a schedule + simulator-derived
+        bubble fraction (ref: passes/pipeline_scheduler_pass/)."""
+        cands = Planner(8, max_pp=8).candidates()
+        shapes = {c.full_shape for c in cands}
+        assert (1, 1, 1, 8) in shapes and (2, 1, 1, 4) in shapes
+        for c in cands:
+            assert c.dp * c.fsdp * c.mp * c.pp == 8
+        prof = ModelProfile(param_bytes=2 * 10 ** 9,
+                            flops_per_step=1e15, batch_tokens=8192,
+                            hidden=4096, layer_count=32)
+        p = Planner(8, max_pp=8)
+        priced = [p.price(c, prof) for c in p.candidates() if c.pp == 4]
+        for c in priced:
+            assert c.schedule in ("1f1b", "zb_h1")
+            assert 0.0 < c.bubble_fraction < 1.0
+            # ZB-H1's whole point: never a worse bubble than 1F1B
+            from paddle_tpu.distributed.auto_parallel.planner import \
+                _bubble_fractions
+            f1b, zb = _bubble_fractions(4, 8)
+            assert zb <= f1b
+
+    def test_memory_infeasible_without_pp_plans_onto_pp(self):
+        """The VERDICT done-gate: a model whose activation checkpoints
+        can't fit however the BATCH is split (batch too small to spread
+        over more dp*fsdp) must come back with pp > 1 — pipeline shards
+        the LAYERS, the one memory lever the flat axes don't have."""
+        # modest params, but enormous activation-checkpoint footprint:
+        # 8192 tokens * hidden 32768 * 128 layers * 2B = 68.7GB of remat
+        # checkpoints; dp*fsdp <= 4 dilutes it to 17.2GB > HBM however
+        # the flat mesh is factored (mp shards neither checkpoints nor
+        # their batch), while pp=4 stores only each stage's layers for
+        # the in-flight micro-batches (~8.6GB)
+        prof = ModelProfile(param_bytes=1 * 10 ** 9,
+                            flops_per_step=1e15,
+                            batch_tokens=8192, hidden=32768,
+                            layer_count=128)
+        # without pp: every flat config is memory-infeasible
+        with pytest.raises(ValueError, match="no feasible"):
+            Planner(4).plan(prof)
+        # with the pipeline axis open, the planner finds a pp plan
+        best = Planner(4, max_pp=4).plan(prof, top_k=1)[0]
+        assert best.pp > 1, best
+        assert best.est_mem_bytes <= Planner(4).cluster.hbm_bytes
+        assert best.schedule in ("1f1b", "zb_h1")
+
+    def test_plan_measured_reports_pp_config(self):
+        """pp candidates reach the trial runner with their schedule in
+        the config dict."""
+        prof = ModelProfile(param_bytes=1 * 10 ** 9,
+                            flops_per_step=1e15,
+                            batch_tokens=8192, hidden=32768,
+                            layer_count=128)
+        seen = []
+
+        def trial(cfg):
+            seen.append(dict(cfg))
+            return 1.0
+
+        Planner(4, max_pp=4).plan_measured(prof, trial, top_k=2)
+        assert any(c.get("pp_degree", 1) > 1 for c in seen)
+        assert all("pp_schedule" in c for c in seen
+                   if c.get("pp_degree", 1) > 1)
+
     def test_plan_measured_picks_trial_winner(self):
         """The measured phase must return the argmax of the trial
         throughputs, skipping failed trials (the reference's recorded
