@@ -9,8 +9,9 @@ bounded dispatch becomes a GATHER (tokens -> [E, C, H] expert buffers)
 and combine becomes a per-token top-k gather — both O(E*C*H) instead of
 the one-hot einsum's O(T*E*C*H), and both plain XLA gathers that GSPMD
 re-shards over the 'ep' mesh axis with all-to-all collectives (asserted
-by tests/test_moe HLO inspection). The expert FFN runs on the Pallas
-grouped-matmul kernel (ops/pallas/grouped_matmul.py) when shapes tile.
+by tests/test_moe HLO inspection). The expert FFN runs on the
+fixed-capacity batched expert GEMM (XLA schedules it at near matmul
+peak; the Pallas grouped matmul serves the ragged-group case).
 """
 from __future__ import annotations
 
@@ -18,7 +19,6 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 __all__ = ["capacity_dispatch_indices", "moe_forward_indices"]
 

@@ -137,7 +137,7 @@ def _gmm_pallas(lhs, rhs, tile_ids, block_t):
 _VMEM_WORDS = int(13.5 * 1024 * 1024) // 4  # fp32 words under the 16MB cap
 
 
-def _pick_blocks(t: int, k: int, n: int, block_t: int):
+def _pick_blocks(k: int, n: int, block_t: int):
     """(block_n, block_k) for the fwd kernel's working set — the
     [block_k, block_n] weight tile, [block_t, block_k] lhs tile,
     [block_t, block_n] out tile and the f32 accumulator — under the
@@ -160,7 +160,7 @@ def _pick_blocks(t: int, k: int, n: int, block_t: int):
 def _gmm_fwd_impl(lhs, rhs, tile_ids, block_t):
     t, k = lhs.shape
     e, _, n = rhs.shape
-    block_n, block_k = _pick_blocks(t, k, n, block_t)
+    block_n, block_k = _pick_blocks(k, n, block_t)
     n_k_tiles = k // block_k
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -188,7 +188,7 @@ def _gmm_fwd_impl(lhs, rhs, tile_ids, block_t):
 def _gmm_drhs_impl(lhs, g, tile_ids, e, block_t):
     t, k = lhs.shape
     n = g.shape[1]
-    block_n, block_k = _pick_blocks(t, k, n, block_t)
+    block_n, block_k = _pick_blocks(k, n, block_t)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         # token tiles MINOR: see kernel docstring (VMEM-resident
