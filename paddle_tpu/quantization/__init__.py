@@ -3,15 +3,18 @@
 ref: python/paddle/quantization/ (QuantConfig config.py, QAT qat.py, PTQ
 ptq.py, observers in quanter/), legacy fake_quantize ops
 (fluid/operators/fake_quantize_op). TPU note: fake-quant is pure
-elementwise math so it fuses into surrounding XLA computations; int8
-deployment lowering is a compiler concern (XLA int8 matmul) — this module
-provides the calibration/training semantics.
+elementwise math so it fuses into surrounding XLA computations;
+``convert_to_int8`` lowers calibrated layers to Int8Linear, which
+executes REAL s8 x s8 -> s32 matmuls (a native MXU fast path) with a
+per-channel scale epilogue — the analog of the reference's int8
+inference kernels behind its analysis passes.
 """
 from .quantize import (  # noqa: F401
     AbsmaxObserver, BaseObserver, BaseQuanter, FakeQuantAbsMax,
-    MovingAverageAbsmaxObserver, PTQ, QAT, QuantConfig, QuantedLinear,
-    fake_quantize_abs_max, quant_absmax, quanter,
+    Int8Linear, MovingAverageAbsmaxObserver, PTQ, QAT, QuantConfig,
+    QuantedLinear, convert_to_int8, fake_quantize_abs_max, quant_absmax,
+    quanter,
 )
 
 __all__ = ["QuantConfig", "BaseQuanter", "BaseObserver", "quanter",
-           "QAT", "PTQ"]
+           "QAT", "PTQ", "Int8Linear", "convert_to_int8"]

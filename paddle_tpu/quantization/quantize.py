@@ -22,7 +22,7 @@ from ..nn.layers_common import Linear
 __all__ = [
     "quant_absmax", "fake_quantize_abs_max", "FakeQuantAbsMax",
     "AbsmaxObserver", "MovingAverageAbsmaxObserver", "QuantConfig", "QAT",
-    "PTQ", "QuantedLinear",
+    "PTQ", "QuantedLinear", "Int8Linear", "convert_to_int8",
 ]
 
 
@@ -274,3 +274,76 @@ def quanter(class_name: str):
         return cls
 
     return decorator
+
+
+def _int8_linear_impl(a, w, ws, *b, act_step):
+    orig_dtype = a.dtype
+    qa = jnp.clip(jnp.round(a.astype(jnp.float32) / act_step),
+                  -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        qa, w, (((qa.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (ws * act_step)
+    if b:
+        y = y + b[0].astype(jnp.float32)
+    return y.astype(orig_dtype)
+
+
+class Int8Linear(Layer):
+    """Linear executing a REAL int8 matmul (ref: the int8 inference
+    kernels the reference's analysis passes lower QAT/PTQ programs onto,
+    fluid/inference quant passes + phi int8 kernels; on TPU int8 is a
+    native MXU fast path at 2x bf16 throughput).
+
+    Weights are stored as int8 with a per-output-channel scale;
+    activations quantize on the fly with the frozen calibration step.
+    The dot runs s8 x s8 -> s32 (preferred_element_type) and the
+    epilogue applies (act_step * w_step) and the f32 bias.
+    """
+
+    def __init__(self, w_int8, w_step, act_step, bias=None):
+        super().__init__()
+        self.w_int8 = w_int8          # [in, out] jnp.int8
+        self.w_step = w_step          # [out] f32 per-channel step
+        self.act_step = float(act_step)
+        self.bias = bias              # Tensor or None
+
+    def forward(self, x):
+        # module-level impl + weights as args: a per-call closure would
+        # be refused by apply_op's fast-dispatch cache (fresh fn
+        # identity every call) and each eager forward would pay ~6
+        # uncompiled dispatches instead of one cached jitted program
+        args = [x, self.w_int8, self.w_step]
+        if self.bias is not None:
+            args.append(self.bias)
+        return apply_op(_int8_linear_impl, *args,
+                        op_name="int8_linear", act_step=self.act_step)
+
+
+def convert_to_int8(model: Layer, inplace: bool = False) -> Layer:
+    """Lower calibrated QuantedLinear layers (PTQ.convert output, or QAT
+    models whose act quanters carry a static scale) to Int8Linear —
+    fake-quant simulation becomes actual int8 execution. Layers without
+    a frozen activation scale are left untouched (dynamic ranges need
+    the fake-quant path).
+    """
+    m = model if inplace else copy.deepcopy(model)
+
+    def pred(l):
+        return (isinstance(l, QuantedLinear)
+                and l.act_quanter.static_scale is not None
+                and l.weight_quanter.quant_bits == 8
+                and l.act_quanter.quant_bits == 8)
+
+    def make(l):
+        w = l.inner.weight._data.astype(jnp.float32)   # [in, out]
+        qmax = 127.0
+        w_absmax = jnp.maximum(jnp.abs(w).max(axis=0), 1e-8)  # [out]
+        w_step = w_absmax / qmax
+        w_int8 = jnp.clip(jnp.round(w / w_step), -qmax, qmax) \
+            .astype(jnp.int8)
+        return Int8Linear(w_int8, w_step,
+                          float(l.act_quanter.static_scale),
+                          bias=l.inner.bias)
+
+    return _swap_layers(m, pred, make)

@@ -1,0 +1,130 @@
+"""Int8 EXECUTION path (VERDICT r3 item 9): PTQ/QAT-calibrated Linears
+lower to actual s8 x s8 -> s32 matmuls with a scale epilogue — not
+fake-quant simulation (ref: the reference's inference quant passes +
+phi int8 kernels; on TPU int8 is a native MXU fast path).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (Int8Linear, PTQ, QuantConfig,
+                                     convert_to_int8)
+
+
+def _mlp():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                         nn.Linear(64, 16))
+
+
+def _calibrated_int8(model, calib):
+    ptq = PTQ(QuantConfig())
+    observed = ptq.quantize(model)
+    for batch in calib:
+        observed(paddle.to_tensor(batch))
+    converted = ptq.convert(observed)
+    return convert_to_int8(converted)
+
+
+class TestInt8Execution:
+    def test_convert_swaps_to_int8_layers(self, rng):
+        model = _mlp()
+        calib = [rng.normal(size=(16, 32)).astype(np.float32)
+                 for _ in range(4)]
+        q = _calibrated_int8(model, calib)
+        int8_layers = [l for l in q.sublayers()
+                       if isinstance(l, Int8Linear)]
+        assert len(int8_layers) == 2
+        for l in int8_layers:
+            assert str(np.dtype(l.w_int8.dtype)) == "int8"
+
+    def test_hlo_contains_int8_dot(self, rng):
+        """The compiled program must really run s8 operands into an s32
+        dot — the int8-execution contract, asserted on the HLO."""
+        import jax
+
+        model = _mlp()
+        calib = [rng.normal(size=(16, 32)).astype(np.float32)
+                 for _ in range(4)]
+        q = _calibrated_int8(model, calib)
+        from paddle_tpu.jit.api import functionalize
+        apply, params0, _ = functionalize(q)
+
+        def fwd(x):
+            out, _ = apply(params0, {}, x)
+            return out
+
+        import jax.numpy as jnp
+        x = jnp.asarray(rng.normal(size=(8, 32)).astype(np.float32))
+        hlo = jax.jit(fwd).lower(x).compile().as_text()
+        assert "s8[" in hlo, "no int8 operand in the compiled program"
+        assert "s32[" in hlo, "no int32 accumulation in the program"
+
+    def test_accuracy_within_1pct_of_fp32(self, rng):
+        """Top-1 agreement vs the fp32 model >= 99% on a trained
+        classifier fixture (the reference's int8-deployment accuracy
+        contract; an untrained model's near-tied random logits would
+        test tie-flipping, not quantization quality)."""
+        model = _mlp()
+        # 16-class gaussian blobs; a short training run separates the
+        # logits so top-1 is confident
+        centers = rng.normal(size=(16, 32)).astype(np.float32) * 2.0
+        labels = rng.integers(0, 16, 1024)
+        data = (centers[labels]
+                + rng.normal(size=(1024, 32)).astype(np.float32) * 0.3)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        crit = paddle.nn.CrossEntropyLoss()
+        for i in range(60):
+            sl = slice((i % 8) * 128, (i % 8) * 128 + 128)
+            loss = crit(model(paddle.to_tensor(data[sl])),
+                        paddle.to_tensor(labels[sl].astype(np.int64)))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        calib = [data[j * 128:(j + 1) * 128] for j in range(8)]
+        q = _calibrated_int8(model, calib)
+        x = (centers[labels]
+             + rng.normal(size=(1024, 32)).astype(np.float32) * 0.3)
+        ref = model(paddle.to_tensor(x)).numpy()
+        got = q(paddle.to_tensor(x)).numpy()
+        agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+        assert agree >= 0.99, agree
+        # and the raw outputs stay close in an absolute sense
+        scale = np.abs(ref).max()
+        assert np.abs(got - ref).max() / scale < 0.1
+
+    def test_int8_matches_fakequant_closely(self, rng):
+        """Int8 execution approximates the fake-quant simulation it
+        replaces (per-channel weight steps make it slightly MORE
+        accurate, so compare both to fp32 rather than to each other)."""
+        model = _mlp()
+        calib = [rng.normal(size=(64, 32)).astype(np.float32)
+                 for _ in range(8)]
+        ptq = PTQ(QuantConfig())
+        observed = ptq.quantize(model)
+        for b in calib:
+            observed(paddle.to_tensor(b))
+        fake = ptq.convert(observed)
+        int8 = convert_to_int8(fake)
+        x = rng.normal(size=(128, 32)).astype(np.float32)
+        ref = model(paddle.to_tensor(x)).numpy()
+        e_fake = np.abs(fake(paddle.to_tensor(x)).numpy() - ref).mean()
+        e_int8 = np.abs(int8(paddle.to_tensor(x)).numpy() - ref).mean()
+        assert e_int8 <= e_fake * 1.5, (e_int8, e_fake)
+
+    def test_predictor_serves_int8(self, rng, tmp_path):
+        """The Predictor path (save -> load -> compiled serve) runs the
+        int8 program end-to-end."""
+        model = _mlp()
+        calib = [rng.normal(size=(16, 32)).astype(np.float32)
+                 for _ in range(4)]
+        q = _calibrated_int8(model, calib)
+        from paddle_tpu.inference import Predictor
+        pred = Predictor(q)
+        x = rng.normal(size=(4, 32)).astype(np.float32)
+        out = pred.run(x)[0]
+        ref = q(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
+                                   atol=1e-5)
