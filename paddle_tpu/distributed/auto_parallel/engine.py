@@ -55,6 +55,7 @@ class Engine:
         self._step: Optional[DistTrainStep] = None
         self._pending_plan_batch = None
         self.plan_choice = None
+        self.recompute_report: Optional[dict] = None
         self.history: dict = {"loss": []}
 
     def _apply_strategy(self):
@@ -107,13 +108,87 @@ class Engine:
                          3: ShardingStage3}[int(sh.get("stage", 1))]
                 self.optimizer = shard_optimizer(self.optimizer,
                                                  stage(self.mesh))
-        rc = (s.recompute if isinstance(s.recompute, dict)
-              else vars(s.recompute))
-        if rc.get("enable"):
-            self._auto_recompute(min_repeat=int(rc.get("min_repeat", 2)))
+        # gradient merge parsed BEFORE recompute: the memory probe must
+        # model the k-way micro-batched program that actually runs
         gm = (s.gradient_merge if isinstance(s.gradient_merge, dict)
               else vars(s.gradient_merge))
         self._acc = int(gm.get("k_steps", 1)) if gm.get("enable") else 1
+        rc = (s.recompute if isinstance(s.recompute, dict)
+              else vars(s.recompute))
+        if rc.get("enable"):
+            target = rc.get("target_peak_bytes")
+            min_repeat = int(rc.get("min_repeat", 2))
+            if target is not None:
+                self._memory_aware_recompute(int(target),
+                                             min_repeat=min_repeat)
+            else:
+                self._auto_recompute(min_repeat=min_repeat)
+
+    def _loss_fn(self):
+        loss_fn = self.loss
+        if hasattr(loss_fn, "forward"):  # a Layer criterion
+            crit = loss_fn
+            return lambda out, *labels: crit(out, *labels)
+        return loss_fn
+
+    def _probe_peak_bytes(self, batch) -> int:
+        """Modeled peak live bytes of the train step for this batch:
+        jaxpr liveness over a shape-only TRACE of the step (no XLA
+        compile, no device allocation) via the static estimator — the
+        decision metric for the memory-aware recompute pass (ref: the
+        reference prices recompute candidates with its static memory
+        cost model, not compiled binaries). The compiled
+        ``memory_analysis()`` remains the deployment truth (bench
+        peak_hbm_bytes); XLA CPU's schedule-agnostic temp figure cannot
+        see remat savings, the model can.
+
+        Shape basis is GLOBAL: jaxpr avals carry unpartitioned logical
+        shapes, so on an N-device mesh this is the whole-program figure
+        (the target budget is interpreted on the same global basis; the
+        report records the basis + mesh size for conversion)."""
+        from .mem_estimator import estimate_peak_bytes
+        opt = self.optimizer
+        if hasattr(opt, "_inner"):
+            opt = opt._inner
+        probe = DistTrainStep(self.model, self._loss_fn(), opt,
+                              data_sharding=self._data_sharding,
+                              accumulate_steps=getattr(self, "_acc", 1))
+        return int(estimate_peak_bytes(
+            probe.trace_jaxpr(*batch, abstract=True)))
+
+    def _memory_aware_recompute(self, target_peak_bytes: int,
+                                min_repeat: int = 2):
+        """Memory-model-driven segment picking (ref: passes/
+        auto_parallel_recompute.py selects segments against a memory
+        model, not a repeat-count heuristic): estimate the step's
+        global-shape peak WITHOUT recompute; only when it exceeds the
+        target are the repeated segments wrapped, and the peak is
+        re-estimated to confirm the drop. Decision + both measurements
+        land in ``self.recompute_report``."""
+        n_dev = (self.mesh.to_jax_mesh().size
+                 if self.mesh is not None else 1)
+        basis = {"shape_basis": "global", "mesh_devices": n_dev,
+                 "target_peak_bytes": int(target_peak_bytes)}
+        batch = self._pending_plan_batch
+        if batch is None:
+            # no sample batch to measure against (explicit load()/
+            # evaluate() path): fall back to the heuristic picker
+            self._auto_recompute(min_repeat=min_repeat)
+            self.recompute_report = {"mode": "heuristic-fallback",
+                                     "reason": "no sample batch",
+                                     **basis}
+            return
+        before = self._probe_peak_bytes(batch)
+        if before <= target_peak_bytes:
+            self.recompute_report = {
+                "mode": "skipped", "peak_bytes": before, **basis}
+            return
+        wrapped = self._auto_recompute(min_repeat=min_repeat)
+        after = self._probe_peak_bytes(batch)
+        self.recompute_report = {
+            "mode": "applied", "segments": len(wrapped),
+            "peak_bytes_before": before, "peak_bytes_after": after,
+            "met_target": after <= target_peak_bytes, **basis}
 
     def _auto_recompute(self, min_repeat: int = 2):
         """Auto segment picking (ref: passes/auto_parallel_recompute.py,
@@ -227,12 +302,11 @@ class Engine:
                         "Engine.plan(sample_batch) explicitly before "
                         "load()/evaluate()")
                 self.plan(self._pending_plan_batch)
-                self._pending_plan_batch = None  # planning consumed it
+                # NOT cleared here: the memory-aware recompute pass in
+                # _apply_strategy also probes against it; fit()/callers
+                # clear it after _ensure_step returns
             self._apply_strategy()
-            loss_fn = self.loss
-            if hasattr(loss_fn, "forward"):  # a Layer criterion
-                crit = loss_fn
-                loss_fn = lambda out, *labels: crit(out, *labels)  # noqa: E731
+            loss_fn = self._loss_fn()
             opt = self.optimizer
             if hasattr(opt, "_inner"):  # _ShardOptimizer: unwrap for step
                 opt = opt._inner

@@ -203,6 +203,66 @@ class DistTrainStep:
                 f"unmatched keys {unmatched[:5]}, "
                 f"missing slots {missing[:5]}")
 
+    def _abstract_opt_state(self):
+        """Shape-only optimizer state (no device allocation): each
+        slot's shapes/dtypes via eval_shape over the optimizer's own
+        init fn — the trace-only probes must not materialize a second
+        copy of the AdamW moments in exactly the memory-constrained
+        configurations they diagnose."""
+        out = {}
+        for k, p in self._params.items():
+            if p.stop_gradient:
+                continue
+            out[k] = jax.eval_shape(
+                lambda d, _p=p: self.optimizer._init_state(
+                    Tensor(d, stop_gradient=_p.stop_gradient)), p._data)
+        return out
+
+    def _probe_args(self, *batch_and_labels, num_labels: int = 1,
+                    abstract: bool = False):
+        """Shared arg prep for the no-run diagnostics (compile_stats /
+        trace_jaxpr): current params/buffers/opt-state plus a FIXED
+        probe rng key — a diagnostic must not advance the global RNG
+        stream (seed-fixed training after a stats query stays
+        identical). ``abstract=True`` substitutes ShapeDtypeStructs
+        everywhere (trace-only callers: zero device allocation; note
+        shardings are NOT carried, so compile-fidelity callers must use
+        the concrete form)."""
+        if self._jitted is None:
+            self._build()
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        raw = [b._data if isinstance(b, Tensor)
+               else b if isinstance(b, jax.Array)
+               else jnp.asarray(np.asarray(b)) for b in batch_and_labels]
+        if abstract:
+            raw = [sds(r) for r in raw]
+        elif self.data_sharding is not None:
+            raw = [jax.device_put(r, self.data_sharding) for r in raw]
+        batch = tuple(raw[:len(raw) - num_labels])
+        labels = tuple(raw[len(raw) - num_labels:]) if num_labels else ()
+        if abstract:
+            params = {k: sds(t._data) for k, t in self._params.items()}
+            buffers = {k: sds(t._data)
+                       for k, t in self._swap.buffers.items()}
+            opt_state = (jax.tree.map(sds, self._opt_state)
+                         if self._opt_state is not None
+                         else self._abstract_opt_state())
+            probe_rng = (jax.eval_shape(lambda: jax.random.key(0)),
+                         jax.ShapeDtypeStruct((), jnp.uint32))
+            lr = jax.ShapeDtypeStruct((), jnp.float32)
+            return (params, buffers, opt_state, lr, probe_rng, batch,
+                    labels)
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        params = {k: t._data for k, t in self._params.items()}
+        buffers = {k: t._data for k, t in self._swap.buffers.items()}
+        probe_rng = (jax.random.key(0), jnp.uint32(0))
+        return (params, buffers, self._opt_state, jnp.float32(0.0),
+                probe_rng, batch, labels)
+
     def compile_stats(self, *batch_and_labels, num_labels: int = 1,
                       return_compiled: bool = False):
         """Compile the step for these batch shapes WITHOUT running it and
@@ -212,29 +272,23 @@ class DistTrainStep:
         pruning, done here ahead of time from the compiled program).
         With return_compiled=True also returns the AOT executable so the
         caller can time steps without a second compile."""
-        if self._jitted is None:
-            self._build()
-        if self._opt_state is None:
-            self._opt_state = self._init_opt_state()
-        raw = [b._data if isinstance(b, Tensor)
-               else b if isinstance(b, jax.Array)
-               else jnp.asarray(np.asarray(b)) for b in batch_and_labels]
-        if self.data_sharding is not None:
-            raw = [jax.device_put(r, self.data_sharding) for r in raw]
-        batch = tuple(raw[:len(raw) - num_labels])
-        labels = tuple(raw[len(raw) - num_labels:]) if num_labels else ()
-        params = {k: t._data for k, t in self._params.items()}
-        buffers = {k: t._data for k, t in self._swap.buffers.items()}
-        # fixed probe key: a diagnostic must not advance the global RNG
-        # stream (seed-fixed training after a stats query stays identical)
-        probe_rng = (jax.random.key(0), jnp.uint32(0))
-        compiled = self._jitted.lower(
-            params, buffers, self._opt_state, jnp.float32(0.0),
-            probe_rng, batch, labels).compile()
+        args = self._probe_args(*batch_and_labels, num_labels=num_labels)
+        compiled = self._jitted.lower(*args).compile()
         mem = compiled.memory_analysis()
         if return_compiled:
-            return mem, compiled, (params, buffers, batch, labels)
+            return mem, compiled, (args[0], args[1], args[5], args[6])
         return mem
+
+    def trace_jaxpr(self, *batch_and_labels, num_labels: int = 1,
+                    abstract: bool = False):
+        """Trace (no compile) the step and return its ClosedJaxpr — the
+        input to the static peak-memory estimator
+        (auto_parallel.mem_estimator.estimate_peak_bytes).
+        ``abstract=True`` traces from ShapeDtypeStructs: no device
+        allocation at all (probe-safe in memory-tight configs)."""
+        args = self._probe_args(*batch_and_labels, num_labels=num_labels,
+                                abstract=abstract)
+        return self._jitted.trace(*args).jaxpr
 
     def __call__(self, *batch_and_labels, num_labels: int = 1):
         if self._jitted is None:
