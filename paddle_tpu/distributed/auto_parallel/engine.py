@@ -184,6 +184,12 @@ class Engine:
                 "mode": "skipped", "peak_bytes": before, **basis}
             return
         wrapped = self._auto_recompute(min_repeat=min_repeat)
+        if not wrapped:
+            # nothing to wrap (no repeated block family): don't claim a
+            # pass was applied, and don't pay a second trace
+            self.recompute_report = {
+                "mode": "no-segments", "peak_bytes": before, **basis}
+            return
         after = self._probe_peak_bytes(batch)
         self.recompute_report = {
             "mode": "applied", "segments": len(wrapped),

@@ -234,13 +234,22 @@ class DistTrainStep:
         def sds(a):
             return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
-        raw = [b._data if isinstance(b, Tensor)
-               else b if isinstance(b, jax.Array)
-               else jnp.asarray(np.asarray(b)) for b in batch_and_labels]
         if abstract:
-            raw = [sds(r) for r in raw]
-        elif self.data_sharding is not None:
-            raw = [jax.device_put(r, self.data_sharding) for r in raw]
+            # shape metadata only — np.asarray on host data reads shape/
+            # dtype without any device transfer, honoring the
+            # zero-device-allocation contract
+            raw = [b._data if isinstance(b, Tensor) else b
+                   for b in batch_and_labels]
+            raw = [sds(r) if isinstance(r, jax.Array)
+                   else sds(np.asarray(r)) for r in raw]
+        else:
+            raw = [b._data if isinstance(b, Tensor)
+                   else b if isinstance(b, jax.Array)
+                   else jnp.asarray(np.asarray(b))
+                   for b in batch_and_labels]
+            if self.data_sharding is not None:
+                raw = [jax.device_put(r, self.data_sharding)
+                       for r in raw]
         batch = tuple(raw[:len(raw) - num_labels])
         labels = tuple(raw[len(raw) - num_labels:]) if num_labels else ()
         if abstract:

@@ -275,11 +275,135 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+class _MicroBatcher:
+    """Request micro-batching for the predictor server (ref: the
+    reference predictor's multi-stream batched serving,
+    inference/api/analysis_predictor.h): concurrent requests arriving
+    within a short window whose inputs share trailing shapes/dtypes are
+    concatenated along axis 0, run as ONE compiled forward, and split
+    back — one dispatch serves many clients. Requests that can't batch
+    (different signature, outputs not row-aligned) fall back to
+    individual runs."""
+
+    def __init__(self, predictor, max_batch: int = 32,
+                 window_ms: float = 2.0):
+        import queue
+        import threading
+        self._p = predictor
+        self.max_batch = max(int(max_batch), 1)
+        self.window_s = max(float(window_ms), 0.0) / 1e3
+        self._q: "queue.Queue" = queue.Queue()
+        self.batches_run = 0       # introspection / tests
+        self.requests_served = 0
+        # signatures whose batched run failed once (e.g. fixed-shape AOT
+        # executables): don't re-attempt the doomed concatenation every
+        # window
+        self._no_batch: set = set()
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def run(self, inputs):
+        import threading
+        done = threading.Event()
+        slot: dict = {}
+        self._q.put((inputs, done, slot))
+        done.wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["outs"]
+
+    @staticmethod
+    def _sig(inputs):
+        return tuple((np.asarray(a).shape[1:], str(np.asarray(a).dtype))
+                     for a in inputs)
+
+    def _loop(self):
+        import queue
+        import time as _time
+        while True:
+            first = self._q.get()
+            batch = [first]
+            deadline = _time.monotonic() + self.window_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            groups: dict = {}
+            for item in batch:
+                groups.setdefault(self._sig(item[0]), []).append(item)
+            for sig, members in groups.items():
+                self._run_group(sig, members)
+
+    @staticmethod
+    def _bucket(total: int) -> int:
+        """Pad totals up to a power of two: arbitrary concatenated row
+        counts would each compile a fresh XLA program (and stall every
+        queued request behind the compile); bucketing bounds the
+        distinct compiled shapes to ~log2(max total)."""
+        b = 1
+        while b < total:
+            b *= 2
+        return b
+
+    def _run_group(self, sig, members):
+        if len(members) == 1 or sig in self._no_batch:
+            for m in members:
+                self._run_single(m)
+            return
+        try:
+            rows = [int(np.asarray(m[0][0]).shape[0]) for m in members]
+            total = sum(rows)
+            padded = self._bucket(total)
+            stacked = []
+            for i in range(len(members[0][0])):
+                arr = np.concatenate(
+                    [np.asarray(m[0][i]) for m in members], axis=0)
+                if padded > total:
+                    pad = np.repeat(arr[-1:], padded - total, axis=0)
+                    arr = np.concatenate([arr, pad], axis=0)
+                stacked.append(arr)
+            outs = self._p.run(*stacked)
+            if not all(np.asarray(o).shape[:1] == (padded,)
+                       for o in outs):
+                raise ValueError("outputs not row-aligned with inputs")
+            off = 0
+            self.batches_run += 1
+            for m, r in zip(members, rows):
+                m[2]["outs"] = [np.asarray(o)[off:off + r] for o in outs]
+                self.requests_served += 1
+                m[1].set()
+                off += r
+        except Exception:
+            # batching invalid for this model/signature (e.g. an AOT
+            # artifact's fixed input shape): remember and serve each
+            # request on its own from now on
+            self._no_batch.add(sig)
+            for m in members:
+                self._run_single(m)
+
+    def _run_single(self, item):
+        inputs, done, slot = item
+        try:
+            slot["outs"] = [np.asarray(o) for o in self._p.run(*inputs)]
+            self.batches_run += 1
+            self.requests_served += 1
+        except Exception as e:  # noqa: BLE001 — surfaced to the client
+            slot["error"] = e
+        done.set()
+
+
 def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
-          block: bool = True):
+          block: bool = True, max_batch: int = 32,
+          batch_window_ms: float = 2.0):
     """Minimal predictor server (ref: the reference ships its predictor
     behind paddle_serving / the C API server loop; this is the
-    batteries-included analog).
+    batteries-included analog). Concurrent requests are micro-batched
+    into one compiled forward (see _MicroBatcher); ``max_batch=1``
+    disables batching.
 
     Protocol: POST /run with an .npz body holding arrays input_0..N;
     response is an .npz of output_0..M. GET /health returns 200.
@@ -290,6 +414,8 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     predictor = Predictor(Config(model_path))
+    batcher = _MicroBatcher(predictor, max_batch=max_batch,
+                            window_ms=batch_window_ms)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -314,7 +440,7 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
                 data = np.load(io.BytesIO(self.rfile.read(n)),
                                allow_pickle=False)
                 inputs = [data[f"input_{i}"] for i in range(len(data))]
-                outs = predictor.run(*inputs)
+                outs = batcher.run(inputs)
                 buf = io.BytesIO()
                 np.savez(buf, **{f"output_{i}": o
                                  for i, o in enumerate(outs)})
@@ -332,6 +458,7 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
                 self.wfile.write(msg)
 
     server = ThreadingHTTPServer((host, port), Handler)
+    server.batcher = batcher  # introspection (tests, metrics)
     if block:
         server.serve_forever()
         return None

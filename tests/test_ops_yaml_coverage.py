@@ -646,7 +646,7 @@ def test_check_grad(name):
             continue
         g = grads[gi].numpy()
         flat = raw[i].reshape(-1)
-        for pos in rng.choice(flat.size, size=min(4, flat.size),
+        for pos in rng.choice(flat.size, size=min(12, flat.size),
                               replace=False):
             orig = flat[pos]
             flat[pos] = orig + eps
