@@ -15,11 +15,15 @@ the op-dispatch level:
   and the extracted value becomes a **guard** (the analog of the
   reference's graph break + guard).
 - **Replay**: later calls with the same input signature execute the
-  recorded segments as jit-compiled programs; after each break the guard
-  tensor is fetched and compared against the recorded path. Matching
-  paths run fully compiled; a mismatch re-records that branch (the trace
-  tree grows one path per taken branch, e.g. one per while-loop trip
-  count).
+  recorded segments as jit-compiled programs. Guards validate
+  SPECULATIVELY: every segment of the recorded path dispatches without
+  waiting, the guard tensors are packed into one uint8 array in-jit,
+  and a single host fetch checks the whole path — N graph breaks cost
+  one device round-trip, not N serialized ones. Matching paths run
+  fully compiled; a mismatch discards the speculated tail (segments are
+  pure programs; side-effectful recordings never replay) and re-records
+  that branch (the trace tree grows one path per taken branch, e.g. one
+  per while-loop trip count).
 - **Fallback**: recordings that consumed RNG (dropout) or mutated
   buffers in place (BN train-mode running stats) are marked non-
   replayable — those calls simply stay eager, which is the reference's
@@ -404,6 +408,25 @@ def _compile_segment(seg: _Segment):
     return jax.jit(seg_fn)
 
 
+@jax.jit
+def _pack_bytes(vals):
+    """Concatenate arbitrary fixed-size-dtype arrays into ONE uint8
+    array (little-endian element bytes == numpy tobytes order)."""
+    parts = []
+    for v in vals:
+        v = jnp.asarray(v)
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.uint8)
+        flat = v.reshape(-1)
+        if flat.dtype.itemsize > 1:
+            flat = jax.lax.bitcast_convert_type(
+                flat, jnp.uint8).reshape(-1)
+        parts.append(flat)
+    if not parts:
+        return jnp.zeros((0,), jnp.uint8)
+    return jnp.concatenate(parts)
+
+
 class _CompiledPath:
     """One guard path of one signature: compiled segments + guards."""
 
@@ -412,6 +435,9 @@ class _CompiledPath:
         self.input_ids = input_ids
         for seg in rec.segments:
             seg.jitted = _compile_segment(seg)
+        # the recorded path's guard values, concatenated once, for the
+        # single-fetch validation below
+        self._guard_bytes = b"".join(g.value for g in rec.guards)
 
     def replay(self, input_tensors: List[Tensor]):
         """Returns (ok, result). ok=False on a guard miss.
@@ -421,6 +447,16 @@ class _CompiledPath:
         THROUGH the compiled segments into the inputs and the captured
         parameters (apply_op takes jax.vjp of the jitted segment — the
         jit boundary is kept as a call primitive, so it stays compiled).
+
+        Guard handling is SPECULATIVE (the lax.cond-flavored answer to
+        the reference's per-break host sync, SURVEY §3.1): every segment
+        of the recorded path is dispatched without waiting, all guard
+        tensors are packed into one uint8 array in-jit, and ONE host
+        fetch validates the whole path — N graph breaks cost one device
+        round-trip instead of N serialized ones. Segments are pure
+        compiled programs (RNG/mutating recordings never replay), so
+        computing a wrong-path tail and discarding it is free of side
+        effects; a mismatch falls back to re-recording, as before.
         """
         from ..core.autograd import apply_op
         rec = self.rec
@@ -428,6 +464,7 @@ class _CompiledPath:
             if np.asarray(t._data).tobytes() != val:
                 return False, None
         env: Dict[int, Tensor] = dict(zip(self.input_ids, input_tensors))
+        guard_vals = []
         for si, seg in enumerate(rec.segments):
             n_ext = len(seg.ext_tensors)
             in_tensors = [env[i] for i in seg.input_ids]
@@ -444,10 +481,11 @@ class _CompiledPath:
                 for oid, o in zip(seg.output_ids, outs):
                     env[oid] = o
             if si < len(rec.guards):
-                g = rec.guards[si]
-                got = np.asarray(env[g.tensor_id]._data).tobytes()
-                if got != g.value:
-                    return False, None  # guard miss
+                guard_vals.append(env[rec.guards[si].tensor_id]._data)
+        if guard_vals:
+            got = np.asarray(_pack_bytes(guard_vals)).tobytes()
+            if got != self._guard_bytes:
+                return False, None  # guard miss somewhere on the path
         return True, self._build_result(env)
 
     def _build_result(self, env):
