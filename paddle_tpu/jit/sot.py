@@ -449,43 +449,85 @@ class _CompiledPath:
         jit boundary is kept as a call primitive, so it stays compiled).
 
         Guard handling is SPECULATIVE (the lax.cond-flavored answer to
-        the reference's per-break host sync, SURVEY §3.1): every segment
-        of the recorded path is dispatched without waiting, all guard
-        tensors are packed into one uint8 array in-jit, and ONE host
-        fetch validates the whole path — N graph breaks cost one device
-        round-trip instead of N serialized ones. Segments are pure
-        compiled programs (RNG/mutating recordings never replay), so
-        computing a wrong-path tail and discarding it is free of side
-        effects; a mismatch falls back to re-recording, as before.
+        the reference's per-break host sync, SURVEY §3.1): the FIRST
+        guard is checked after the first segment (so a wrong candidate
+        path — MRU probing tries siblings — costs ~one segment, as the
+        per-guard scheme did), then every remaining segment dispatches
+        without waiting and the rest of the guard tensors are packed
+        into one uint8 array in-jit and validated with ONE further
+        fetch — N graph breaks cost ~2 device round-trips instead of N
+        serialized ones (device-resident ext guards share one more
+        packed fetch). Segments are pure compiled programs
+        (RNG/mutating recordings never replay), so a wrong-path tail is
+        discarded without side effects; any exception while speculating
+        (e.g. a NaN check tripping on wrong-path garbage) also falls
+        back to re-recording, and NaN flags the discarded tail enqueued
+        are rolled back.
         """
+        from ..core import autograd as autograd_mod
         from ..core.autograd import apply_op
         rec = self.rec
+        # ext guards: host values compare directly; device-resident ones
+        # share one packed fetch
+        dev_guards = []
         for t, val in rec.ext_guards:
-            if np.asarray(t._data).tobytes() != val:
+            if isinstance(t._data, jax.Array):
+                dev_guards.append((t._data, val))
+            elif np.asarray(t._data).tobytes() != val:
+                return False, None
+        if dev_guards:
+            got = np.asarray(_pack_bytes(
+                [d for d, _ in dev_guards])).tobytes()
+            if got != b"".join(v for _, v in dev_guards):
                 return False, None
         env: Dict[int, Tensor] = dict(zip(self.input_ids, input_tensors))
         guard_vals = []
-        for si, seg in enumerate(rec.segments):
-            n_ext = len(seg.ext_tensors)
-            in_tensors = [env[i] for i in seg.input_ids]
-            if seg.ops:
-                jitted = seg.jitted
+        nan_mark = len(autograd_mod._nan_pending)
 
-                def run_seg(*flat, _j=jitted, _n=n_ext):
-                    return tuple(_j(list(flat[:_n]), list(flat[_n:])))
+        def miss():
+            # roll back NaN flags enqueued by the discarded speculation
+            # — they belong to garbage no caller ever sees
+            del autograd_mod._nan_pending[nan_mark:]
+            return False, None
 
-                outs = apply_op(run_seg, *seg.ext_tensors, *in_tensors,
-                                op_name="sot_segment")
-                if not isinstance(outs, tuple):
-                    outs = (outs,)
-                for oid, o in zip(seg.output_ids, outs):
-                    env[oid] = o
-            if si < len(rec.guards):
-                guard_vals.append(env[rec.guards[si].tensor_id]._data)
-        if guard_vals:
-            got = np.asarray(_pack_bytes(guard_vals)).tobytes()
-            if got != self._guard_bytes:
-                return False, None  # guard miss somewhere on the path
+        try:
+            for si, seg in enumerate(rec.segments):
+                n_ext = len(seg.ext_tensors)
+                in_tensors = [env[i] for i in seg.input_ids]
+                if seg.ops:
+                    jitted = seg.jitted
+
+                    def run_seg(*flat, _j=jitted, _n=n_ext):
+                        return tuple(_j(list(flat[:_n]),
+                                        list(flat[_n:])))
+
+                    outs = apply_op(run_seg, *seg.ext_tensors,
+                                    *in_tensors, op_name="sot_segment")
+                    if not isinstance(outs, tuple):
+                        outs = (outs,)
+                    for oid, o in zip(seg.output_ids, outs):
+                        env[oid] = o
+                if si < len(rec.guards):
+                    g = rec.guards[si]
+                    if si == 0:
+                        # early check: wrong sibling candidates bail
+                        # after one segment instead of a full path
+                        got = np.asarray(
+                            env[g.tensor_id]._data).tobytes()
+                        if got != g.value:
+                            return miss()
+                    else:
+                        guard_vals.append(env[g.tensor_id]._data)
+            if guard_vals:
+                got = np.asarray(_pack_bytes(guard_vals)).tobytes()
+                if got != b"".join(
+                        g.value for g in rec.guards[1:]):
+                    return miss()  # miss somewhere on the tail
+        except Exception:
+            # wrong-path garbage can legitimately raise (NaN checks);
+            # re-record eagerly — a genuine error reproduces there with
+            # its real context
+            return miss()
         return True, self._build_result(env)
 
     def _build_result(self, env):

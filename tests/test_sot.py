@@ -416,3 +416,35 @@ class TestReviewFixes3:
             b = sf(x)
         # different cast regimes must be distinct cache entries
         assert sf.cache_size() >= 2
+
+
+def test_speculative_replay_nan_guard_rollback():
+    """Wrong-path speculation must neither crash the call (NaN check
+    tripping on discarded garbage) nor leak flags into the global
+    pending NaN queue; the re-recorded branch serves the right result."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core import autograd as ag
+    from paddle_tpu.jit.sot import sot_compile
+
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        @sot_compile
+        def f(x):
+            if bool((x.min() > 0).numpy()):
+                return paddle.log(x)
+            return x * 2.0
+
+        pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        neg = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        f(pos)                                     # record positive path
+        np.testing.assert_allclose(f(pos).numpy(), np.log([1.0, 2.0]),
+                                   rtol=1e-6)      # replay it
+        # guard miss: log(neg) speculated, discarded, branch re-recorded
+        np.testing.assert_allclose(f(neg).numpy(), [-2.0, 4.0],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(f(neg).numpy(), [-2.0, 4.0],
+                                   rtol=1e-6)      # replay negative path
+        assert not ag._nan_pending, ag._nan_pending
+        ag.flush_nan_checks()                      # must not raise
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
