@@ -435,9 +435,10 @@ class _CompiledPath:
         self.input_ids = input_ids
         for seg in rec.segments:
             seg.jitted = _compile_segment(seg)
-        # the recorded path's guard values, concatenated once, for the
-        # single-fetch validation below
-        self._guard_bytes = b"".join(g.value for g in rec.guards)
+        # tail guard values (guard 0 is checked early, on its own),
+        # concatenated once for the packed single-fetch validation
+        self._tail_guard_bytes = b"".join(
+            g.value for g in rec.guards[1:])
 
     def replay(self, input_tensors: List[Tensor]):
         """Returns (ok, result). ok=False on a guard miss.
@@ -482,12 +483,20 @@ class _CompiledPath:
                 return False, None
         env: Dict[int, Tensor] = dict(zip(self.input_ids, input_tensors))
         guard_vals = []
-        nan_mark = len(autograd_mod._nan_pending)
+        # NaN-flag isolation: flush whatever earlier eager ops enqueued
+        # FIRST (outside the try — a genuine pre-existing NaN raises
+        # here with its real attribution), then give the speculation its
+        # own queue. On success the speculation's flags merge back (they
+        # belong to real outputs); on a miss they are discarded with the
+        # garbage they describe. A mid-speculation stride flush only
+        # ever sees speculation-owned flags, so a trip there is caught
+        # below and simply falls back to re-record.
+        autograd_mod.flush_nan_checks()
+        saved_pending = autograd_mod._nan_pending
+        autograd_mod._nan_pending = []
 
         def miss():
-            # roll back NaN flags enqueued by the discarded speculation
-            # — they belong to garbage no caller ever sees
-            del autograd_mod._nan_pending[nan_mark:]
+            autograd_mod._nan_pending = saved_pending
             return False, None
 
         try:
@@ -520,14 +529,21 @@ class _CompiledPath:
                         guard_vals.append(env[g.tensor_id]._data)
             if guard_vals:
                 got = np.asarray(_pack_bytes(guard_vals)).tobytes()
-                if got != b"".join(
-                        g.value for g in rec.guards[1:]):
+                if got != self._tail_guard_bytes:
                     return miss()  # miss somewhere on the tail
-        except Exception:
-            # wrong-path garbage can legitimately raise (NaN checks);
-            # re-record eagerly — a genuine error reproduces there with
-            # its real context
+        except FloatingPointError:
+            # wrong-path garbage legitimately trips the NaN check;
+            # re-record eagerly — if the CORRECT path is non-finite, the
+            # re-record reproduces the error with its real context
             return miss()
+        except Exception as e:  # noqa: BLE001 — degrade, but loudly
+            warnings.warn(
+                f"SOT replay fell back to re-recording on an unexpected "
+                f"{type(e).__name__}: {e} — speculation disabled for "
+                f"this call", RuntimeWarning)
+            return miss()
+        autograd_mod._nan_pending = \
+            saved_pending + autograd_mod._nan_pending
         return True, self._build_result(env)
 
     def _build_result(self, env):

@@ -55,7 +55,8 @@ class OpTest:
                     else v for k, v in base.items()}
             expect = type(self).ref_fn(
                 *[np.asarray(a, np.float32)
-                  if np.issubdtype(np.asarray(a).dtype, np.floating)
+                  if jnp.issubdtype(jnp.dtype(np.asarray(a).dtype),
+                                    jnp.floating)  # incl. bfloat16
                   else a for a in arrs.values()], **self.attrs)
 
             # eager
@@ -87,7 +88,10 @@ class OpTest:
         names = list(getattr(self, "grad_inputs", float_names))
         if not names:
             return
-        arrs = {k: np.asarray(v, np.float32) for k, v in base.items()}
+        # floats to f32 for finite differences; ints (indices) unchanged
+        arrs = {k: np.asarray(v, np.float32)
+                if np.issubdtype(v.dtype, np.floating) else v
+                for k, v in base.items()}
 
         def scalar_loss(*xs):
             out = type(self).op_fn(

@@ -426,25 +426,34 @@ def test_speculative_replay_nan_guard_rollback():
     from paddle_tpu.core import autograd as ag
     from paddle_tpu.jit.sot import sot_compile
 
-    paddle.set_flags({"FLAGS_check_nan_inf": True})
-    try:
-        @sot_compile
-        def f(x):
-            if bool((x.min() > 0).numpy()):
-                return paddle.log(x)
-            return x * 2.0
+    # stride 1 = immediate-raise mode; stride 4 = batched-queue mode
+    # (the queue isolation/rollback only has work to do in the latter)
+    for stride in (1, 4):
+        paddle.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_check_nan_inf_stride": stride})
+        try:
+            @sot_compile
+            def f(x):
+                if bool((x.min() > 0).numpy()):
+                    return paddle.log(x)
+                return x * 2.0
 
-        pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
-        neg = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
-        f(pos)                                     # record positive path
-        np.testing.assert_allclose(f(pos).numpy(), np.log([1.0, 2.0]),
-                                   rtol=1e-6)      # replay it
-        # guard miss: log(neg) speculated, discarded, branch re-recorded
-        np.testing.assert_allclose(f(neg).numpy(), [-2.0, 4.0],
-                                   rtol=1e-6)
-        np.testing.assert_allclose(f(neg).numpy(), [-2.0, 4.0],
-                                   rtol=1e-6)      # replay negative path
-        assert not ag._nan_pending, ag._nan_pending
-        ag.flush_nan_checks()                      # must not raise
-    finally:
-        paddle.set_flags({"FLAGS_check_nan_inf": False})
+            pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+            neg = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+            f(pos)                                 # record positive path
+            np.testing.assert_allclose(f(pos).numpy(),
+                                       np.log([1.0, 2.0]),
+                                       rtol=1e-6)  # replay it
+            # guard miss: log(neg) speculated, discarded, re-recorded
+            np.testing.assert_allclose(f(neg).numpy(), [-2.0, 4.0],
+                                       rtol=1e-6)
+            np.testing.assert_allclose(f(neg).numpy(), [-2.0, 4.0],
+                                       rtol=1e-6)  # replay negative path
+            # discarded-speculation flags must not leak into the queue
+            assert not any(np.asarray(fl).any()
+                           for _, _, fl in ag._nan_pending), \
+                ag._nan_pending
+            ag.flush_nan_checks()                  # must not raise
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False,
+                              "FLAGS_check_nan_inf_stride": 1})
