@@ -412,7 +412,11 @@ def bench_moe_dispatch():
         # tiny-T measurements flatter the dense algebra instead of
         # measuring the scalable path (MoELayer's dispatch_mode="auto"
         # routes small batches to dense for exactly that reason)
-        T, E, H, F, steps = 32768, 16, 1024, 4096, 6
+        # 24 chained steps: the closing value fetch costs one ~70-100ms
+        # tunnel round-trip (xplane shows the 6-step run's device steps
+        # back-to-back at 30.9 ms each, yet 6 steps measured 42.9 —
+        # the fetch amortized over too few steps)
+        T, E, H, F, steps = 32768, 16, 1024, 4096, 24
     else:
         T, E, H, F, steps = 64, 4, 16, 32, 2
     cap = max(1, int(1.25 * T * 2 / E))
@@ -454,12 +458,15 @@ def bench_moe_dispatch():
     def timeit(f):
         l, _ = f(tokens, wi, wo)
         float(l)
-        t0 = time.perf_counter()
-        l = None
-        for _ in range(steps):
-            l, _ = f(tokens, wi, wo)
-        float(l)
-        return (time.perf_counter() - t0) / steps
+        best = float("inf")
+        for _ in range(3):  # best-of windows: the tunnel wobbles ±5%
+            t0 = time.perf_counter()
+            l = None
+            for _ in range(steps):
+                l, _ = f(tokens, wi, wo)
+            float(l)
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best
 
     t_dense = timeit(train(dense_fwd))
     t_index = timeit(train(index_fwd))
@@ -496,6 +503,10 @@ def bench_dispatch_overhead():
     import paddle_tpu as paddle
 
     budget_us = 150.0
+    # quiesce: this bench runs after the big workloads; pending
+    # finalizers/garbage distort µs-level host timing
+    import gc
+    gc.collect()
     a = paddle.to_tensor(
         np.random.default_rng(0).standard_normal((128, 128))
         .astype(np.float32), stop_gradient=False)
@@ -508,22 +519,25 @@ def bench_dispatch_overhead():
         one()
     jax.block_until_ready(jnp.zeros(()))
     n = 500
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(n):
-            one()
-        best = min(best, (time.perf_counter() - t0) / n)
-    us = best * 1e6
-    raw = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(n):
-            jnp.add(a._data, b._data)
-        raw = min(raw, (time.perf_counter() - t0) / n)
+
+    def best_of(fn, reps=5):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best * 1e6
+
+    us = best_of(one)
+    raw = best_of(lambda: jnp.add(a._data, b._data))
+    # overhead above the raw-jnp floor is the framework's own cost; the
+    # floor itself is environment (tunnel/host load) and is reported
+    # alongside so a loaded run is readable
     _emit("eager_dispatch_overhead_us", us, "us/op", budget_us / us, {
         "path": "grad-recording add, cached jit pair",
-        "raw_jnp_dispatch_us": round(raw * 1e6, 1),
+        "raw_jnp_dispatch_us": round(raw, 1),
+        "overhead_above_floor_us": round(us - raw, 1),
         "budget_us": budget_us,
         "backend": jax.default_backend()})
 
@@ -537,10 +551,20 @@ def main(argv=None):
     # default (the driver run) = the FULL suite, one JSON line per
     # BASELINE workload, headline (Llama) first. A non-headline failure
     # emits an error line instead of killing the artifact.
+    # dispatch µs-bench runs FIRST: after the big workloads the process
+    # carries enough jit-cache/GC/tunnel state to triple even the raw
+    # jnp dispatch floor (measured 32 -> 72 µs), drowning the number
+    try:
+        bench_dispatch_overhead()
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({
+            "metric": "eager_dispatch_overhead_us", "value": None,
+            "unit": "error", "vs_baseline": 0.0,
+            "detail": {"error": f"{type(e).__name__}: {e}"[:300]},
+        }), flush=True)
     bench_llama()
     for fn in (bench_llama7b_geometry, bench_resnet50, bench_bert_base,
-               bench_gpt13b_geometry, bench_moe_dispatch,
-               bench_dispatch_overhead):
+               bench_gpt13b_geometry, bench_moe_dispatch):
         try:
             fn()
         except Exception as e:  # noqa: BLE001 - record, keep going

@@ -360,6 +360,14 @@ _op_recorder = None
 _backward_observer = None
 
 
+# resolved on first dispatch (tensor.py/amp import us — a module-level
+# import would be circular; a per-call import costs ~1.5µs of the
+# measured dispatch budget)
+_Tensor = None
+_amp_state = None
+_maybe_cast_inputs = None
+
+
 def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     """Run ``fn`` (a pure JAX function) on mixed Tensor/raw args, recording a
     GradNode when grad is enabled and any Tensor input requires grad.
@@ -368,21 +376,25 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     This is the analog of a generated ``*_ad_func`` forward
     (ref: fluid/eager/api/manual/eager_manual/forwards/multiply_fwd_func.cc:68).
     """
-    from .tensor import Tensor  # local import; tensor.py imports us too
+    global _Tensor, _amp_state, _maybe_cast_inputs
+    if _Tensor is None:
+        from .tensor import Tensor as _T
+        from ..amp.auto_cast import _state as _s, maybe_cast_inputs as _m
+        _Tensor, _amp_state, _maybe_cast_inputs = _T, _s, _m
+    Tensor = _Tensor
 
     name = op_name or getattr(fn, "__name__", "op")
     datas = [a._data if isinstance(a, Tensor) else a for a in args]
 
     # AMP hook (the analog of the generated ad_func AMP block,
     # ref: multiply_fwd_func.cc:49-70)
-    from ..amp.auto_cast import _state as _amp_state, maybe_cast_inputs
     record_fn = fn
     if _amp_state.enabled:
-        datas = maybe_cast_inputs(name, datas)
+        datas = _maybe_cast_inputs(name, datas)
         # recorders (SOT/static tape) must capture the cast too, so a
         # replayed program reproduces the same AMP numerics
         def record_fn(*a, _fn=fn, _name=name, **kw):
-            return _fn(*maybe_cast_inputs(_name, list(a)), **kw)
+            return _fn(*_maybe_cast_inputs(_name, list(a)), **kw)
 
     has_vjp = _op_gate(name, len(args))
     diff_idx = [

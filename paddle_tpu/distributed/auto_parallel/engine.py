@@ -273,12 +273,52 @@ class Engine:
         # without a shard_fn the fallback only shards along fsdp, so an
         # mp>1 plan would be priced against memory it cannot realize
         max_mp = (auto.get("max_mp") if shard_fn is not None else 1)
-        planner = Planner(n, cluster=cluster, max_mp=max_mp)
+        # the pipeline axis opens only when the model is realizable as
+        # a pipeline (PipelineLayer segmentation contract) — a pp plan
+        # the executor can't run would be worse than no plan
+        max_pp = int(auto.get("max_pp", 1))
+        fam_len = 0
+        if max_pp > 1:
+            from .engine_pp import detect_pipeline_split
+            split = detect_pipeline_split(self.model)
+            if split is None:
+                max_pp = 1
+            else:
+                fam_len = len(split[1])
+        planner = Planner(n, cluster=cluster, max_mp=max_mp,
+                          max_pp=max_pp,
+                          schedules=("gpipe",) if max_pp > 1 else None)
         if trial_fn is not None:
             best = planner.plan_measured(prof, trial_fn)
         else:
-            best = planner.plan(prof, top_k=1)[0]
+            cands = planner.plan(prof, top_k=16)
+
+            def realizable(c):
+                # v1 pipeline realization runs the non-pp axes as pure
+                # data parallel (a pp plan that also assumed fsdp/mp
+                # sharding would claim memory the executor can't
+                # deliver), and the block family must split evenly
+                # across the stages
+                return c.pp == 1 or (c.fsdp == 1 and c.mp == 1
+                                     and fam_len % c.pp == 0)
+
+            best = next((c for c in cands if realizable(c)), None)
+            if best is None:
+                raise ValueError(
+                    "no realizable parallel config: every feasible "
+                    "candidate needs shardings the pipeline executor "
+                    "can't deliver (pp with fsdp/mp, or pp not "
+                    f"dividing the {fam_len}-block family) — raise "
+                    "HBM, shrink the model, or provide a mesh "
+                    "explicitly")
         self.plan_choice = best
+        if best.pp > 1:
+            # pipeline realization builds its own ("dp", "pp") mesh in
+            # _ensure_step; no per-param shardings (blocks stack on pp)
+            self.mesh = ProcessMesh(
+                np.arange(n).reshape(n // best.pp, best.pp),
+                dim_names=["dp", "pp"])
+            return best
         dims = [d for d in best.mesh_shape]
         mesh = ProcessMesh(
             np.arange(n).reshape(dims), dim_names=["dp", "fsdp", "mp"])
@@ -316,10 +356,27 @@ class Engine:
             opt = self.optimizer
             if hasattr(opt, "_inner"):  # _ShardOptimizer: unwrap for step
                 opt = opt._inner
-            self._step = DistTrainStep(
-                self.model, loss_fn, opt,
-                data_sharding=self._data_sharding,
-                accumulate_steps=getattr(self, "_acc", 1))
+            if self.plan_choice is not None and self.plan_choice.pp > 1:
+                # realize the pipeline plan: compiled GPipe over the
+                # ("dp", "pp") mesh (ref: static engine +
+                # pipeline_scheduler_pass; the plan was also PRICED with
+                # the GPipe fill-drain bubble — see plan()'s schedules
+                # argument — so plan_choice.schedule tells the truth)
+                if getattr(self, "_acc", 1) > 1:
+                    raise NotImplementedError(
+                        "gradient_merge with a pipeline plan is not "
+                        "supported (v1): the pipeline already "
+                        "micro-batches inside the step — drop "
+                        "gradient_merge or cap max_pp to 1")
+                from .engine_pp import PipelineTrainStep
+                self._step = PipelineTrainStep(
+                    self.model, loss_fn, opt, pp=self.plan_choice.pp,
+                    n_devices=self.mesh.to_jax_mesh().size)
+            else:
+                self._step = DistTrainStep(
+                    self.model, loss_fn, opt,
+                    data_sharding=self._data_sharding,
+                    accumulate_steps=getattr(self, "_acc", 1))
         return self._step
 
     # -- training (ref: engine.py fit :1544) --------------------------------

@@ -1,0 +1,229 @@
+"""Pipeline-plan realization for the auto-parallel Engine.
+
+The reference's static engine doesn't just PLAN pipeline schedules — it
+executes them (ref: auto_parallel/static/engine.py:100 +
+passes/pipeline_scheduler_pass/). This module is that executor for the
+TPU build: when the planner picks a pp > 1 candidate, the Engine hands
+the model here, the repeated-block family becomes the pipeline body
+(the reference's PipelineLayer SEGMENTATION role,
+fleet/meta_parallel/pp_layers.py), and one jitted train step runs
+pre-layers -> compiled GPipe over a ("dp", "pp") mesh
+(parallel.spmd_pipeline) -> post-layers -> loss -> grads -> optimizer
+update, all inside a single XLA program.
+
+Supported model shape (v1, the same contract the reference's
+PipelineLayer imposes): a Sequential whose children contain ONE
+contiguous run of >= 2 structurally-identical single-input blocks
+(transformer layers, MLP blocks); children before/after the run become
+replicated pre/post stages. Blocks must be buffer-free (BN running
+stats would need cross-microbatch merging).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["detect_pipeline_split", "PipelineTrainStep"]
+
+
+def _block_signature(layer):
+    """Stacking identity: class + ordered (param name, shape, dtype)."""
+    return (type(layer).__name__,
+            tuple((n, tuple(p.shape), str(p.dtype))
+                  for n, p in layer.named_parameters()))
+
+
+def detect_pipeline_split(model):
+    """(pre_layers, family, post_layers) or None when the model has no
+    realizable pipeline body. Family = the longest contiguous run of
+    STRUCTURALLY-identical children (same class AND same param
+    names/shapes/dtypes — same-class blocks with different widths can't
+    stack) with >= 2 members inside a Sequential model."""
+    children = [l for _, l in model.named_children()]
+    if len(children) < 2 or not hasattr(model, "__getitem__"):
+        return None
+    best = None  # (length, start, end)
+    i = 0
+    while i < len(children):
+        j = i
+        sig = _block_signature(children[i])
+        while j < len(children) and \
+                _block_signature(children[j]) == sig:
+            j += 1
+        if j - i >= 2 and (best is None or j - i > best[0]):
+            best = (j - i, i, j)
+        i = max(j, i + 1)
+    if best is None:
+        return None
+    _, s, e = best
+    fam = children[s:e]
+    if any(len(dict(b.named_buffers())) for b in fam):
+        return None  # buffer-carrying blocks (BN) can't pipeline (v1)
+    return children[:s], fam, children[e:]
+
+
+class PipelineTrainStep:
+    """One jitted train step realizing a (dp x pp) pipeline plan.
+
+    loss_fn(out_tensor, *label_tensors) -> scalar Tensor. The loss must
+    be a mean over the batch for micro-batch averaging to equal the
+    full-batch gradient (GPipe's contract; asserted numerically by
+    tests against a flat oracle).
+    """
+
+    def __init__(self, model, loss_fn: Callable, optimizer, pp: int,
+                 n_devices: Optional[int] = None, micro_batches=None,
+                 remat="dots"):
+        from jax.sharding import Mesh
+
+        from ...jit.api import functionalize
+        from ...parallel import stack_layer_params
+
+        split = detect_pipeline_split(model)
+        if split is None:
+            raise ValueError(
+                "pipeline plan needs a Sequential model with a "
+                "contiguous run of >= 2 identical buffer-free blocks "
+                "(the PipelineLayer segmentation contract)")
+        pre, fam, post = split
+        if len(fam) % pp:
+            raise ValueError(
+                f"{len(fam)} pipeline blocks not divisible by pp={pp}")
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.pp = pp
+        self.micro = micro_batches or 2 * pp
+        n = n_devices or len(jax.devices())
+        if n % pp:
+            raise ValueError(f"{n} devices not divisible by pp={pp}")
+        self.mesh = Mesh(
+            np.array(jax.devices()[:n]).reshape(n // pp, pp),
+            ("dp", "pp"))
+
+        applies = [functionalize(b) for b in fam]
+        self._stage_apply = applies[0][0]
+        stacked = stack_layer_params([a[1] for a in applies])
+        params = {"blocks": stacked}
+        # source Tensor maps so updates WRITE BACK into the live model
+        # (evaluate()/save() after fit must see trained weights — the
+        # DistTrainStep contract)
+        self._block_tensors = [dict(b.named_parameters()) for b in fam]
+        self._pre_tensors = self._post_tensors = None
+        self._pre_apply = self._post_apply = None
+        if pre:
+            from ...nn.container import Sequential
+            seq = Sequential(*pre)
+            a, p0, b0 = functionalize(seq)
+            if b0:
+                raise ValueError("pre-stage buffers unsupported (v1)")
+            self._pre_apply, params["pre"] = a, p0
+            self._pre_tensors = dict(seq.named_parameters())
+        if post:
+            from ...nn.container import Sequential
+            seq = Sequential(*post)
+            a, p0, b0 = functionalize(seq)
+            if b0:
+                raise ValueError("post-stage buffers unsupported (v1)")
+            self._post_apply, params["post"] = a, p0
+            self._post_tensors = dict(seq.named_parameters())
+        self._params = params
+        self._opt_state = None
+        self._jitted = None
+        self._remat = remat
+
+    def _init_opt_state(self):
+        return jax.tree.map(
+            lambda leaf: self.optimizer._init_state(Tensor(leaf)),
+            self._params)
+
+    def _build(self):
+        from ...parallel import spmd_pipeline
+
+        opt = self.optimizer
+        loss_fn = self.loss_fn
+        pre_apply, post_apply = self._pre_apply, self._post_apply
+        stage_apply = self._stage_apply
+        mesh, micro, remat = self.mesh, self.micro, self._remat
+
+        def stage_fn(p, x):
+            out, _ = stage_apply(p, {}, x)
+            return out._data if isinstance(out, Tensor) else out
+
+        def step_fn(params, opt_state, lr, batch, labels):
+            def loss_of(ps):
+                x = batch
+                if pre_apply is not None:
+                    out, _ = pre_apply(ps["pre"], {}, x)
+                    x = out._data if isinstance(out, Tensor) else out
+                b = x.shape[0]
+                if b % micro:
+                    raise ValueError(
+                        f"batch {b} not divisible by {micro} "
+                        f"micro-batches")
+                mb = x.reshape(micro, b // micro, *x.shape[1:])
+                y = spmd_pipeline(stage_fn, ps["blocks"], mb, mesh,
+                                  "pp", ("dp",), remat=remat)
+                y = y.reshape(b, *y.shape[2:])
+                if post_apply is not None:
+                    out, _ = post_apply(ps["post"], {}, y)
+                    y = out._data if isinstance(out, Tensor) else out
+                lt = loss_fn(Tensor(y),
+                             *[Tensor(l) for l in labels])
+                return lt._data.astype(jnp.float32)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            # opt_state holds a SLOT DICT at each param-leaf position;
+            # flatten params and lift the state tree only down to the
+            # param leaves so each slot dict rides along intact
+            flat_p, tdef = jax.tree.flatten(params)
+            flat_g = jax.tree.leaves(grads)
+            flat_s = tdef.flatten_up_to(opt_state)
+            out = [opt._update(p, g, s, lr)
+                   for p, g, s in zip(flat_p, flat_g, flat_s)]
+            new_params = jax.tree.unflatten(tdef, [o[0] for o in out])
+            new_state = jax.tree.unflatten(tdef, [o[1] for o in out])
+            return loss, new_params, new_state
+
+        self._jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def _write_back(self):
+        """Push the step's param pytree into the live model's Tensors."""
+        for i, tens in enumerate(self._block_tensors):
+            for k, t in tens.items():
+                t._data = self._params["blocks"][k][i]
+        if self._pre_tensors:
+            for k, t in self._pre_tensors.items():
+                t._data = self._params["pre"][k]
+        if self._post_tensors:
+            for k, t in self._post_tensors.items():
+                t._data = self._params["post"][k]
+
+    def state_dict(self):
+        """Same contract the Engine save path uses on DistTrainStep."""
+        return {"params": self._params, "opt_state": self._opt_state}
+
+    def set_state_dict(self, state):
+        self._params = state["params"]
+        if state.get("opt_state") is not None:
+            self._opt_state = state["opt_state"]
+        self._write_back()
+
+    def __call__(self, batch, *labels):
+        if self._jitted is None:
+            self._build()
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        raw = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
+               for b in (batch, *labels)]
+        lr = jnp.float32(float(self.optimizer.get_lr()))
+        loss, self._params, self._opt_state = self._jitted(
+            self._params, self._opt_state, lr, raw[0], tuple(raw[1:]))
+        self.optimizer._global_step += 1
+        self._write_back()
+        return Tensor(loss)

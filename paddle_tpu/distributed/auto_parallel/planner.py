@@ -167,16 +167,22 @@ class Planner:
 
     def __init__(self, n_devices: int, cluster: Optional[Cluster] = None,
                  max_mp: Optional[int] = None, max_pp: int = 1,
-                 micro_batches: Optional[int] = None):
+                 micro_batches: Optional[int] = None,
+                 schedules=None):
         self.n = n_devices
         self.cluster = cluster or Cluster()
         self.max_mp = max_mp or n_devices
         # pp candidates are enumerated only up to max_pp: the caller must
-        # be able to REALIZE a pipeline plan (Engine's executor currently
-        # drives flat meshes, so it passes 1; the standalone planner and
-        # the pipeline-capable trial runner pass n)
+        # be able to REALIZE a pipeline plan (Engine gates this on its
+        # pipeline executor's segmentation contract)
         self.max_pp = max(int(max_pp), 1)
         self.micro_batches = micro_batches  # default: 2*pp per candidate
+        # which schedules the CALLER can execute: pp candidates are
+        # priced with the best bubble among these and record the pick.
+        # Default = the fleet's executable split-B/W schedules; the
+        # Engine's compiled-GPipe executor passes ("gpipe",) so the plan
+        # is priced with the fill-drain bubble it will actually get.
+        self.schedules = tuple(schedules or ("1f1b", "zb_h1"))
 
     def candidates(self) -> List[PlanCandidate]:
         out = []
@@ -217,10 +223,14 @@ class Planner:
         ckpt_all = (prof.layer_count * prof.batch_tokens * prof.hidden *
                     prof.act_dtype_bytes)
         ckpt = ckpt_all / (cand.dp * cand.fsdp)
+        live = act_live / self.n
         if cand.pp > 1:
             in_flight = min(cand.pp, micro)
             ckpt = ckpt * in_flight / (micro * cand.pp)
-        mem = state_bytes / n_shard + act_live / self.n + ckpt
+            # the pipeline computes ONE micro-batch at a time per stage,
+            # so the live working set shrinks with the micro count
+            live = live / micro
+        mem = state_bytes / n_shard + live + ckpt
         cand.est_mem_bytes = mem
         if mem > c.hbm_bytes:
             cand.feasible = False
@@ -239,8 +249,13 @@ class Planner:
         # (the executable schedules in fleet/pipeline_zero_bubble.py)
         if cand.pp > 1:
             f1b, zb = _bubble_fractions(cand.pp, micro)
-            cand.schedule, cand.bubble_fraction = (
-                ("zb_h1", zb) if zb <= f1b else ("1f1b", f1b))
+            # GPipe fill-drain closed form: (pp-1) idle slots around
+            # micro working slots per stage
+            gp = (cand.pp - 1) / (micro + cand.pp - 1)
+            options = {"1f1b": f1b, "zb_h1": zb, "gpipe": gp}
+            cand.schedule, cand.bubble_fraction = min(
+                ((s, options[s]) for s in self.schedules
+                 if s in options), key=lambda kv: kv[1])
             t_compute = t_compute / max(1.0 - cand.bubble_fraction, 1e-3)
         # -- communication per step (ring costs over ICI):
         bw = c.ici_bandwidth

@@ -205,3 +205,92 @@ class TestEngineAutoPlan:
         assert eng.plan_choice.mesh_shape == (8, 1, 1), eng.plan_choice
         assert eng.mesh is not None
         assert np.isfinite(eng.history["loss"]).all()
+
+
+class TestEnginePipelineRealization:
+    """Planner v2 closes the loop: a pp plan is not just priced — the
+    Engine EXECUTES it via the compiled GPipe schedule (ref: static
+    engine + pipeline_scheduler_pass; segmentation contract =
+    PipelineLayer's repeated-block family)."""
+
+    def _model(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        blocks = [nn.Sequential(nn.Linear(64, 64), nn.Tanh())
+                  for _ in range(4)]
+        return nn.Sequential(*blocks, nn.Linear(64, 64))
+
+    def test_detect_split(self):
+        from paddle_tpu.distributed.auto_parallel.engine_pp import (
+            detect_pipeline_split)
+        m = self._model()
+        pre, fam, post = detect_pipeline_split(m)
+        assert len(pre) == 0 and len(fam) == 4 and len(post) == 1
+
+    def test_pipeline_step_matches_flat_oracle(self):
+        import jax
+
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.auto_parallel.engine_pp import (
+            PipelineTrainStep)
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        m = self._model()
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 64)).astype(np.float32)
+        y = rng.standard_normal((32, 64)).astype(np.float32)
+        # flat full-batch oracle BEFORE any update
+        oracle0 = float(((m(paddle.to_tensor(x))
+                          - paddle.to_tensor(y)) ** 2).mean().numpy())
+        step = PipelineTrainStep(
+            m, lambda o, l: ((o - l) ** 2).mean(), opt, pp=4,
+            n_devices=8)
+        l0 = float(step(x, y))
+        # GPipe micro-batch mean == full-batch mean for a mean loss
+        np.testing.assert_allclose(l0, oracle0, rtol=1e-5)
+        l1 = float(step(x, y))
+        assert l1 < l0  # SGD actually updated the stacked params
+
+    def test_engine_auto_plans_and_runs_pipeline(self):
+        import jax
+
+        from paddle_tpu.distributed.auto_parallel.engine import (
+            Engine, Strategy)
+        from paddle_tpu.distributed.auto_parallel.planner import Cluster
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        m = self._model()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        # activation-dominated geometry: a 2048x64 batch makes remat
+        # checkpoints + the live working set the memory drivers; flat
+        # meshes price ~46MB however factored, while pp=4 (layers split
+        # across stages, one micro-batch in flight) prices ~14MB — a
+        # 20MB budget forces the pipeline plan
+        strat = Strategy()
+        strat.auto = {"enable": True, "max_pp": 4,
+                      "cluster": Cluster(hbm_bytes=20e6)}
+        eng = Engine(m, lambda o, l: ((o - l) ** 2).mean(), opt,
+                     strategy=strat)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2048, 64)).astype(np.float32)
+        oracle0 = float(((m(paddle.to_tensor(x))
+                          - paddle.to_tensor(x)) ** 2).mean().numpy())
+        eng.fit([(x, x)] * 3, epochs=1)
+        assert eng.plan_choice is not None and eng.plan_choice.pp > 1, \
+            eng.plan_choice
+        # the Engine's executor runs compiled GPipe, and the plan was
+        # priced with that schedule's fill-drain bubble — no misreport
+        assert eng.plan_choice.schedule == "gpipe"
+        losses = eng.history["loss"]
+        np.testing.assert_allclose(losses[0], oracle0, rtol=1e-4)
+        assert losses[-1] < losses[0]
+        # updates must WRITE BACK into the live model (evaluate/save
+        # after a pipeline fit see trained weights)
+        post = float(((m(paddle.to_tensor(x))
+                       - paddle.to_tensor(x)) ** 2).mean().numpy())
+        assert post < oracle0, (post, oracle0)
