@@ -205,13 +205,38 @@ class PipelineTrainStep:
                 t._data = self._params["post"][k]
 
     def state_dict(self):
-        """Same contract the Engine save path uses on DistTrainStep."""
-        return {"params": self._params, "opt_state": self._opt_state}
+        """Flat name -> Tensor dict, the same contract DistTrainStep
+        gives the sharded-checkpoint machinery (param keys
+        'section.name', optimizer slots 'section.name#slot'; stacked
+        block params save as single [L, ...] tensors)."""
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        out = {}
+        for section, tree in self._params.items():
+            for k, v in tree.items():
+                out[f"{section}.{k}"] = Tensor(v)
+            for k, slots in self._opt_state[section].items():
+                for sname, sv in slots.items():
+                    out[f"{section}.{k}#{sname}"] = Tensor(sv)
+        return out
 
-    def set_state_dict(self, state):
-        self._params = state["params"]
-        if state.get("opt_state") is not None:
-            self._opt_state = state["opt_state"]
+    def set_state_dict(self, sd):
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+        for key, t in sd.items():
+            val = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            name, slot = (key.rsplit("#", 1) + [None])[:2] \
+                if "#" in key else (key, None)
+            section, pname = name.split(".", 1)
+            if section not in self._params or \
+                    pname not in self._params[section]:
+                raise ValueError(
+                    f"checkpoint key {key!r} does not match the "
+                    f"pipeline step's parameters")
+            if slot is None:
+                self._params[section][pname] = val
+            else:
+                self._opt_state[section][pname][slot] = val
         self._write_back()
 
     def __call__(self, batch, *labels):

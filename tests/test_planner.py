@@ -294,3 +294,10 @@ class TestEnginePipelineRealization:
         post = float(((m(paddle.to_tensor(x))
                        - paddle.to_tensor(x)) ** 2).mean().numpy())
         assert post < oracle0, (post, oracle0)
+        # checkpoint contract: flat name->Tensor incl. optimizer slots
+        sd = eng._step.state_dict()
+        assert any("#moment" in k or "#" in k for k in sd)
+        eng._step.set_state_dict(sd)  # identity roundtrip
+        np.testing.assert_allclose(
+            float(((m(paddle.to_tensor(x)) - paddle.to_tensor(x)) ** 2)
+                  .mean().numpy()), post, rtol=1e-6)
