@@ -92,17 +92,23 @@ def eye(num_rows, num_columns=None, dtype=None, name=None):
 
 
 def diag(x, offset=0, padding_value=0, name=None):
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    if xd.ndim == 1 and padding_value != 0:
-        n = xd.shape[0] + abs(offset)
-        base = jnp.full((n, n), padding_value, xd.dtype)
-        idx = jnp.arange(xd.shape[0])
-        if offset >= 0:
-            base = base.at[idx, idx + offset].set(xd)
-        else:
-            base = base.at[idx - offset, idx].set(xd)
-        return Tensor(base)
-    return Tensor(jnp.diag(xd, k=offset))
+    # through apply_op: diag is differentiable (vector<->matrix diagonal
+    # exchange) — a direct Tensor() construction would silently drop
+    # gradients off the tape
+    from ..core.autograd import apply_op
+
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            idx = jnp.arange(a.shape[0])
+            if offset >= 0:
+                return base.at[idx, idx + offset].set(a)
+            return base.at[idx - offset, idx].set(a)
+        return jnp.diag(a, k=offset)
+
+    return apply_op(f, x if isinstance(x, Tensor)
+                    else Tensor(jnp.asarray(x)), op_name="diag")
 
 
 def tril(x, diagonal=0, name=None):
@@ -116,16 +122,28 @@ def triu(x, diagonal=0, name=None):
 
 
 def meshgrid(*args, **kwargs):
-    arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
-    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+    # differentiable in the reference (broadcast-expand per input);
+    # dispatch each output through the tape
+    from ..core.autograd import apply_op
+    tens = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+            for a in args]
+    outs = apply_op(
+        lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *tens,
+        op_name="meshgrid")
+    return list(outs) if isinstance(outs, tuple) else [outs]
 
 
 def assign(x, output=None):
-    data = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
     if output is not None:
+        data = x._data if isinstance(x, Tensor) \
+            else jnp.asarray(np.asarray(x))
         output.set_value(data)
         return output
-    return Tensor(data)
+    if isinstance(x, Tensor):
+        # identity with gradient flow (ref: assign backward = identity)
+        from ..core.autograd import apply_op
+        return apply_op(lambda a: a, x, op_name="assign")
+    return Tensor(jnp.asarray(np.asarray(x)))
 
 
 def clone(x, name=None):

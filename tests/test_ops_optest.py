@@ -506,3 +506,195 @@ class TestCrossEntropySmallOp(OpTest):
         r = _rng()
         return {"logits": r.normal(size=(6, 5)).astype(np.float32),
                 "labels": r.integers(0, 5, (6,)).astype(np.int64)}
+
+
+# ---------------------------------------------------------------------------
+# round-4 second batch: transpose-conv/depthwise, batched matmul,
+# shape/index manipulation, activations, losses
+# ---------------------------------------------------------------------------
+
+
+class TestConvTranspose2dOp(OpTest):
+    op_fn = staticmethod(lambda x, w: F.conv2d_transpose(
+        x, w, stride=2, padding=0))
+    grad_rtol = 0.15
+
+    @staticmethod
+    def ref_fn(x, w):
+        # w: [cin, cout, kh, kw]; scatter each input pixel's kernel
+        n, cin, h, wd = x.shape
+        _, cout, kh, kw = w.shape
+        oh, ow = (h - 1) * 2 + kh, (wd - 1) * 2 + kw
+        out = np.zeros((n, cout, oh, ow), np.float32)
+        for i in range(h):
+            for j in range(wd):
+                out[:, :, i * 2:i * 2 + kh, j * 2:j * 2 + kw] += \
+                    np.einsum("nc,cokl->nokl", x[:, :, i, j], w)
+        return out
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(1, 2, 3, 3)).astype(np.float32),
+                "w": r.normal(size=(2, 3, 2, 2)).astype(np.float32)}
+
+
+class TestDepthwiseConv2dOp(OpTest):
+    op_fn = staticmethod(lambda x, w: F.conv2d(x, w, stride=1,
+                                               padding=0, groups=2))
+    grad_rtol = 0.15
+
+    @staticmethod
+    def ref_fn(x, w):
+        # groups=2: channels split in half, each half convolved with its
+        # own filter bank
+        halves = []
+        for g in range(2):
+            xg = x[:, g:g + 1]
+            wg = w[g:g + 1, :]
+            halves.append(_np_conv2d(xg, wg, 1, 0))
+        return np.concatenate(halves, axis=1)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(1, 2, 5, 5)).astype(np.float32),
+                "w": r.normal(size=(2, 1, 3, 3)).astype(np.float32)}
+
+
+class TestBmmOp(OpTest):
+    op_fn = staticmethod(paddle.bmm)
+    ref_fn = staticmethod(lambda x, y: np.einsum("bij,bjk->bik", x, y))
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 4, 5)).astype(np.float32),
+                "y": r.normal(size=(3, 5, 2)).astype(np.float32)}
+
+
+class TestStackOp(OpTest):
+    op_fn = staticmethod(lambda x, y: paddle.stack([x, y], axis=1))
+    ref_fn = staticmethod(lambda x, y: np.stack([x, y], axis=1))
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 4)).astype(np.float32),
+                "y": r.normal(size=(3, 4)).astype(np.float32)}
+
+
+class TestFlipRollOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.roll(paddle.flip(x, axis=[1]),
+                                               shifts=2, axis=0))
+    ref_fn = staticmethod(lambda x: np.roll(np.flip(x, axis=1), 2,
+                                            axis=0))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(5, 4)).astype(np.float32)}
+
+
+class TestTrilOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.tril(x, diagonal=-1))
+    ref_fn = staticmethod(lambda x: np.tril(x, k=-1))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(4, 4)).astype(np.float32)}
+
+
+class TestDiagOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.diag(x))
+    ref_fn = staticmethod(np.diag)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(6,)).astype(np.float32)}
+
+
+class TestTakeAlongAxisOp(OpTest):
+    op_fn = staticmethod(lambda x, idx: paddle.take_along_axis(
+        x, idx, axis=1))
+    ref_fn = staticmethod(lambda x, idx: np.take_along_axis(x, idx, 1))
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 5)).astype(np.float32),
+                "idx": r.integers(0, 5, (3, 2)).astype(np.int64)}
+
+
+class TestExpandOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.expand(x, [4, 3, 5]))
+    ref_fn = staticmethod(lambda x: np.broadcast_to(x, (4, 3, 5)))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(1, 3, 5)).astype(np.float32)}
+
+
+class TestPreluOp(OpTest):
+    op_fn = staticmethod(lambda x, a: F.prelu(x, a))
+    ref_fn = staticmethod(lambda x, a: np.where(x > 0, x, a * x))
+    grad_inputs = ("x",)  # FD at the kink for x entries near 0 is fine
+    # with the chosen data; alpha grads are exact linear sums
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 4)).astype(np.float32) + 0.05,
+                "a": np.array([0.25], np.float32)}
+
+
+class TestSiluOp(OpTest):
+    op_fn = staticmethod(F.silu)
+    ref_fn = staticmethod(lambda x: x / (1 + np.exp(-x)))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(4, 4)).astype(np.float32)}
+
+
+class TestSoftplusOp(OpTest):
+    op_fn = staticmethod(F.softplus)
+    ref_fn = staticmethod(lambda x: np.log1p(np.exp(-np.abs(x)))
+                          + np.maximum(x, 0))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(4, 4)).astype(np.float32)}
+
+
+class TestMseLossOp(OpTest):
+    op_fn = staticmethod(lambda x, y: F.mse_loss(x, y))
+    ref_fn = staticmethod(
+        lambda x, y: np.array(((x - y) ** 2).mean(), np.float32))
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(4, 5)).astype(np.float32),
+                "y": r.normal(size=(4, 5)).astype(np.float32)}
+
+
+class TestKLDivOp(OpTest):
+    op_fn = staticmethod(lambda lp, t: F.kl_div(lp, t,
+                                                reduction="sum"))
+    ref_fn = staticmethod(
+        lambda lp, t: np.array((t * (np.log(t) - lp)).sum(), np.float32))
+    grad_inputs = ("logp",)
+
+    def inputs(self):
+        r = _rng()
+        t = np.abs(r.normal(size=(3, 4))).astype(np.float32) + 0.1
+        t = t / t.sum(-1, keepdims=True)
+        return {"logp": r.normal(size=(3, 4)).astype(np.float32),
+                "target": t}
+
+
+class TestOuterOp(OpTest):
+    op_fn = staticmethod(paddle.outer)
+    ref_fn = staticmethod(np.outer)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(5,)).astype(np.float32),
+                "y": r.normal(size=(4,)).astype(np.float32)}
+
+
+class TestKronOp(OpTest):
+    op_fn = staticmethod(paddle.kron)
+    ref_fn = staticmethod(np.kron)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(2, 3)).astype(np.float32),
+                "y": r.normal(size=(3, 2)).astype(np.float32)}
