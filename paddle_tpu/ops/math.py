@@ -223,30 +223,34 @@ def cumprod(x, dim=None, dtype=None, name=None):
 
 
 def cummax(x, axis=None, dtype="int64", name=None):
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    if axis is None:
-        xd, ax = xd.reshape(-1), 0
-    else:
-        ax = axis
-    pos = jnp.arange(xd.shape[ax]).reshape(
-        [-1 if i == ax else 1 for i in range(xd.ndim)])
-    pos = jnp.broadcast_to(pos, xd.shape)
+    # values are differentiable (grad scatters to the running-max
+    # positions); dispatch through the tape — direct Tensor()
+    # construction silently dropped gradients
+    def f(xd):
+        if axis is None:
+            xd2, ax = xd.reshape(-1), 0
+        else:
+            xd2, ax = xd, axis
+        pos = jnp.arange(xd2.shape[ax]).reshape(
+            [-1 if i == ax else 1 for i in range(xd2.ndim)])
+        pos = jnp.broadcast_to(pos, xd2.shape)
 
-    def combine(a, b):
-        av, ai = a
-        bv, bi = b
-        take_b = bv >= av  # paddle keeps the later index on ties
-        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+        def combine(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv >= av  # paddle keeps the later index on ties
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
 
-    vals, idx = jax.lax.associative_scan((lambda a, b: combine(a, b)),
-                                         (xd, pos), axis=ax)
-    return Tensor(vals), Tensor(idx.astype(convert_dtype(dtype)))
+        vals, idx = jax.lax.associative_scan(combine, (xd2, pos),
+                                             axis=ax)
+        return vals, idx.astype(convert_dtype(dtype))
+
+    return apply_op(f, _t(x), op_name="cummax")
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    neg_vals, idx = cummax(-(x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))),
-                           axis=axis, dtype=dtype)
-    return Tensor(-neg_vals._data), idx
+    neg_vals, idx = cummax(-_t(x), axis=axis, dtype=dtype)
+    return -neg_vals, idx
 
 
 # -- comparison / logical ----------------------------------------------------

@@ -185,3 +185,21 @@ class TestInplaceVariants:
         assert paddle.binomial(_t(np.array([10.0], np.float32)),
                                _t(np.array([0.5], np.float32))
                                ).numpy()[0] <= 10
+
+
+def test_cummax_cummin_gradients():
+    """cummax/cummin values are differentiable — grads scatter to the
+    running-extreme positions under the later-index tie rule (these ops
+    previously built Tensors directly and silently dropped the tape)."""
+    x = paddle.to_tensor(np.array([3., 1., 4., 1., 5.], np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.cummax(x)
+    np.testing.assert_allclose(vals.numpy(), [3, 3, 4, 4, 5])
+    np.testing.assert_allclose(idx.numpy(), [0, 0, 2, 2, 4])
+    (vals ** 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12., 0., 16., 0., 10.])
+    x.clear_grad()
+    v2, _ = paddle.cummin(x)
+    np.testing.assert_allclose(v2.numpy(), [3, 1, 1, 1, 1])
+    v2.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1., 2., 0., 2., 0.])
