@@ -144,18 +144,23 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
     return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
 
 
+def _tt(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
 def qr(x, mode="reduced", name=None):
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    q, r = jnp.linalg.qr(xd, mode=mode)
-    return Tensor(q), Tensor(r)
+    # through the tape: QR is differentiable (jax ships its VJP) —
+    # direct Tensor() construction silently dropped gradients
+    return apply_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x,
+                    op_name="qr")
 
 
 def svd(x, full_matrices=False, name=None):
     """Returns (U, S, VH) — VH is the conjugate transpose of V, matching the
     reference contract (ref: python/paddle/tensor/linalg.py svd Returns)."""
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    u, s, vh = jnp.linalg.svd(xd, full_matrices=full_matrices)
-    return Tensor(u), Tensor(s), Tensor(vh)
+    return apply_op(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        x, op_name="svd")
 
 
 def eig(x, name=None):
@@ -165,9 +170,8 @@ def eig(x, name=None):
 
 
 def eigh(x, UPLO="L", name=None):
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    w, v = jnp.linalg.eigh(xd, UPLO=UPLO)
-    return Tensor(w), Tensor(v)
+    return apply_op(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x,
+                    op_name="eigh")
 
 
 def eigvals(x, name=None):
@@ -185,9 +189,9 @@ def det(x, name=None):
 
 
 def slogdet(x, name=None):
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    sign, logdet = jnp.linalg.slogdet(xd)
-    return Tensor(jnp.stack([sign, logdet]))
+    return apply_op(
+        lambda a: jnp.stack(tuple(jnp.linalg.slogdet(a))), x,
+        op_name="slogdet")
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
@@ -332,13 +336,14 @@ def lu(x, pivot=True, get_infos=False, name=None):
     if not pivot:
         raise NotImplementedError(
             "lu(pivot=False) is unsupported (XLA's LU always pivots)")
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    lu_mat, piv, _ = jax.lax.linalg.lu(xd.astype(jnp.float32))
-    piv1 = (piv + 1).astype(jnp.int32)
-    if get_infos:
-        info = jnp.zeros(xd.shape[:-2], jnp.int32)
-        return Tensor(lu_mat), Tensor(piv1), Tensor(info)
-    return Tensor(lu_mat), Tensor(piv1)
+    def f(a):
+        lu_mat, piv, _ = jax.lax.linalg.lu(a.astype(jnp.float32))
+        piv1 = (piv + 1).astype(jnp.int32)
+        if get_infos:
+            return lu_mat, piv1, jnp.zeros(a.shape[:-2], jnp.int32)
+        return lu_mat, piv1
+
+    return apply_op(f, x, op_name="lu")
 
 
 def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
@@ -415,29 +420,38 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
     Halko-Martinsson-Tropp). Returns (U, S, V) with V (not Vᵀ),
     matching the reference."""
     from ..core import random as random_mod
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    a = xd.astype(jnp.float32)
+
+    def f(a, key, *rest):
+        a = a.astype(jnp.float32)
+        if rest:
+            a = a - rest[0]
+        qmat = _lowrank_q(a, min(q, *a.shape[-2:]), niter, key)
+        b = jnp.swapaxes(qmat, -1, -2) @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, jnp.swapaxes(vh, -1, -2)
+
+    # the projection key rides as an argument (random op contract) and
+    # the whole factorization runs on the tape — it is differentiable
+    args = [_tt(x), Tensor(random_mod.next_key())]
     if M is not None:
-        a = a - (M._data if isinstance(M, Tensor) else jnp.asarray(M))
-    qmat = _lowrank_q(a, min(q, *a.shape[-2:]), niter,
-                      random_mod.next_key())
-    b = jnp.swapaxes(qmat, -1, -2) @ a
-    u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
-    u = qmat @ u_b
-    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+        args.append(_tt(M))
+    return apply_op(f, *args, op_name="svd_lowrank")
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     """Randomized PCA (ref: tensor/linalg.py pca_lowrank): low-rank SVD
     of the (optionally centered) data."""
     xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    a = xd.astype(jnp.float32)
-    m, n = a.shape[-2], a.shape[-1]
+    m, n = xd.shape[-2], xd.shape[-1]
     if q is None:
         q = min(6, m, n)
     if center:
-        a = a - jnp.mean(a, axis=-2, keepdims=True)
-    return svd_lowrank(Tensor(a), q=q, niter=niter)
+        centered = apply_op(
+            lambda a: a.astype(jnp.float32)
+            - jnp.mean(a.astype(jnp.float32), axis=-2, keepdims=True),
+            _tt(x), op_name="pca_center")
+        return svd_lowrank(centered, q=q, niter=niter)
+    return svd_lowrank(_tt(x), q=q, niter=niter)
 
 
 def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,

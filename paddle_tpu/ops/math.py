@@ -369,7 +369,13 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    # values differentiable (grad scatters to the selected occurrence,
+    # like median); dispatched through the tape
+    return apply_op(lambda a: _mode_impl(a, axis, keepdim), _t(x),
+                    op_name="mode")
+
+
+def _mode_impl(xd, axis, keepdim):
     ax = axis if axis >= 0 else xd.ndim + axis
     moved = jnp.moveaxis(xd, ax, -1)
     batch_shape, n = moved.shape[:-1], moved.shape[-1]
@@ -383,15 +389,18 @@ def mode(x, axis=-1, keepdim=False, name=None):
 
     cnt = jax.vmap(counts)(s)
     best = jnp.argmax(cnt, axis=-1, keepdims=True)
-    vals = jnp.take_along_axis(s, best, axis=-1)
-    # index of (last) occurrence in the original order, paddle-style
-    occ = flat == vals
+    # stop_gradient: the mode VALUE is selected through the sorted copy,
+    # but the gradient must scatter to the REPORTED occurrence (paddle's
+    # mode_grad contract) — so re-gather from the original positions
+    sel = jax.lax.stop_gradient(jnp.take_along_axis(s, best, axis=-1))
+    occ = flat == sel
     idx = (n - 1) - jnp.argmax(occ[:, ::-1], axis=-1, keepdims=True)
+    vals = jnp.take_along_axis(flat, idx, axis=-1)
     vals = jnp.moveaxis(vals.reshape(batch_shape + (1,)), -1, ax)
     idx = jnp.moveaxis(idx.reshape(batch_shape + (1,)), -1, ax)
     if not keepdim:
         vals, idx = jnp.squeeze(vals, ax), jnp.squeeze(idx, ax)
-    return Tensor(vals), Tensor(idx.astype(jnp.int64))
+    return vals, idx.astype(jnp.int64)
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,

@@ -1,0 +1,88 @@
+"""Namespace-wide autograd-tape audit.
+
+The diag/cummax bugs (round 4) were SILENT: a differentiable op built
+its output Tensor directly instead of dispatching through apply_op, so
+gradients vanished with no error. This audit sweeps every public
+single-tensor callable: any float-valued output of a float input must
+either carry a tape node or be an explicitly known non-differentiable /
+creation op. A new op added without tape dispatch fails here by name.
+"""
+import inspect
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import autograd as ag
+from paddle_tpu.core.tensor import Tensor
+
+# ops whose float output is legitimately detached from the tape
+KNOWN_DETACHED = {
+    # creation / sampling (output independent of the input's VALUE path
+    # or drawn from RNG)
+    "bernoulli", "empty", "empty_like", "full_like", "normal", "ones",
+    "ones_like", "rand", "randn", "randint_like", "standard_normal",
+    "uniform", "zeros", "zeros_like", "to_tensor", "clone_detached",
+    "poisson", "multinomial", "rand_like",
+    # value-independent / zero-derivative by contract
+    "sign", "round", "floor", "ceil", "trunc",
+    # set-returning (membership, not a smooth map)
+    "unique", "unique_consecutive",
+    # data-dependent binning: edges/counts are piecewise-constant in the
+    # input (the reference's histogram has no grad kernel either)
+    "histogram", "histogram_bin_edges", "histogramdd",
+}
+
+# never call these in an audit loop: they switch global modes, touch
+# files/devices, or consume the argument destructively
+DENYLIST_SUBSTRINGS = (
+    "static", "grad", "save", "load", "seed", "set_", "device",
+    "flags", "jit", "compile", "summary", "flops", "backward",
+    "assign_", "hub", "iinfo", "finfo", "dtype",
+)
+
+
+def _candidates():
+    out = []
+    for name in sorted(dir(paddle)):
+        if name.startswith("_"):
+            continue
+        if any(s in name for s in DENYLIST_SUBSTRINGS):
+            continue
+        fn = getattr(paddle, name)
+        if not callable(fn) or inspect.isclass(fn):
+            continue
+        out.append((name, fn))
+    return out
+
+
+def test_no_silent_tape_drops():
+    base = np.abs(np.random.default_rng(0).normal(size=(4, 4))) \
+        .astype(np.float32) + 0.5
+    flagged = []
+    for name, fn in _candidates():
+        x = paddle.to_tensor(base.copy(), stop_gradient=False)
+        grad_mode = ag._state.enabled
+        recorder = ag._op_recorder
+        try:
+            out = fn(x)
+        except Exception:
+            continue
+        finally:
+            # a mode-switching callable that slipped the denylist must
+            # not poison the rest of the sweep
+            ag._state.enabled = grad_mode
+            ag._op_recorder = recorder
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for o in outs:
+            if not isinstance(o, Tensor):
+                continue
+            if not np.issubdtype(o.dtype, np.floating):
+                continue
+            if o.stop_gradient and name not in KNOWN_DETACHED:
+                flagged.append(name)
+            break
+    assert not flagged, (
+        f"float outputs silently detached from the autograd tape: "
+        f"{sorted(set(flagged))} — dispatch through apply_op, or add "
+        f"to KNOWN_DETACHED with a justification")
