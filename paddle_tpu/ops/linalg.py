@@ -138,10 +138,11 @@ def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    yd = y._data if isinstance(y, Tensor) else jnp.asarray(y)
-    sol, res, rank, sv = jnp.linalg.lstsq(xd, yd, rcond=rcond)
-    return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
+    # on the tape: jax's SVD-based lstsq is differentiable in the
+    # solution/singular values (rank stays int/no-grad)
+    return apply_op(
+        lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+        _tt(x), _tt(y), op_name="lstsq")
 
 
 def _tt(x):

@@ -25,7 +25,8 @@ KNOWN_DETACHED = {
     "uniform", "zeros", "zeros_like", "to_tensor", "clone_detached",
     "poisson", "multinomial", "rand_like",
     # value-independent / zero-derivative by contract
-    "sign", "round", "floor", "ceil", "trunc",
+    "sign", "round", "floor", "ceil", "trunc", "floor_divide",
+    "floor_mod",
     # set-returning (membership, not a smooth map)
     "unique", "unique_consecutive",
     # data-dependent binning: edges/counts are piecewise-constant in the
@@ -56,16 +57,19 @@ def _candidates():
     return out
 
 
-def test_no_silent_tape_drops():
+def _sweep(arity):
     base = np.abs(np.random.default_rng(0).normal(size=(4, 4))) \
         .astype(np.float32) + 0.5
     flagged = []
     for name, fn in _candidates():
-        x = paddle.to_tensor(base.copy(), stop_gradient=False)
+        if name.endswith("_"):
+            continue  # in-place variants mutate their argument
+        args = [paddle.to_tensor(base.copy(), stop_gradient=False)
+                for _ in range(arity)]
         grad_mode = ag._state.enabled
         recorder = ag._op_recorder
         try:
-            out = fn(x)
+            out = fn(*args)
         except Exception:
             continue
         finally:
@@ -82,7 +86,13 @@ def test_no_silent_tape_drops():
             if o.stop_gradient and name not in KNOWN_DETACHED:
                 flagged.append(name)
             break
+    return sorted(set(flagged))
+
+
+@pytest.mark.parametrize("arity", [1, 2])
+def test_no_silent_tape_drops(arity):
+    flagged = _sweep(arity)
     assert not flagged, (
-        f"float outputs silently detached from the autograd tape: "
-        f"{sorted(set(flagged))} — dispatch through apply_op, or add "
-        f"to KNOWN_DETACHED with a justification")
+        f"float outputs silently detached from the autograd tape "
+        f"(arity {arity}): {flagged} — dispatch through apply_op, or "
+        f"add to KNOWN_DETACHED with a justification")
