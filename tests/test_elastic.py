@@ -153,12 +153,28 @@ class TestLauncherElastic:
             time.sleep(1.0)
             peer = ElasticManager(store, "1", ttl=1.5, interval=0.4)
             peer.start()
-            time.sleep(3.0)   # scale-out detected -> restart with world=2
-            peer.leave()      # scale-in -> restart with world=1
-            time.sleep(4.0)
-            log = (tmp_path / "log" / "workerlog.0").read_text()
-            assert "WORLD 1 RANK 0" in log, log
+
+            def wait_log(pred, timeout=30.0):
+                # poll with a deadline: fixed sleeps flaked under loaded
+                # CI (parallel suites starve the watcher loop)
+                path = tmp_path / "log" / "workerlog.0"
+                deadline = time.time() + timeout
+                log = ""
+                while time.time() < deadline:
+                    if path.exists():
+                        log = path.read_text()
+                        if pred(log):
+                            return log
+                    time.sleep(0.3)
+                return log
+
+            log = wait_log(lambda l: "WORLD 2 RANK 0" in l)
             assert "WORLD 2 RANK 0" in log, log
+            peer.leave()      # scale-in -> restart with world=1
+            log = wait_log(
+                lambda l: "WORLD 2" in l and "WORLD 1" in l
+                and l.rindex("WORLD 1") > l.index("WORLD 2"))
+            assert "WORLD 1 RANK 0" in log, log
             # after scale-in the world returns to 1 (appears again)
             assert log.rindex("WORLD 1") > log.index("WORLD 2"), log
         finally:
