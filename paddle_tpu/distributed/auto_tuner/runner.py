@@ -51,10 +51,28 @@ def build_trial_runner(make_model: Callable[[], object],
 
     def trial(config: Dict) -> float:
         degrees = [int(config.get(f"{a}_degree", 1)) for a in mesh_axes]
-        n = int(np.prod(degrees))
+        pp = int(config.get("pp_degree", 1))
+        n = int(np.prod(degrees)) * pp
         if n > len(devs):
             raise ValueError(
                 f"config needs {n} devices, have {len(devs)}")
+        if pp > 1:
+            # pipeline candidate (planner v2): time the compiled-GPipe
+            # executor the Engine would realize it with
+            from ..auto_parallel.engine_pp import PipelineTrainStep
+            model = make_model()
+            pstep = PipelineTrainStep(model, loss_fn,
+                                      make_optimizer(model), pp=pp,
+                                      n_devices=n)
+            batch = make_batch(config)
+            float(pstep(*batch))   # compile + warm
+            t0 = time.perf_counter()
+            loss = None
+            for _ in range(steps):
+                loss = pstep(*batch)
+            float(loss)
+            dt = (time.perf_counter() - t0) / steps
+            return int(np.asarray(batch[0]).shape[0]) / dt
         mesh = ProcessMesh(
             np.arange(n).reshape(degrees), dim_names=list(mesh_axes))
         model = make_model()
