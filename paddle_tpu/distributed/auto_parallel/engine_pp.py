@@ -78,7 +78,7 @@ class PipelineTrainStep:
 
     def __init__(self, model, loss_fn: Callable, optimizer, pp: int,
                  n_devices: Optional[int] = None, micro_batches=None,
-                 remat="dots"):
+                 remat="dots", devices=None):
         from jax.sharding import Mesh
 
         from ...jit.api import functionalize
@@ -99,12 +99,14 @@ class PipelineTrainStep:
         self.optimizer = optimizer
         self.pp = pp
         self.micro = micro_batches or 2 * pp
-        n = n_devices or len(jax.devices())
+        pool = list(devices) if devices is not None else jax.devices()
+        n = n_devices or len(pool)
         if n % pp:
             raise ValueError(f"{n} devices not divisible by pp={pp}")
+        if n > len(pool):
+            raise ValueError(f"need {n} devices, have {len(pool)}")
         self.mesh = Mesh(
-            np.array(jax.devices()[:n]).reshape(n // pp, pp),
-            ("dp", "pp"))
+            np.array(pool[:n]).reshape(n // pp, pp), ("dp", "pp"))
 
         applies = [functionalize(b) for b in fam]
         self._stage_apply = applies[0][0]
@@ -191,6 +193,30 @@ class PipelineTrainStep:
             return loss, new_params, new_state
 
         self._jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def estimate_peak_bytes(self, batch, *labels) -> int:
+        """Global-shape peak of the step via the static jaxpr-liveness
+        estimator (compile-free; same model the Engine's memory-aware
+        recompute uses) — the auto-tuner's pre-execution OOM gate for
+        pipeline trials."""
+        from .mem_estimator import estimate_peak_bytes
+        if self._jitted is None:
+            self._build()
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+
+        def sds(a):
+            a = np.asarray(a) if not hasattr(a, "dtype") else a
+            return jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+
+        raw = [b._data if isinstance(b, Tensor) else np.asarray(b)
+               for b in (batch, *labels)]
+        traced = self._jitted.trace(
+            jax.tree.map(sds, self._params),
+            jax.tree.map(sds, self._opt_state),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            sds(raw[0]), tuple(sds(r) for r in raw[1:]))
+        return int(estimate_peak_bytes(traced.jaxpr))
 
     def _write_back(self):
         """Push the step's param pytree into the live model's Tensors."""

@@ -58,18 +58,55 @@ def build_trial_runner(make_model: Callable[[], object],
                 f"config needs {n} devices, have {len(devs)}")
         if pp > 1:
             # pipeline candidate (planner v2): time the compiled-GPipe
-            # executor the Engine would realize it with
+            # executor the Engine would realize it with. Same
+            # realizability contract as the Engine — a config this
+            # executor can't faithfully run records as a FAILED trial
+            # rather than a mislabeled measurement.
+            bad = [a for a in mesh_axes
+                   if a != "dp" and int(config.get(f"{a}_degree", 1)) > 1]
+            if bad:
+                raise ValueError(
+                    f"pipeline trials run non-pp axes as pure data "
+                    f"parallel; config also asks for {bad} — "
+                    f"unrealizable, recording as failed")
+            sched = config.get("pp_schedule", "gpipe")
+            if sched != "gpipe":
+                raise ValueError(
+                    f"pipeline executor runs the GPipe schedule; "
+                    f"cannot measure {sched!r} — price the planner "
+                    f"with schedules=('gpipe',)")
             from ..auto_parallel.engine_pp import PipelineTrainStep
             model = make_model()
             pstep = PipelineTrainStep(model, loss_fn,
                                       make_optimizer(model), pp=pp,
-                                      n_devices=n)
+                                      n_devices=n, devices=devs[:n])
             batch = make_batch(config)
-            float(pstep(*batch))   # compile + warm
+            if hbm_bytes is not None:
+                est = pstep.estimate_peak_bytes(*batch)
+                if est > hbm_bytes:
+                    raise MemoryBudgetExceeded(
+                        f"estimated peak {est / 1e6:.1f}MB exceeds "
+                        f"budget {hbm_bytes / 1e6:.1f}MB "
+                        f"(jaxpr-liveness model; pipeline trial)")
+            # time the bare jitted step (threaded donated state), the
+            # same footing as the flat branch — __call__'s per-step
+            # host upload + write-back would bias the comparison
+            import jax.numpy as jnp
+            pstep._build()
+            pstate = pstep._init_opt_state()
+            pparams = pstep._params
+            raw = [jnp.asarray(np.asarray(b)) for b in batch]
+            lr = jnp.float32(0.0)
+
+            def pone(params, state):
+                return pstep._jitted(params, state, lr, raw[0],
+                                     tuple(raw[1:]))
+
+            loss, pparams, pstate = pone(pparams, pstate)
+            float(loss)
             t0 = time.perf_counter()
-            loss = None
             for _ in range(steps):
-                loss = pstep(*batch)
+                loss, pparams, pstate = pone(pparams, pstate)
             float(loss)
             dt = (time.perf_counter() - t0) / steps
             return int(np.asarray(batch[0]).shape[0]) / dt

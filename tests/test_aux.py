@@ -205,3 +205,25 @@ def test_trial_runner_times_pipeline_configs():
     piped = trial({"dp_degree": 4, "pp_degree": 2,
                    "pp_schedule": "gpipe"})
     assert flat > 0 and piped > 0
+    # unrealizable configs record as FAILED trials, not mislabeled
+    # measurements: pp with tensor parallelism, or a schedule the
+    # GPipe executor can't deliver
+    import pytest as _pytest
+    trial_mp = build_trial_runner(
+        make_model, shard_model, make_optimizer,
+        lambda out, label: ((out - label) ** 2).mean(), make_batch,
+        mesh_axes=("dp", "mp"), steps=1)
+    with _pytest.raises(ValueError, match="unrealizable"):
+        trial_mp({"dp_degree": 2, "mp_degree": 2, "pp_degree": 2})
+    with _pytest.raises(ValueError, match="GPipe"):
+        trial({"dp_degree": 4, "pp_degree": 2, "pp_schedule": "zb_h1"})
+    # pre-execution OOM gate holds for pipeline trials too
+    from paddle_tpu.distributed.auto_tuner.runner import \
+        MemoryBudgetExceeded
+    tight = build_trial_runner(
+        make_model, shard_model, make_optimizer,
+        lambda out, label: ((out - label) ** 2).mean(), make_batch,
+        mesh_axes=("dp",), steps=1, hbm_bytes=1)
+    with _pytest.raises(MemoryBudgetExceeded):
+        tight({"dp_degree": 4, "pp_degree": 2,
+               "pp_schedule": "gpipe"})
