@@ -44,16 +44,24 @@ DENYLIST_SUBSTRINGS = (
 
 
 def _candidates():
+    import paddle_tpu.linalg as linalg_ns
+    import paddle_tpu.nn.functional as F_ns
     out = []
-    for name in sorted(dir(paddle)):
-        if name.startswith("_"):
-            continue
-        if any(s in name for s in DENYLIST_SUBSTRINGS):
-            continue
-        fn = getattr(paddle, name)
-        if not callable(fn) or inspect.isclass(fn):
-            continue
-        out.append((name, fn))
+    seen = set()
+    for prefix, ns in (("", paddle), ("linalg.", linalg_ns),
+                       ("F.", F_ns)):
+        for name in sorted(dir(ns)):
+            if name.startswith("_"):
+                continue
+            if any(s in name for s in DENYLIST_SUBSTRINGS):
+                continue
+            fn = getattr(ns, name)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            if id(fn) in seen:  # re-exports audit once
+                continue
+            seen.add(id(fn))
+            out.append((prefix + name, fn))
     return out
 
 
@@ -83,7 +91,8 @@ def _sweep(arity):
                 continue
             if not np.issubdtype(o.dtype, np.floating):
                 continue
-            if o.stop_gradient and name not in KNOWN_DETACHED:
+            bare = name.split(".", 1)[-1]
+            if o.stop_gradient and bare not in KNOWN_DETACHED:
                 flagged.append(name)
             break
     return sorted(set(flagged))
