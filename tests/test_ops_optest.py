@@ -698,3 +698,129 @@ class TestKronOp(OpTest):
         r = _rng()
         return {"x": r.normal(size=(2, 3)).astype(np.float32),
                 "y": r.normal(size=(3, 2)).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# round-4 third batch: shape manipulation, fused linear forms,
+# normalization, pixel ops
+# ---------------------------------------------------------------------------
+
+
+class TestSqueezeUnsqueezeOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.unsqueeze(
+        paddle.squeeze(x, axis=1), axis=0))
+    ref_fn = staticmethod(lambda x: np.expand_dims(np.squeeze(x, 1), 0))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 1, 4)).astype(np.float32)}
+
+
+class TestTileOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.tile(x, [2, 3]))
+    ref_fn = staticmethod(lambda x: np.tile(x, (2, 3)))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(2, 4)).astype(np.float32)}
+
+
+class TestChunkFirstOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.chunk(x, 3, axis=1)[0])
+    ref_fn = staticmethod(lambda x: x[:, :2])
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 6)).astype(np.float32)}
+
+
+class TestAddmmOp(OpTest):
+    op_fn = staticmethod(lambda inp, a, b: paddle.addmm(
+        inp, a, b, beta=0.5, alpha=2.0))
+    ref_fn = staticmethod(lambda inp, a, b: 0.5 * inp + 2.0 * (a @ b))
+
+    def inputs(self):
+        r = _rng()
+        return {"inp": r.normal(size=(3, 5)).astype(np.float32),
+                "a": r.normal(size=(3, 4)).astype(np.float32),
+                "b": r.normal(size=(4, 5)).astype(np.float32)}
+
+
+class TestPutAlongAxisOp(OpTest):
+    op_fn = staticmethod(lambda x, idx, v: paddle.put_along_axis(
+        x, idx, v, axis=1))
+
+    @staticmethod
+    def ref_fn(x, idx, v):
+        out = x.copy()
+        np.put_along_axis(out, idx, v, axis=1)
+        return out
+
+    def inputs(self):
+        # seeded indices have no within-row duplicates, so the
+        # scatter-overwrite gradient (zero at overwritten x positions,
+        # pass-through for v) is FD-checkable
+        r = _rng()
+        return {"x": r.normal(size=(3, 5)).astype(np.float32),
+                "idx": r.integers(0, 5, (3, 2)).astype(np.int64),
+                "v": r.normal(size=(3, 2)).astype(np.float32)}
+
+
+class TestInstanceNormOp(OpTest):
+    op_fn = staticmethod(lambda x: F.instance_norm(x))
+
+    @staticmethod
+    def ref_fn(x):
+        mu = x.mean(axis=(2, 3), keepdims=True)
+        var = x.var(axis=(2, 3), keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(2, 3, 4, 4))
+                .astype(np.float32)}
+
+
+class TestHardswishOp(OpTest):
+    op_fn = staticmethod(F.hardswish)
+    ref_fn = staticmethod(
+        lambda x: x * np.clip(x + 3, 0, 6) / 6)
+    # seeded samples all sit > grad_eps from the ±3 kinks, so central
+    # differences are well-defined
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(4, 5)).astype(np.float32) * 3}
+
+
+class TestMishOp(OpTest):
+    op_fn = staticmethod(F.mish)
+
+    @staticmethod
+    def ref_fn(x):
+        sp = np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+        return x * np.tanh(sp)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(4, 5)).astype(np.float32)}
+
+
+class TestPixelShuffleOp(OpTest):
+    op_fn = staticmethod(lambda x: F.pixel_shuffle(x, 2))
+
+    @staticmethod
+    def ref_fn(x):
+        n, c, h, w = x.shape
+        oc = c // 4
+        y = x.reshape(n, oc, 2, 2, h, w)
+        y = y.transpose(0, 1, 4, 2, 5, 3)
+        return y.reshape(n, oc, h * 2, w * 2)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(1, 8, 3, 3))
+                .astype(np.float32)}
+
+
+class TestEinsumContractionOp(OpTest):
+    op_fn = staticmethod(lambda x, y: paddle.einsum("ij,jk->ik", x, y))
+    ref_fn = staticmethod(lambda x, y: np.einsum("ij,jk->ik", x, y))
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 4)).astype(np.float32),
+                "y": r.normal(size=(4, 5)).astype(np.float32)}
