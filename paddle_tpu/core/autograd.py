@@ -346,8 +346,44 @@ def _fast_pair(fn, kwargs, datas, diff_idx):
     return pair, tuple(dyn_idx), cache, key
 
 
-def _mark_nojit(cache, key):
-    cache[key] = _NOJIT
+def _mark_nojit(cache, key, exc=None):
+    """Pin (fn, config) to the plain eager path — but only for errors
+    that prove the fn can't trace (host-side numpy, value-dependent
+    control flow). A transient runtime failure (e.g. RESOURCE_EXHAUSTED
+    during the one-time compile under memory pressure) must NOT
+    permanently demote the op to the ~1.5ms eager path: evict the cache
+    entry so the next dispatch retries the jit, bounded to a few
+    attempts so a persistently failing config still settles to eager."""
+    msg = "" if exc is None else str(exc)
+    transient = ("RESOURCE_EXHAUSTED" in msg or "OUT_OF_MEMORY" in msg
+                 or "out of memory" in msg)
+    # retry counters live in ONE nested dict so bookkeeping can never
+    # crowd the len(cache) gate that caps new pair builds in _fast_pair
+    rc = cache.get("_retry_counts")
+    if not transient:
+        if rc:
+            rc.pop(key, None)  # settled: drop the bookkeeping slot
+        cache[key] = _NOJIT
+        return
+    if rc is None:
+        rc = cache.setdefault("_retry_counts", {})
+    retries = rc.get(key, 0)
+    if retries >= 3:
+        rc.pop(key, None)
+        cache[key] = _NOJIT
+        return
+    rc[key] = retries + 1
+    pair = cache.get(key)
+    if isinstance(pair, tuple) and pair[2].get("ever_ok"):
+        # the pair has executed successfully at least once — the
+        # compile is fine, only this execution hit resource pressure.
+        # Keep the compiled executable across the WHOLE retry budget
+        # (re-tracing under the same pressure would cost hundreds of
+        # ms for nothing); a later success re-confirms it (clearing
+        # the counter via state), consecutive failures settle above.
+        pair[2]["state"] = 0
+        return
+    cache.pop(key, None)  # failed during initial compile: rebuild
 
 
 # When paddle_tpu.static is recording (enable_static / program_guard), this
@@ -412,12 +448,21 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
             try:
                 outs = jfwd(*(datas[i] for i in dyn_idx))
                 multi = meta["multi"]
+                if meta.get("state") != 1:
+                    # first success (or first after a transient retry):
+                    # confirm the pair and clear the failure counter
+                    meta["state"] = 1
+                    meta["ever_ok"] = True
+                    rc = cache.get("_retry_counts")
+                    if rc:
+                        rc.pop(ckey, None)
             except FloatingPointError:
                 raise
-            except Exception:
+            except Exception as e:
                 # fn isn't jittable here (host-side numpy, value-dependent
-                # control flow): run it eagerly from now on
-                _mark_nojit(cache, ckey)
+                # control flow): run it eagerly from now on — unless the
+                # failure was transient (resource), which retries
+                _mark_nojit(cache, ckey, e)
                 outs = None
         if outs is None:
             out = fn(*datas, **kwargs)
@@ -439,10 +484,16 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
         try:
             outs = jfwd(*dyn_args)
             multi = meta["multi"]
+            if meta.get("state") != 1:
+                meta["state"] = 1
+                meta["ever_ok"] = True
+                rc = cache.get("_retry_counts")
+                if rc:
+                    rc.pop(ckey, None)
         except FloatingPointError:
             raise
-        except Exception:
-            _mark_nojit(cache, ckey)
+        except Exception as e:
+            _mark_nojit(cache, ckey, e)
             outs = None
         else:
             def vjp_fn(cts, _dyn=dyn_args, _jb=jbwd):

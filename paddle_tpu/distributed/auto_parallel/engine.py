@@ -288,29 +288,25 @@ class Engine:
         planner = Planner(n, cluster=cluster, max_mp=max_mp,
                           max_pp=max_pp,
                           schedules=("gpipe",) if max_pp > 1 else None)
+        def realizable(c):
+            # v1 pipeline realization runs the non-pp axes as pure
+            # data parallel (a pp plan that also assumed fsdp/mp
+            # sharding would claim memory the executor can't
+            # deliver), and the block family must split evenly
+            # across the stages
+            return c.pp == 1 or (c.fsdp == 1 and c.mp == 1
+                                 and fam_len % c.pp == 0)
+
+        # realizability filtering lives in Planner.plan (the single home
+        # of the contract) so the analytic and measured paths can never
+        # diverge; plan() ranks EVERY feasible candidate before the cut,
+        # so a realizable pp=1 plan below the cheapest-16 is still found
         if trial_fn is not None:
-            best = planner.plan_measured(prof, trial_fn)
+            best = planner.plan_measured(prof, trial_fn,
+                                         realizable_fn=realizable)
         else:
-            cands = planner.plan(prof, top_k=16)
-
-            def realizable(c):
-                # v1 pipeline realization runs the non-pp axes as pure
-                # data parallel (a pp plan that also assumed fsdp/mp
-                # sharding would claim memory the executor can't
-                # deliver), and the block family must split evenly
-                # across the stages
-                return c.pp == 1 or (c.fsdp == 1 and c.mp == 1
-                                     and fam_len % c.pp == 0)
-
-            best = next((c for c in cands if realizable(c)), None)
-            if best is None:
-                raise ValueError(
-                    "no realizable parallel config: every feasible "
-                    "candidate needs shardings the pipeline executor "
-                    "can't deliver (pp with fsdp/mp, or pp not "
-                    f"dividing the {fam_len}-block family) — raise "
-                    "HBM, shrink the model, or provide a mesh "
-                    "explicitly")
+            best = planner.plan(prof, top_k=1,
+                                realizable_fn=realizable)[0]
         self.plan_choice = best
         if best.pp > 1:
             # pipeline realization builds its own ("dp", "pp") mesh in

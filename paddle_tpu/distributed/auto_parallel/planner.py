@@ -32,6 +32,7 @@ v5e and is overridable — the analog of static/cluster.py.
 from __future__ import annotations
 
 import functools
+import sys
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
@@ -205,6 +206,17 @@ class Planner:
                                              pp=pp))
         return out
 
+    def _pick_schedule(self, pp: int, micro: int):
+        """Best executable schedule for (pp, micro): replay 1F1B/ZB-H1
+        through the repo's own simulator (the executable schedules in
+        fleet/pipeline_zero_bubble.py); GPipe fill-drain closed form
+        is (pp-1) idle slots around micro working slots per stage."""
+        f1b, zb = _bubble_fractions(pp, micro)
+        gp = (pp - 1) / (micro + pp - 1)
+        options = {"1f1b": f1b, "zb_h1": zb, "gpipe": gp}
+        return min(((s, options[s]) for s in self.schedules
+                    if s in options), key=lambda kv: kv[1])
+
     def price(self, cand: PlanCandidate, prof: ModelProfile
               ) -> PlanCandidate:
         c = self.cluster
@@ -225,7 +237,19 @@ class Planner:
         ckpt = ckpt_all / (cand.dp * cand.fsdp)
         live = act_live / self.n
         if cand.pp > 1:
-            in_flight = min(cand.pp, micro)
+            # Pick the schedule FIRST (bubble replay needs only pp and
+            # micro) so memory is priced with the schedule that will
+            # actually run: 1F1B/ZB cap live checkpoints at the stage
+            # depth, but GPipe's fill-drain holds every micro-batch's
+            # stage checkpoints until backward starts — pricing a
+            # gpipe-executed plan with min(pp, micro) under-counts ~2x
+            # and the HBM prune admits plans the executor OOMs on.
+            cand.schedule, cand.bubble_fraction = self._pick_schedule(
+                cand.pp, micro)
+            if cand.schedule == "gpipe":
+                in_flight = micro
+            else:
+                in_flight = min(cand.pp, micro)
             ckpt = ckpt * in_flight / (micro * cand.pp)
             # the pipeline computes ONE micro-batch at a time per stage,
             # so the live working set shrinks with the micro count
@@ -244,18 +268,9 @@ class Planner:
         mp_eff = min(1.0, width / c.mp_min_width)
         t_compute = prof.flops_per_step / self.n / \
             (c.chip_flops * c.mfu_ceiling * mp_eff)
-        # -- pipeline bubble: replay the candidate's schedules through
-        # the repo's own simulator and take the better of 1F1B / ZB-H1
-        # (the executable schedules in fleet/pipeline_zero_bubble.py)
+        # -- pipeline bubble: schedule + fraction were picked in the
+        # memory pass above (so memory matches the executed schedule)
         if cand.pp > 1:
-            f1b, zb = _bubble_fractions(cand.pp, micro)
-            # GPipe fill-drain closed form: (pp-1) idle slots around
-            # micro working slots per stage
-            gp = (cand.pp - 1) / (micro + cand.pp - 1)
-            options = {"1f1b": f1b, "zb_h1": zb, "gpipe": gp}
-            cand.schedule, cand.bubble_fraction = min(
-                ((s, options[s]) for s in self.schedules
-                 if s in options), key=lambda kv: kv[1])
             t_compute = t_compute / max(1.0 - cand.bubble_fraction, 1e-3)
         # -- communication per step (ring costs over ICI):
         bw = c.ici_bandwidth
@@ -292,8 +307,14 @@ class Planner:
                               t_lat)
         return cand
 
-    def plan(self, prof: ModelProfile, top_k: int = 1
+    def plan(self, prof: ModelProfile, top_k: int = 1,
+             realizable_fn: Optional[Callable] = None
              ) -> List[PlanCandidate]:
+        """Rank feasible candidates by estimated step time.
+        ``realizable_fn`` additionally prunes configs the caller's
+        executor cannot run (e.g. pp plans whose block family doesn't
+        split) — the single home of the realizability contract, shared
+        by the Engine's analytic path and plan_measured."""
         priced = [self.price(c, prof) for c in self.candidates()]
         feas = [c for c in priced if c.feasible]
         if not feas:
@@ -303,16 +324,32 @@ class Planner:
             raise ValueError(
                 f"no feasible parallel config for {self.n} devices "
                 f"({detail}) — add devices or shrink the model/batch")
+        if realizable_fn is not None:
+            feas = [c for c in feas if realizable_fn(c)]
+            if not feas:
+                raise ValueError(
+                    "no realizable parallel config: every feasible "
+                    "candidate needs shardings the caller's executor "
+                    "can't deliver (pp with fsdp/mp, or pp not dividing "
+                    "the block family) — raise HBM, shrink the model, "
+                    "or provide a mesh explicitly")
         feas.sort(key=lambda c: c.est_step_time)
         return feas[:top_k]
 
     def plan_measured(self, prof: ModelProfile, trial_fn: Callable,
-                      top_k: int = 3) -> PlanCandidate:
+                      top_k: int = 3,
+                      realizable_fn: Optional[Callable] = None
+                      ) -> PlanCandidate:
         """Time the analytic top-k with ``trial_fn(config_dict) ->
         items/s`` (build_trial_runner's contract); failures (OOM et al)
-        are recorded and skipped like the reference's failed trials."""
+        are recorded and skipped like the reference's failed trials.
+        ``realizable_fn`` prunes candidates the caller's executor cannot
+        run BEFORE they occupy trial slots (otherwise 3 unrealizable pp
+        plans would exhaust the trials while a realizable pp=1 plan sits
+        just below the cut)."""
+        cands = self.plan(prof, top_k=top_k, realizable_fn=realizable_fn)
         best = None
-        for cand in self.plan(prof, top_k=top_k):
+        for cand in cands:
             cfg = {"dp_degree": cand.dp, "fsdp_degree": cand.fsdp,
                    "mp_degree": cand.mp}
             if cand.pp > 1:

@@ -332,11 +332,33 @@ class _MicroBatcher:
                     batch.append(self._q.get(timeout=remaining))
                 except queue.Empty:
                     break
+            # No exception may kill this singleton daemon thread — that
+            # would hang every subsequent serve() request forever. _sig
+            # failures (malformed inputs) are isolated per REQUEST so
+            # one bad client doesn't fail the well-formed requests that
+            # share its window; _run_group failures fail that group.
             groups: dict = {}
             for item in batch:
-                groups.setdefault(self._sig(item[0]), []).append(item)
+                try:
+                    groups.setdefault(self._sig(item[0]), []).append(item)
+                except Exception as e:
+                    self._fail(item, e)
             for sig, members in groups.items():
-                self._run_group(sig, members)
+                try:
+                    self._run_group(sig, members)
+                except Exception as e:
+                    for m in members:
+                        self._fail(m, e)
+
+    @staticmethod
+    def _fail(item, e):
+        # store the ORIGINAL exception (matching _run_single) so callers
+        # see the same type whether the failure hit the batched or the
+        # singleton path
+        _, done, slot = item
+        if not done.is_set():
+            slot.setdefault("error", e)
+            done.set()
 
     @staticmethod
     def _bucket(total: int) -> int:

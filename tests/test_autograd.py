@@ -312,3 +312,55 @@ class TestDoubleGradEdgeCases:
         c.backward(retain_graph=True)
         g, = paddle.grad(c, [a], create_graph=True)
         assert g.item() == pytest.approx(4.0)
+
+
+
+# Transient resource failures must not permanently demote an op to the
+# plain eager path (ADVICE r4: autograd.py fast-dispatch NOJIT pinning),
+# while trace-type errors settle immediately.
+
+def test_mark_nojit_trace_error_settles_immediately():
+    from paddle_tpu.core.autograd import _mark_nojit, _NOJIT
+    cache, key = {}, ((), (), ())
+    _mark_nojit(cache, key, TypeError("not traceable"))
+    assert cache[key] is _NOJIT
+
+
+def test_mark_nojit_transient_error_retries_then_settles():
+    from paddle_tpu.core.autograd import _mark_nojit, _NOJIT
+    cache, key = {}, ((), (), ())
+    oom = RuntimeError("RESOURCE_EXHAUSTED: out of HBM")
+    for _ in range(3):
+        cache[key] = ("f", "b", {})  # rebuilt, never succeeded
+        _mark_nojit(cache, key, oom)
+        assert key not in cache  # evicted -> retried next dispatch
+    cache[key] = ("f", "b", {})
+    _mark_nojit(cache, key, oom)  # 4th consecutive failure
+    assert cache[key] is _NOJIT
+    assert key not in cache.get("_retry_counts", {})
+
+
+def test_mark_nojit_confirmed_pair_survives_transient_failures():
+    from paddle_tpu.core.autograd import _mark_nojit, _NOJIT
+    cache, key = {}, ((), (), ())
+    # has executed successfully at least once
+    pair = ("f", "b", {"state": 1, "ever_ok": True})
+    cache[key] = pair
+    for _ in range(3):  # kept across the WHOLE retry budget
+        _mark_nojit(cache, key, RuntimeError("RESOURCE_EXHAUSTED"))
+        assert cache[key] is pair  # executable kept, no retrace
+    assert pair[2]["state"] == 0  # next success must re-confirm
+    _mark_nojit(cache, key, RuntimeError("RESOURCE_EXHAUSTED"))
+    assert cache[key] is _NOJIT  # 4th consecutive failure settles
+
+
+def test_mark_nojit_bookkeeping_does_not_crowd_pair_slots():
+    from paddle_tpu.core.autograd import _mark_nojit
+    cache = {}
+    oom = RuntimeError("RESOURCE_EXHAUSTED")
+    for i in range(40):
+        key = ((), (i,), ())
+        cache[key] = ("f", "b", {})
+        _mark_nojit(cache, key, oom)
+    # all counters share the single "_retry_counts" slot
+    assert len(cache) == 1
