@@ -11,7 +11,7 @@ from .process_mesh import (  # noqa: F401
 )
 from .api import (  # noqa: F401
     DistAttr, shard_tensor, dtensor_from_fn, reshard, shard_layer,
-    unshard_dtensor, placements_to_spec,
+    unshard_dtensor, placements_to_spec, shard_batch,
 )
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, all_reduce, all_gather,

@@ -25,6 +25,7 @@ from .process_mesh import ProcessMesh
 __all__ = [
     "DistAttr", "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
     "unshard_dtensor", "placements_to_spec", "shard_parameter",
+    "shard_batch",
 ]
 
 
@@ -91,6 +92,35 @@ def shard_tensor(data, mesh: ProcessMesh,
     out._dist_attr = DistAttr(mesh, placements)
     if isinstance(data, Tensor):
         out.name = data.name
+    return out
+
+
+def shard_batch(data, mesh: ProcessMesh,
+                placements: Optional[Sequence[Placement]] = None,
+                dtype=None) -> Tensor:
+    """Assemble each process's LOCAL batch shard into one global
+    DistTensor — the multi-controller data-feeding contract: every rank's
+    DataLoader yields only ITS OWN rows (the reference's
+    DistributedBatchSampler split, ref: python/paddle/io/dataloader —
+    each NCCL rank feeds its local batch), and the global array spanning
+    the mesh is assembled from those per-process pieces without any rank
+    ever holding the full batch.
+
+    Default placement shards dim 0 along the mesh's FIRST axis. On a
+    single controller this degenerates to shard_tensor (local == global).
+    """
+    import numpy as np
+    placements = _normalize_placements(
+        mesh, placements if placements is not None else [Shard(0)])
+    local = data._data if isinstance(data, Tensor) else data
+    local = np.asarray(local, dtype=dtype)
+    sharding = _named_sharding(mesh, placements, local.ndim)
+    if jax.process_count() == 1:
+        arr = jax.device_put(local, sharding)
+    else:
+        arr = jax.make_array_from_process_local_data(sharding, local)
+    out = Tensor(arr, stop_gradient=True)
+    out._dist_attr = DistAttr(mesh, placements)
     return out
 
 

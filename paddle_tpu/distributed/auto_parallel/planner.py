@@ -32,7 +32,6 @@ v5e and is overridable — the analog of static/cluster.py.
 from __future__ import annotations
 
 import functools
-import sys
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 

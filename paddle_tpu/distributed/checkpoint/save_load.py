@@ -46,54 +46,133 @@ def _process_id() -> int:
     return jax.process_index()
 
 
+def _barrier_if_multiprocess(process_group=None):
+    """Synchronize save phases across the SAVING group's controllers.
+    Without it the coordinator can merge metadata parts other ranks
+    haven't written yet (the rank-0 metadata race), and a fast rank
+    could return from save — and immediately load — before
+    metadata.json exists (writer collision with the previous save's
+    file). The caller's process_group is honored: barriering the whole
+    world from a subgroup save would hang on the non-participants."""
+    if jax.process_count() <= 1:
+        return
+    from ..collective import barrier
+    barrier(group=process_group)
+
+
+def _participants(process_group) -> List[int]:
+    """Process ids taking part in this save (the rank set whose
+    metadata parts the coordinator merges — stale parts from an earlier
+    larger-world save into the same path must NOT leak in)."""
+    ranks = getattr(process_group, "ranks", None)
+    if ranks:
+        return sorted(int(x) for x in ranks)
+    return list(range(jax.process_count()))
+
+
 def save_state_dict(state_dict: Dict, path: str,
                     process_group=None, coordinator_rank: int = 0):
     """ref: save_state_dict.py:145. Layout on disk:
     path/{key}__{shard_idx}.npy per local shard + path/metadata.json
-    (written by the coordinator; single-controller writes everything)."""
+    (written by the coordinator; single-controller writes everything).
+
+    Multi-controller contract (each process writes ONLY its addressable
+    shards): cross-rank dedup of replicated copies picks the
+    replica_id==0 shard — exactly one process on the mesh owns each
+    (key, offset) — matching the reference's dedup_tensor assignment of
+    replicated tensors to a single writer (ref: save_state_dict.py:117);
+    two barriers order shard-writes < metadata merge < return."""
     os.makedirs(path, exist_ok=True)
     meta = Metadata()
     rank = _process_id()
-    for key, value in _flatten(state_dict).items():
-        arr = value._data if isinstance(value, Tensor) else np.asarray(value)
-        entries = []
-        if isinstance(arr, jax.Array) and len(arr.sharding.device_set) > 1:
-            seen_offsets = set()
-            for i, shard in enumerate(arr.addressable_shards):
-                offset = tuple(s.start or 0 for s in shard.index) \
-                    if shard.index else ()
-                if offset in seen_offsets:
-                    continue  # dedup replicated shards (ref: :117)
-                seen_offsets.add(offset)
-                fname = f"{_safe(key)}__r{rank}s{i}.npy"
-                np.save(os.path.join(path, fname), np.asarray(shard.data))
-                entries.append(LocalTensorMetadata(
-                    offset, tuple(shard.data.shape), str(arr.dtype), fname))
-        else:
-            if rank == coordinator_rank:
-                fname = f"{_safe(key)}__full.npy"
-                np.save(os.path.join(path, fname), np.asarray(arr))
-                entries.append(LocalTensorMetadata(
-                    tuple(0 for _ in np.shape(arr)),
-                    tuple(np.shape(arr)), str(np.asarray(arr).dtype), fname))
-        if entries:
-            meta.state_dict_metadata[key] = entries
-    # merge metadata across processes via the filesystem (each process owns
-    # distinct keys' shard files; coordinator merges)
-    part = os.path.join(path, f"metadata_rank{rank}.pkl")
-    with open(part, "wb") as f:
-        pickle.dump(meta, f)
-    if rank == coordinator_rank:
-        merged = Metadata()
-        for fn in sorted(os.listdir(path)):
-            if fn.startswith("metadata_rank") and fn.endswith(".pkl"):
-                with open(os.path.join(path, fn), "rb") as f:
+    # A local failure (ENOSPC in a shard write, pickle error) must not
+    # strand the other ranks in the barriers below — capture, keep
+    # participating in every synchronization point, re-raise at the end.
+    err: Optional[BaseException] = None
+    marker = os.path.join(path, f"metadata_rank{rank}.failed")
+    try:
+        if os.path.exists(marker):
+            os.remove(marker)  # stale marker from an earlier save
+        for key, value in _flatten(state_dict).items():
+            arr = (value._data if isinstance(value, Tensor)
+                   else np.asarray(value))
+            entries = []
+            is_dist = isinstance(arr, jax.Array) and (
+                len(arr.sharding.device_set) > 1
+                or not arr.is_fully_addressable)
+            if is_dist:
+                seen_offsets = set()
+                for i, shard in enumerate(arr.addressable_shards):
+                    if shard.replica_id != 0:
+                        continue  # another device/process owns this copy
+                    offset = tuple(s.start or 0 for s in shard.index) \
+                        if shard.index else ()
+                    if offset in seen_offsets:
+                        continue  # dedup replicated shards (ref: :117)
+                    seen_offsets.add(offset)
+                    fname = f"{_safe(key)}__r{rank}s{i}.npy"
+                    np.save(os.path.join(path, fname),
+                            np.asarray(shard.data))
+                    entries.append(LocalTensorMetadata(
+                        offset, tuple(shard.data.shape), str(arr.dtype),
+                        fname))
+            else:
+                if rank == coordinator_rank:
+                    fname = f"{_safe(key)}__full.npy"
+                    np.save(os.path.join(path, fname), np.asarray(arr))
+                    entries.append(LocalTensorMetadata(
+                        tuple(0 for _ in np.shape(arr)),
+                        tuple(np.shape(arr)),
+                        str(np.asarray(arr).dtype), fname))
+            if entries:
+                meta.state_dict_metadata[key] = entries
+        # merge metadata across processes via the filesystem (each process
+        # owns distinct keys' shard files; coordinator merges)
+        part = os.path.join(path, f"metadata_rank{rank}.pkl")
+        with open(part + ".tmp", "wb") as f:
+            pickle.dump(meta, f)
+        os.replace(part + ".tmp", part)
+    except BaseException as e:  # noqa: BLE001 — re-raised below
+        err = e
+        try:  # tell the coordinator this rank's shards are incomplete
+            with open(marker, "w") as f:
+                f.write(f"{type(e).__name__}: {e}")
+        except OSError:
+            pass
+    _barrier_if_multiprocess(process_group)  # parts on disk before merge
+    if err is None and rank == coordinator_rank:
+        try:
+            failed = [r for r in _participants(process_group)
+                      if os.path.exists(
+                          os.path.join(path, f"metadata_rank{r}.failed"))]
+            if failed:
+                raise RuntimeError(
+                    f"checkpoint save failed on rank(s) {failed}; "
+                    f"metadata.json withheld (a partial checkpoint must "
+                    f"not look loadable)")
+            merged = Metadata()
+            # merge ONLY this save's participants: stale parts from an
+            # earlier larger-world save into the same path would mix
+            # old-topology shards into metadata.json
+            for r in _participants(process_group):
+                fn = os.path.join(path, f"metadata_rank{r}.pkl")
+                if not os.path.exists(fn):
+                    continue  # rank r had nothing to write
+                with open(fn, "rb") as f:
                     m = pickle.load(f)
                 for k, v in m.state_dict_metadata.items():
                     merged.state_dict_metadata.setdefault(k, []).extend(v)
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump({k: [vars(e) for e in v]
-                       for k, v in merged.state_dict_metadata.items()}, f)
+            tmp = os.path.join(path, "metadata.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump({k: [vars(e) for e in v]
+                           for k, v in merged.state_dict_metadata.items()},
+                          f)
+            os.replace(tmp, os.path.join(path, "metadata.json"))
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            err = e
+    _barrier_if_multiprocess(process_group)  # no early return
+    if err is not None:
+        raise err
 
 
 def load_state_dict(state_dict: Dict, path: str,

@@ -192,7 +192,27 @@ class DistTrainStep:
                 if arr.shape != param_arr.shape:
                     # scalar slots (beta pows) replicate over the mesh
                     sharding = NamedSharding(sharding.mesh, PartitionSpec())
-                arr = jax.device_put(arr, sharding)
+                if getattr(arr, "sharding", None) == sharding:
+                    pass  # already placed (the dist-checkpoint load
+                    # path fills slots with the param's own sharding);
+                    # re-putting a multi-controller global array would
+                    # be an unsupported cross-host transfer
+                elif (isinstance(arr, jax.Array)
+                      and not arr.is_fully_addressable):
+                    raise ValueError(
+                        f"optimizer slot {key!r} arrives as a "
+                        f"multi-process array with sharding "
+                        f"{arr.sharding} but the parameter needs "
+                        f"{sharding}; reshard it via dist checkpoint "
+                        f"load (host-side assembly) instead")
+                else:
+                    # a COMMITTED device array can't be device_put
+                    # across processes (pinned src placement); hop
+                    # through host — every process holds the full
+                    # value, so the put only writes local shards
+                    if isinstance(arr, jax.Array):
+                        arr = np.asarray(arr)
+                    arr = jax.device_put(arr, sharding)
             self._opt_state[pname][slot] = arr
         missing = [f"{p}#{s}" for p, slots in self._opt_state.items()
                    for s in slots if (p, s) not in covered]
