@@ -99,7 +99,7 @@ class GradNode:
                  kwargs=None, diff_idx=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # tuple of differentiable input Tensors
-        self.out_avals = out_avals    # ShapeDtypeStruct per output
+        self.out_avals = out_avals    # (shape, dtype) aval per output
         self.name = name
         # Retained for create_graph=True: re-running the op's forward under
         # the tape makes the backward step differentiable w.r.t. primals too
@@ -114,13 +114,37 @@ class GradNode:
         return f"GradNode({self.name})"
 
 
+class _Aval:
+    """Minimal (shape, dtype) aval for GradNode outputs — a
+    jax.ShapeDtypeStruct here costs ~5µs/op of checked-__setattr__ on
+    the eager hot path for two fields the backward ever reads."""
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = shape
+        self.dtype = dtype
+
+
 def _zeros_ct(aval):
     if jnp.issubdtype(aval.dtype, jnp.inexact):
         return jnp.zeros(aval.shape, aval.dtype)
     return np.zeros(aval.shape, jax.dtypes.float0)
 
 
+_diff_dtype_cache: Dict[Any, bool] = {}
+
+
 def _is_diff_dtype(x) -> bool:
+    # dtype-keyed cache: jnp.result_type costs ~10µs/call on the eager
+    # hot path; arrays expose .dtype directly and the distinct dtype
+    # population is tiny
+    dt = getattr(x, "dtype", None)
+    if dt is not None:
+        hit = _diff_dtype_cache.get(dt)
+        if hit is None:
+            hit = _diff_dtype_cache[dt] = bool(
+                jnp.issubdtype(dt, jnp.inexact))
+        return hit
     return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
 
 
@@ -147,15 +171,23 @@ def flush_nan_checks() -> None:
             f"(FLAGS_check_nan_inf is set)")
 
 
+_nan_flag = None     # resolved Flag objects (registry identity is
+_stride_flag = None  # stable) — avoids per-op registry lookups
+
+
 def _maybe_check_nan_inf(name: str, outs) -> None:
     """FLAGS_check_nan_inf per-op scan (ref: eager/nan_inf_utils.h:38 —
     CheckTensorHasNanOrInf after each ad_func). Only active in eager mode
     (concrete arrays); tracing skips it, matching the reference's
     dygraph-only check."""
-    from .flags import flag_value
-    if not flag_value("check_nan_inf"):
+    global _nan_flag, _stride_flag
+    if _nan_flag is None:
+        from .flags import _registry
+        _nan_flag = _registry["check_nan_inf"]
+        _stride_flag = _registry["check_nan_inf_stride"]
+    if not _nan_flag.value:
         return
-    stride = max(int(flag_value("check_nan_inf_stride") or 1), 1)
+    stride = max(int(_stride_flag.value or 1), 1)
     for i, o in enumerate(outs):
         if isinstance(o, jax.core.Tracer):
             return  # inside jit trace, skip (dygraph-only check)
@@ -230,18 +262,32 @@ _FAST_DISPATCH = os.environ.get(
 
 
 def _fn_pair_cache(fn):
+    # id-keyed first: jnp ufunc objects define __hash__/__eq__ that cost
+    # ~3µs per lookup on the hot path; ufuncs are module-level
+    # singletons so identity is the right key (the entry holds fn,
+    # keeping the id stable)
+    hit = _pair_cache_strong.get(id(fn))
+    if hit is not None:
+        return hit[1]
     try:
         d = _pair_cache_weak.get(fn)
         if d is None:
             d = {}
             _pair_cache_weak[fn] = d
-        return d
-    except TypeError:  # fn doesn't support weakrefs (e.g. jnp ufunc objs)
-        d = _pair_cache_strong.get(fn)
-        if d is None:
+        elif "_seen" in d:
+            # second+ dispatch of the same fn OBJECT: long-lived (a
+            # module fn or ufunc) — promote to the id-keyed cache so
+            # later dispatches skip fn.__hash__/__eq__ (jnp ufuncs
+            # spend ~3µs there per lookup). Bounded by the 1024-clear.
             if len(_pair_cache_strong) > 1024:
                 _pair_cache_strong.clear()
-            d = _pair_cache_strong.setdefault(fn, {})
+            _pair_cache_strong[id(fn)] = (fn, d)
+        return d
+    except TypeError:  # fn doesn't support weakrefs (e.g. jnp ufunc objs)
+        if len(_pair_cache_strong) > 1024:
+            _pair_cache_strong.clear()
+        d = {}
+        _pair_cache_strong[id(fn)] = (fn, d)
         return d
 
 
@@ -325,7 +371,8 @@ def _fast_pair(fn, kwargs, datas, diff_idx):
                 dyn_idx.append(i)
             else:
                 static_key.append((i, _freeze(d)))
-        key = (tuple(diff_idx), tuple(static_key), _freeze(kwargs))
+        key = (tuple(diff_idx), tuple(static_key),
+               () if not kwargs else _freeze(kwargs))
     except TypeError:
         return None
     cache = _fn_pair_cache(fn)
@@ -532,7 +579,7 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     for o in outs:
         _memory.track(o)
 
-    out_avals = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs)
+    out_avals = tuple(_Aval(o.shape, o.dtype) for o in outs)
     node = GradNode(vjp_fn, tuple(args[i] for i in diff_idx), out_avals, name,
                     fn=fn, datas=datas, kwargs=kwargs, diff_idx=diff_idx)
 

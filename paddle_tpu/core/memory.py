@@ -32,6 +32,7 @@ import weakref
 from typing import Dict, Optional
 
 import jax
+from jax.sharding import SingleDeviceSharding
 
 from .._native import lib as _native
 
@@ -47,8 +48,14 @@ _lock = threading.Lock()
 _py_stats: Dict[str, list] = {}
 
 
+_key_cache: Dict = {}
+
+
 def _key(device) -> str:
-    return f"{_ALLOC}.{device.platform}:{device.id}"
+    k = _key_cache.get(device)
+    if k is None:
+        k = _key_cache[device] = f"{_ALLOC}.{device.platform}:{device.id}"
+    return k
 
 
 def _update(key: str, delta: int) -> None:
@@ -134,7 +141,12 @@ def track(arr) -> None:
             return
         _tracked.add(buf_id)
     try:
-        per_device = list(_per_device_bytes(arr).items())
+        if type(arr.sharding) is SingleDeviceSharding:
+            # single-device fast path (the eager hot loop): no
+            # shard-shape math, one cached key lookup
+            per_device = [(_key(arr.device), arr.nbytes)]
+        else:
+            per_device = list(_per_device_bytes(arr).items())
     except Exception:
         with _lock:
             _tracked.discard(buf_id)

@@ -345,8 +345,11 @@ def gather_nd(x_spec, index_spec, index_depth=1):
     fixed = list(xs)
     for d in range(min(index_depth, len(fixed))):
         fixed[d] = None  # indexed dims: reshard to whole
+    # the coordinate-depth (last) dim of the index must be whole too —
+    # a shard holding half of every coordinate tuple gathers garbage
+    fixed_idx = P(*idx[:-1], None) if idx else index_spec
     out = idx[:-1] + fixed[index_depth:]
-    return (P(*fixed) if xs else x_spec, index_spec), P(*out)
+    return (P(*fixed) if xs else x_spec, fixed_idx), P(*out)
 
 
 @register_rule("scatter")
@@ -497,7 +500,11 @@ def expand_as(x_spec, y_spec=None, target_rank=None):
     elif target_rank is not None:
         out = [None] * target_rank
     else:
-        return (x_spec, y_spec), x_spec
+        raise ValueError(
+            "expand_as rule needs the target's spec or rank: returning "
+            "x's spec unchanged would shard the wrong dims after a "
+            "rank-growing broadcast (specs bind leading dims; "
+            "broadcasting aligns trailing) — fall back to GSPMD")
     off = len(out) - len(xs)
     for i, d in enumerate(xs):
         if d is not None:
@@ -669,10 +676,17 @@ def take_along_axis(x_spec, index_spec, axis=0):
     index is fine, each shard computes its own slice of the output.
     ref: spmd_rules/take_along_axis.cc."""
     xs = list(x_spec) if x_spec is not None else []
-    fixed = list(xs)
-    if fixed:
-        fixed[axis % len(fixed)] = None
-    return (P(*fixed) if xs else x_spec, index_spec), index_spec
+    idx = list(index_spec) if index_spec is not None else []
+    if not xs:
+        return (x_spec, index_spec), index_spec
+    ax = axis % len(xs)
+    # consistency: non-axis dims of x CO-SHARD with the index (each
+    # shard must hold exactly the x rows its index rows point into);
+    # the axis dim of x is whole; the output has the index's shape and
+    # sharding
+    fixed = [None if i == ax else (idx[i] if i < len(idx) else None)
+             for i in range(len(xs))]
+    return (P(*fixed), index_spec), index_spec
 
 
 @register_rule("roll")

@@ -88,3 +88,44 @@ def test_bn_uncentered_input_variance_stable():
     assert np.abs(y - ref).max() < 2e-2
     # running var must be ~1, not garbage
     np.testing.assert_allclose(rv.numpy(), 1.0, atol=0.2)
+
+
+def test_bn_uncentered_large_batch_sampled_repair():
+    """Cold-anchor repair with a STRIDED sample (batch > 8 so the
+    stride exceeds 1): hostile-mean data on the first training step
+    must still normalize within the sampled estimator's tolerance."""
+    rng = np.random.default_rng(1)
+    x = (rng.standard_normal((64, 16, 4, 4)) * 2.0 + 5000.0) \
+        .astype(np.float32)
+    rm = paddle.to_tensor(np.zeros(16, np.float32))
+    rv = paddle.to_tensor(np.ones(16, np.float32))
+    w = paddle.to_tensor(np.ones(16, np.float32))
+    b = paddle.to_tensor(np.zeros(16, np.float32))
+    y = F.batch_norm(paddle.to_tensor(x), rm, rv, w, b,
+                     training=True).numpy()
+    ref = (x - x.mean(axis=(0, 2, 3), keepdims=True)) / \
+        x.std(axis=(0, 2, 3), keepdims=True)
+    # sampled variance (1/8 of rows, ~sqrt(2/128)=12% rel var error):
+    # normalization must be statistically right, not exact — the
+    # failure mode being excluded is the naive form's 50%+ garbage
+    assert np.abs(y - ref).max() < 0.2 * np.abs(ref).max()
+    # running var: momentum EMA 0.9*1 + 0.1*var(~4) = ~1.3
+    np.testing.assert_allclose(rv.numpy(), 1.3, rtol=0.25)
+
+
+def test_bn_warm_anchor_exact_one_pass():
+    """Steady state: anchor (running mean) near the true mean -> the
+    fast one-pass variance is used and matches the two-pass reference
+    tightly even for means far from zero."""
+    rng = np.random.default_rng(2)
+    x = (rng.standard_normal((16, 8, 6, 6)) + 300.0).astype(np.float32)
+    m_true = x.mean(axis=(0, 2, 3))
+    rm = paddle.to_tensor((m_true + 0.5).astype(np.float32))  # warm
+    rv = paddle.to_tensor(np.ones(8, np.float32))
+    w = paddle.to_tensor(np.ones(8, np.float32))
+    b = paddle.to_tensor(np.zeros(8, np.float32))
+    y = F.batch_norm(paddle.to_tensor(x), rm, rv, w, b,
+                     training=True).numpy()
+    ref = (x - x.mean(axis=(0, 2, 3), keepdims=True)) / \
+        x.std(axis=(0, 2, 3), keepdims=True)
+    assert np.abs(y - ref).max() < 2e-3
