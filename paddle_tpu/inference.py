@@ -420,7 +420,9 @@ class _MicroBatcher:
 
 def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
           block: bool = True, max_batch: int = 32,
-          batch_window_ms: float = 2.0):
+          batch_window_ms: float = 2.0, generate: bool = False,
+          max_slots: int = 4, max_seq: int = 256, int8: bool = False,
+          eos_id=None):
     """Minimal predictor server (ref: the reference ships its predictor
     behind paddle_serving / the C API server loop; this is the
     batteries-included analog). Concurrent requests are micro-batched
@@ -430,6 +432,14 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     Protocol: POST /run with an .npz body holding arrays input_0..N;
     response is an .npz of output_0..M. GET /health returns 200.
     Returns the HTTPServer (started in a daemon thread) when block=False.
+
+    ``generate=True`` additionally serves POST /generate for causal-LM
+    artifacts: body is an .npz with ``input_ids`` [L] and scalar
+    ``max_new_tokens``; response is ``output_ids`` (the generated
+    continuation). Requests share the engine's fixed decode slots with
+    iteration-level continuous batching — a long generation never
+    blocks a short one (see serving.GenerationServer); ``int8=True``
+    runs the projections as real s8 matmuls.
     """
     import io
     import threading
@@ -438,6 +448,16 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     predictor = Predictor(Config(model_path))
     batcher = _MicroBatcher(predictor, max_batch=max_batch,
                             window_ms=batch_window_ms)
+    gen_server = None
+    if generate:
+        from .serving import GenerationServer, LlamaDecodeEngine
+        # reuse the predictor's already-loaded Layer (a second
+        # load_inference_model would hold the weights twice at startup)
+        model = predictor.model if predictor.model is not None \
+            else load_inference_model(model_path)
+        gen_server = GenerationServer(LlamaDecodeEngine(
+            model, max_slots=max_slots, max_seq=max_seq, int8=int8,
+            eos_id=eos_id))
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -453,14 +473,36 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
                 self.end_headers()
 
         def do_POST(self):
-            if self.path != "/run":
+            if self.path not in ("/run", "/generate"):
                 self.send_response(404)
                 self.end_headers()
+                return
+            if self.path == "/generate" and gen_server is None:
+                msg = b"serve(generate=True) not enabled"
+                self.send_response(404)
+                self.send_header("Content-Length", str(len(msg)))
+                self.end_headers()
+                self.wfile.write(msg)
                 return
             try:
                 n = int(self.headers.get("Content-Length", "0"))
                 data = np.load(io.BytesIO(self.rfile.read(n)),
                                allow_pickle=False)
+                if self.path == "/generate":
+                    ids = np.asarray(data["input_ids"]).reshape(-1)
+                    mnt = int(data["max_new_tokens"]) \
+                        if "max_new_tokens" in data else 32
+                    toks = gen_server.generate(ids, mnt)
+                    outs = [np.asarray(toks, np.int32)]
+                    buf = io.BytesIO()
+                    np.savez(buf, output_ids=outs[0])
+                    body = buf.getvalue()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/npz")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 inputs = [data[f"input_{i}"] for i in range(len(data))]
                 outs = batcher.run(inputs)
                 buf = io.BytesIO()
@@ -481,6 +523,7 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
 
     server = ThreadingHTTPServer((host, port), Handler)
     server.batcher = batcher  # introspection (tests, metrics)
+    server.gen_server = gen_server
     if block:
         server.serve_forever()
         return None
