@@ -256,6 +256,11 @@ class Engine:
                 else vars(self.strategy.auto))
         n = n_devices or auto.get("n_devices") or len(jax.devices())
         cluster = cluster if cluster is not None else auto.get("cluster")
+        if cluster is None:
+            # no manual spec: detect from the live runtime (device-kind
+            # table + PJRT memory stats; ref: static/cluster.py)
+            from .planner import detect_cluster
+            cluster = detect_cluster()
         trial_fn = trial_fn if trial_fn is not None \
             else auto.get("trial_fn")
         first = sample_batch[0] if isinstance(

