@@ -28,7 +28,63 @@ import numpy as np
 
 from ...core.tensor import Tensor
 
-__all__ = ["detect_pipeline_split", "PipelineTrainStep"]
+__all__ = ["detect_pipeline_split", "PipelineTrainStep",
+           "build_pipeline_model"]
+
+
+def build_pipeline_model(descs):
+    """Instantiate a fleet LayerDesc/SharedLayerDesc list into the
+    Sequential the compiled pipeline path consumes (ref: PipelineLayer's
+    build loop, pp_layers.py:257) — SharedLayerDescs with the same key
+    share ONE layer instance, so its parameters are the same Tensor
+    objects at every use site and PipelineTrainStep's tied-weight
+    detection wires the gradient merge."""
+    from ...nn.container import Sequential
+    from ...nn.layer import Layer
+    from ..fleet.pp_layers import LayerDesc, SharedLayerDesc
+
+    class _SharedUse(Layer):
+        """One use-site of a shared layer (optionally through its
+        forward_func, e.g. embedding-as-lm-head)."""
+
+        def __init__(self, inner, fwd=None):
+            super().__init__()
+            self.inner = inner
+            self._fwd = fwd
+
+        def forward(self, x):
+            if self._fwd is not None:
+                return self._fwd(self.inner, x)
+            return self.inner(x)
+
+    class _FnLayer(Layer):
+        """Plain-callable pipeline item (pp_layers.py:130 accepts
+        functions, e.g. a reshape between stages)."""
+
+        def __init__(self, fn):
+            super().__init__()
+            self._fn = fn
+
+        def forward(self, x):
+            return self._fn(x)
+
+    shared = {}
+    layers = []
+    for d in descs:
+        if isinstance(d, SharedLayerDesc):
+            if d.layer_name not in shared:
+                shared[d.layer_name] = d.build_layer()
+            layers.append(_SharedUse(shared[d.layer_name],
+                                     d.forward_func))
+        elif isinstance(d, LayerDesc):
+            layers.append(d.build_layer())
+        elif isinstance(d, Layer):
+            layers.append(d)
+        elif callable(d):
+            layers.append(_FnLayer(d))
+        else:
+            raise TypeError(f"bad pipeline item {d!r}")
+    return Sequential(*layers)
 
 
 def _block_signature(layer):
@@ -134,6 +190,50 @@ class PipelineTrainStep:
                 raise ValueError("post-stage buffers unsupported (v1)")
             self._post_apply, params["post"] = a, p0
             self._post_tensors = dict(seq.named_parameters())
+        # -- tied weights (SharedLayerDesc semantics, ref:
+        # fleet/meta_parallel/parallel_layers/pp_layers.py:92): the SAME
+        # Parameter object reachable from both the pre and post stages
+        # (tied embedding / lm head) moves to ONE canonical "shared"
+        # entry; both stages read it from there inside the step, so
+        # autodiff SUMS the two use-sites' gradients — the in-program
+        # equivalent of the reference's shared-param grad allreduce
+        # across owning stages — and the optimizer updates one copy.
+        self._tied = {"pre": {}, "post": {}}
+        self._shared_tensors = {}
+        by_id = {}
+        for sec, tens in (("pre", self._pre_tensors or {}),
+                          ("post", self._post_tensors or {})):
+            for k, t in tens.items():
+                by_id.setdefault(id(t), (t, []))[1].append((sec, k))
+        # a Parameter shared with a pipeline BLOCK cannot be tied this
+        # way (stack_layer_params copies it into the stacked family, so
+        # the copies would silently diverge) — reject loudly
+        block_ids = {id(t) for tens in self._block_tensors
+                     for t in tens.values()}
+        for tid, (t, locs) in by_id.items():
+            if len(locs) >= 1 and tid in block_ids:
+                raise ValueError(
+                    f"parameter {locs[0][1]!r} is shared between a "
+                    f"pipeline block and the {locs[0][0]} stage; tying "
+                    f"into the stacked block family is unsupported — "
+                    f"tie only across the pre/post stages")
+        shared = {}
+        for t, locs in by_id.values():
+            if len(locs) < 2:
+                continue
+            # section + key makes the canonical name unique (two
+            # DIFFERENT ties could share a positional key like
+            # '0.weight' across sections)
+            sname = ("tied_" + locs[0][0] + "_"
+                     + locs[0][1].replace(".", "_"))
+            sec0, key0 = locs[0]
+            shared[sname] = params[sec0][key0]
+            self._shared_tensors[sname] = t
+            for sec, key in locs:
+                del params[sec][key]
+                self._tied[sec][key] = sname
+        if shared:
+            params["shared"] = shared
         self._params = params
         self._opt_state = None
         self._jitted = None
@@ -157,11 +257,22 @@ class PipelineTrainStep:
             out, _ = stage_apply(p, {}, x)
             return out._data if isinstance(out, Tensor) else out
 
+        tied = self._tied
+
+        def with_tied(ps, sec):
+            """Section params + its tied entries materialized from the
+            canonical shared copies."""
+            base = ps.get(sec, {})
+            if not tied[sec]:
+                return base
+            return {**base, **{k: ps["shared"][s]
+                               for k, s in tied[sec].items()}}
+
         def step_fn(params, opt_state, lr, batch, labels):
             def loss_of(ps):
                 x = batch
                 if pre_apply is not None:
-                    out, _ = pre_apply(ps["pre"], {}, x)
+                    out, _ = pre_apply(with_tied(ps, "pre"), {}, x)
                     x = out._data if isinstance(out, Tensor) else out
                 b = x.shape[0]
                 if b % micro:
@@ -173,7 +284,7 @@ class PipelineTrainStep:
                                   "pp", ("dp",), remat=remat)
                 y = y.reshape(b, *y.shape[2:])
                 if post_apply is not None:
-                    out, _ = post_apply(ps["post"], {}, y)
+                    out, _ = post_apply(with_tied(ps, "post"), {}, y)
                     y = out._data if isinstance(out, Tensor) else out
                 lt = loss_fn(Tensor(y),
                              *[Tensor(l) for l in labels])
@@ -225,10 +336,14 @@ class PipelineTrainStep:
                 t._data = self._params["blocks"][k][i]
         if self._pre_tensors:
             for k, t in self._pre_tensors.items():
-                t._data = self._params["pre"][k]
+                if k not in self._tied["pre"]:
+                    t._data = self._params["pre"][k]
         if self._post_tensors:
             for k, t in self._post_tensors.items():
-                t._data = self._params["post"][k]
+                if k not in self._tied["post"]:
+                    t._data = self._params["post"][k]
+        for sname, t in self._shared_tensors.items():
+            t._data = self._params["shared"][sname]
 
     def state_dict(self):
         """Flat name -> Tensor dict, the same contract DistTrainStep

@@ -334,5 +334,10 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         acc = jnp.stack([padded[..., i:i + c] for i in range(size)],
                         axis=0).sum(0)
         acc = jnp.moveaxis(acc, -1, ch_axis)
-        return (a / jnp.power(k + alpha * acc, beta)).astype(a.dtype)
+        # reference semantics: the window is AVERAGED (its impl is an
+        # avg_pool over squares, python/paddle/nn/functional/norm.py
+        # local_response_norm), so alpha scales sum/size — not the raw
+        # sum (caught by the r5 OpTest batch against the NumPy oracle)
+        return (a / jnp.power(k + alpha * acc / size, beta)).astype(
+            a.dtype)
     return apply_op(f, x, op_name="local_response_norm")

@@ -125,7 +125,15 @@ class Layer:
     # -- iteration -----------------------------------------------------------
     def named_parameters(self, prefix="", include_sublayers=True
                          ) -> Iterator[Tuple[str, Parameter]]:
-        seen = set()
+        yield from self._named_parameters(prefix, include_sublayers,
+                                          set())
+
+    def _named_parameters(self, prefix, include_sublayers, seen):
+        # `seen` threads through the WHOLE walk: a tied Parameter
+        # reachable via two submodules (tied embedding/lm-head) must
+        # yield once — a per-level memo made optimizers built from
+        # parameters() apply the update twice to the shared tensor
+        # (ref: Layer.parameters dedup semantics, nn/layer/layers.py)
         for name, p in self._parameters.items():
             if p is not None and id(p) not in seen:
                 seen.add(id(p))
@@ -136,8 +144,8 @@ class Layer:
                 if layer is None:
                     continue
                 sub_prefix = f"{prefix}.{lname}" if prefix else lname
-                for item in layer.named_parameters(sub_prefix):
-                    yield item
+                yield from layer._named_parameters(sub_prefix, True,
+                                                   seen)
 
     def parameters(self, include_sublayers=True) -> List[Parameter]:
         return [p for _, p in self.named_parameters(

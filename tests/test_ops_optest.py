@@ -269,21 +269,18 @@ class TestAvgPool2dOp(OpTest):
                 .astype(np.float32)}
 
 
-class TestLayerNormOp(OpTest):
-    op_fn = staticmethod(lambda x, w, b: F.layer_norm(
-        x, normalized_shape=[8], weight=w, bias=b))
+class TestLayerNormNoAffineOp(OpTest):
+    # weight=None/bias=None: the NO-affine branch
+    op_fn = staticmethod(lambda x: F.layer_norm(x, 8))
 
     @staticmethod
-    def ref_fn(x, w, b):
-        mu = x.mean(-1, keepdims=True)
-        var = x.var(-1, keepdims=True)
-        return (x - mu) / np.sqrt(var + 1e-5) * w + b
+    def ref_fn(x):
+        m_ = x.mean(-1, keepdims=True)
+        v_ = x.var(-1, keepdims=True)
+        return (x - m_) / np.sqrt(v_ + 1e-5)
 
     def inputs(self):
-        r = _rng()
-        return {"x": r.normal(size=(4, 8)).astype(np.float32),
-                "w": r.normal(size=(8,)).astype(np.float32),
-                "b": r.normal(size=(8,)).astype(np.float32)}
+        return {"x": _rng().normal(size=(3, 8)).astype(np.float32)}
 
 
 class TestGroupNormOp(OpTest):
@@ -372,7 +369,7 @@ class TestPadOp(OpTest):
         return {"x": _rng().normal(size=(2, 4)).astype(np.float32)}
 
 
-class TestConcatOp(OpTest):
+class TestConcatAxis1Op(OpTest):
     op_fn = staticmethod(lambda x, y: paddle.concat([x, y], axis=1))
     ref_fn = staticmethod(lambda x, y: np.concatenate([x, y], axis=1))
 
@@ -445,18 +442,17 @@ class TestLinearOp(OpTest):
                 "b": r.normal(size=(5,)).astype(np.float32)}
 
 
-class TestGeluOp(OpTest):
+class TestGeluTanhOp(OpTest):
     op_fn = staticmethod(F.gelu)
+    attrs = {"approximate": True}
 
     @staticmethod
-    def ref_fn(x):
-        import math
-        erf = np.vectorize(math.erf)
-        return (x * 0.5 * (1.0 + erf(x / np.sqrt(2.0)))).astype(
-            np.float32)
+    def ref_fn(x, approximate=True):
+        return 0.5 * x * (1.0 + np.tanh(
+            np.sqrt(2.0 / np.pi) * (x + 0.044715 * x ** 3)))
 
     def inputs(self):
-        return {"x": _rng().normal(size=(4, 4)).astype(np.float32)}
+        return {"x": _rng().normal(size=(4, 5)).astype(np.float32)}
 
 
 class TestLogSoftmaxOp(OpTest):
@@ -824,3 +820,722 @@ class TestEinsumContractionOp(OpTest):
         r = _rng()
         return {"x": r.normal(size=(3, 4)).astype(np.float32),
                 "y": r.normal(size=(4, 5)).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Batch 4 (r5, VERDICT r4 #9): conv variants, pooling edge cases, pad
+# modes, index ops, norm family, math/reduction long tail — the
+# reference's most-tested op families (test/legacy_test/test_*_op.py).
+
+class TestConv1dOp(OpTest):
+    op_fn = staticmethod(F.conv1d)
+    attrs = {"stride": 1, "padding": 1}
+
+    @staticmethod
+    def ref_fn(x, w, stride=1, padding=1):
+        import numpy as np
+        n, c, l = x.shape
+        o, _, k = w.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (padding, padding)))
+        lo = (l + 2 * padding - k) // stride + 1
+        out = np.zeros((n, o, lo), np.float32)
+        for i in range(lo):
+            seg = xp[:, :, i * stride:i * stride + k]
+            out[:, :, i] = np.einsum("ncK,ocK->no", seg, w)
+        return out
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(2, 3, 8)).astype(np.float32),
+                "w": r.normal(size=(4, 3, 3)).astype(np.float32)}
+
+
+class TestConv2dGroupsOp(OpTest):
+    op_fn = staticmethod(F.conv2d)
+    attrs = {"groups": 2}
+    grad_eps = 1e-2  # f32 FD noise at 1e-3 on the quadratic loss
+
+    @staticmethod
+    def ref_fn(x, w, groups=2):
+        import numpy as np
+        n, c, h, ww = x.shape
+        o, cg, kh, kw = w.shape
+        og = o // groups
+        out = np.zeros((n, o, h - kh + 1, ww - kw + 1), np.float32)
+        for g in range(groups):
+            xs = x[:, g * cg:(g + 1) * cg]
+            for i in range(out.shape[2]):
+                for j in range(out.shape[3]):
+                    seg = xs[:, :, i:i + kh, j:j + kw]
+                    out[:, g * og:(g + 1) * og, i, j] = np.einsum(
+                        "nchw,ochw->no", seg, w[g * og:(g + 1) * og])
+        return out
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(2, 4, 5, 5)).astype(np.float32),
+                "w": r.normal(size=(4, 2, 3, 3)).astype(np.float32)}
+
+
+class TestConv2dDilationOp(OpTest):
+    op_fn = staticmethod(F.conv2d)
+    attrs = {"dilation": 2}
+
+    @staticmethod
+    def ref_fn(x, w, dilation=2):
+        import numpy as np
+        n, c, h, ww = x.shape
+        o, _, kh, kw = w.shape
+        eh, ew = (kh - 1) * dilation + 1, (kw - 1) * dilation + 1
+        out = np.zeros((n, o, h - eh + 1, ww - ew + 1), np.float32)
+        for i in range(out.shape[2]):
+            for j in range(out.shape[3]):
+                seg = x[:, :, i:i + eh:dilation, j:j + ew:dilation]
+                out[:, :, i, j] = np.einsum("nchw,ochw->no", seg, w)
+        return out
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(1, 2, 7, 7)).astype(np.float32),
+                "w": r.normal(size=(3, 2, 2, 2)).astype(np.float32)}
+
+
+class TestConv3dOp(OpTest):
+    op_fn = staticmethod(F.conv3d)
+
+    @staticmethod
+    def ref_fn(x, w):
+        import numpy as np
+        n, c, d, h, ww = x.shape
+        o, _, kd, kh, kw = w.shape
+        out = np.zeros((n, o, d - kd + 1, h - kh + 1, ww - kw + 1),
+                       np.float32)
+        for a in range(out.shape[2]):
+            for i in range(out.shape[3]):
+                for j in range(out.shape[4]):
+                    seg = x[:, :, a:a + kd, i:i + kh, j:j + kw]
+                    out[:, :, a, i, j] = np.einsum(
+                        "ncdhw,ocdhw->no", seg, w)
+        return out
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(1, 2, 4, 4, 4)).astype(np.float32),
+                "w": r.normal(size=(3, 2, 2, 2, 2)).astype(np.float32)}
+
+
+class TestMaxPool1dOp(OpTest):
+    op_fn = staticmethod(F.max_pool1d)
+    attrs = {"kernel_size": 2, "stride": 2}
+
+    @staticmethod
+    def ref_fn(x, kernel_size=2, stride=2):
+        n, c, l = x.shape
+        lo = (l - kernel_size) // stride + 1
+        return np.stack([x[:, :, i * stride:i * stride + kernel_size]
+                         .max(-1) for i in range(lo)], axis=-1)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(2, 3, 8)).astype(np.float32)}
+
+
+class TestMaxPool2dStridedOp(OpTest):
+    op_fn = staticmethod(F.max_pool2d)
+    attrs = {"kernel_size": 3, "stride": 2}
+
+    @staticmethod
+    def ref_fn(x, kernel_size=3, stride=2):
+        n, c, h, w = x.shape
+        ho = (h - kernel_size) // stride + 1
+        wo = (w - kernel_size) // stride + 1
+        out = np.zeros((n, c, ho, wo), np.float32)
+        for i in range(ho):
+            for j in range(wo):
+                out[:, :, i, j] = x[:, :, i*2:i*2+3, j*2:j*2+3].max((2, 3))
+        return out
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(2, 2, 7, 7)).astype(np.float32)}
+
+
+class TestAvgPool2dPaddedOp(OpTest):
+    op_fn = staticmethod(F.avg_pool2d)
+    attrs = {"kernel_size": 2, "stride": 2, "padding": 1}
+    grad_inputs = ()  # padding-boundary FD is ragged; output check only
+
+    @staticmethod
+    def ref_fn(x, kernel_size=2, stride=2, padding=1):
+        # exclusive=True (the paddle default): the divisor counts only
+        # NON-PAD elements in each window
+        n, c, h, w = x.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        cnt = np.pad(np.ones_like(x), ((0, 0), (0, 0), (1, 1), (1, 1)))
+        ho = (h + 2 - kernel_size) // stride + 1
+        wo = (w + 2 - kernel_size) // stride + 1
+        out = np.zeros((n, c, ho, wo), np.float32)
+        for i in range(ho):
+            for j in range(wo):
+                s = xp[:, :, i*2:i*2+2, j*2:j*2+2].sum((2, 3))
+                d = cnt[:, :, i*2:i*2+2, j*2:j*2+2].sum((2, 3))
+                out[:, :, i, j] = s / d
+        return out
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(1, 2, 6, 6)).astype(np.float32)}
+
+
+class TestAdaptiveAvgPool2dOp(OpTest):
+    op_fn = staticmethod(F.adaptive_avg_pool2d)
+    attrs = {"output_size": 2}
+
+    @staticmethod
+    def ref_fn(x, output_size=2):
+        n, c, h, w = x.shape
+        out = np.zeros((n, c, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                out[:, :, i, j] = x[:, :, i*(h//2):(i+1)*(h//2),
+                                    j*(w//2):(j+1)*(w//2)].mean((2, 3))
+        return out
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(2, 3, 4, 4)).astype(np.float32)}
+
+
+class TestAdaptiveMaxPool2dOp(OpTest):
+    op_fn = staticmethod(F.adaptive_max_pool2d)
+    attrs = {"output_size": 2}
+
+    @staticmethod
+    def ref_fn(x, output_size=2):
+        n, c, h, w = x.shape
+        out = np.zeros((n, c, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                out[:, :, i, j] = x[:, :, i*(h//2):(i+1)*(h//2),
+                                    j*(w//2):(j+1)*(w//2)].max((2, 3))
+        return out
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(2, 3, 4, 4)).astype(np.float32)}
+
+
+class TestPadReflectOp(OpTest):
+    op_fn = staticmethod(F.pad)
+    attrs = {"pad": [1, 1, 2, 0], "mode": "reflect"}
+
+    @staticmethod
+    def ref_fn(x, pad=None, mode=None):
+        return np.pad(x, ((0, 0), (0, 0), (2, 0), (1, 1)),
+                      mode="reflect")
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(1, 2, 4, 5)).astype(np.float32)}
+
+
+class TestPadReplicateOp(OpTest):
+    op_fn = staticmethod(F.pad)
+    attrs = {"pad": [2, 1, 1, 1], "mode": "replicate"}
+
+    @staticmethod
+    def ref_fn(x, pad=None, mode=None):
+        return np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 1)), mode="edge")
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(1, 2, 4, 5)).astype(np.float32)}
+
+
+class TestPadCircularOp(OpTest):
+    op_fn = staticmethod(F.pad)
+    attrs = {"pad": [1, 1, 1, 1], "mode": "circular"}
+
+    @staticmethod
+    def ref_fn(x, pad=None, mode=None):
+        return np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="wrap")
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(1, 2, 4, 4)).astype(np.float32)}
+
+
+class TestPadConstantValueOp(OpTest):
+    # full-rank pad (len == 2*ndim): per-dim (before, after) pairs
+    op_fn = staticmethod(F.pad)
+    attrs = {"pad": [0, 1, 1, 2], "mode": "constant", "value": 2.5}
+
+    @staticmethod
+    def ref_fn(x, pad=None, mode=None, value=2.5):
+        return np.pad(x, ((0, 1), (1, 2)), constant_values=2.5)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 4)).astype(np.float32)}
+
+
+class TestScatterOp(OpTest):
+    op_fn = staticmethod(paddle.scatter)
+    grad_inputs = ("x",)
+
+    @staticmethod
+    def ref_fn(x, index, updates):
+        out = x.copy()
+        out[index] = updates
+        return out
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(6, 3)).astype(np.float32),
+                "index": np.array([1, 4], np.int64),
+                "updates": r.normal(size=(2, 3)).astype(np.float32)}
+
+
+class TestGatherNdOp(OpTest):
+    op_fn = staticmethod(paddle.gather_nd)
+
+    @staticmethod
+    def ref_fn(x, index):
+        return x[tuple(index.T)] if index.shape[-1] == x.ndim else \
+            x[tuple(np.moveaxis(index, -1, 0))]
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(4, 5)).astype(np.float32),
+                "index": np.array([[0, 1], [3, 2]], np.int64)}
+
+
+class TestIndexSampleOp(OpTest):
+    op_fn = staticmethod(paddle.index_sample)
+
+    @staticmethod
+    def ref_fn(x, index):
+        return np.take_along_axis(x, index, axis=1)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 6)).astype(np.float32),
+                "index": np.array([[0, 2], [1, 1], [5, 0]], np.int64)}
+
+
+class TestOneHotOp(OpTest):
+    op_fn = staticmethod(F.one_hot)
+    attrs = {"num_classes": 5}
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x, num_classes=5):
+        return np.eye(num_classes, dtype=np.float32)[x]
+
+    def inputs(self):
+        return {"x": np.array([0, 3, 1, 4], np.int64)}
+
+
+class TestRollMultiAxisOp(OpTest):
+    op_fn = staticmethod(paddle.roll)
+    attrs = {"shifts": [1, -2], "axis": [0, 1]}
+
+    @staticmethod
+    def ref_fn(x, shifts=None, axis=None):
+        return np.roll(x, (1, -2), axis=(0, 1))
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(4, 5)).astype(np.float32)}
+
+
+class TestBatchNormEvalOp(OpTest):
+    op_fn = staticmethod(
+        lambda x, rm, rv, w, b: F.batch_norm(x, rm, rv, w, b,
+                                             training=False))
+    grad_inputs = ("x",)
+
+    @staticmethod
+    def ref_fn(x, rm, rv, w, b):
+        xn = (x - rm[None, :, None, None]) / np.sqrt(
+            rv[None, :, None, None] + 1e-5)
+        return xn * w[None, :, None, None] + b[None, :, None, None]
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(2, 3, 4, 4)).astype(np.float32),
+                "rm": r.normal(size=(3,)).astype(np.float32),
+                "rv": np.abs(r.normal(size=(3,))).astype(np.float32) + 1,
+                "w": r.normal(size=(3,)).astype(np.float32),
+                "b": r.normal(size=(3,)).astype(np.float32)}
+
+
+class TestBatchNormTrainOp(OpTest):
+    """Training BN with the r5 anchored one-pass stats — output parity
+    against the straight two-pass NumPy reference."""
+    op_fn = staticmethod(
+        lambda x, w, b: F.batch_norm(
+            paddle.to_tensor(x) if not hasattr(x, "_data") else x,
+            paddle.to_tensor(np.zeros(3, np.float32)),
+            paddle.to_tensor(np.ones(3, np.float32)),
+            w, b, training=True))
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x, w, b):
+        m = x.mean((0, 2, 3), keepdims=True)
+        v = x.var((0, 2, 3), keepdims=True)
+        xn = (x - m) / np.sqrt(v + 1e-5)
+        return xn * w[None, :, None, None] + b[None, :, None, None]
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(4, 3, 4, 4)).astype(np.float32),
+                "w": r.normal(size=(3,)).astype(np.float32),
+                "b": r.normal(size=(3,)).astype(np.float32)}
+
+
+class TestRmsNormOp(OpTest):
+    op_fn = staticmethod(F.rms_norm)
+
+    @staticmethod
+    def ref_fn(x, w):
+        v = np.mean(x * x, axis=-1, keepdims=True)
+        return x / np.sqrt(v + 1e-6) * w
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 8)).astype(np.float32),
+                "w": r.normal(size=(8,)).astype(np.float32)}
+
+
+class TestNormalizeOp(OpTest):
+    op_fn = staticmethod(F.normalize)
+    attrs = {"axis": 1}
+
+    @staticmethod
+    def ref_fn(x, axis=1):
+        n = np.sqrt((x * x).sum(axis=1, keepdims=True))
+        return x / np.maximum(n, 1e-12)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 5)).astype(np.float32)}
+
+
+class TestLocalResponseNormOp(OpTest):
+    op_fn = staticmethod(F.local_response_norm)
+    attrs = {"size": 3}
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x, size=3):
+        # reference formula: avg_pool of squares over the channel
+        # window with ZERO padding -> alpha * sum / size at every
+        # position (the denominator stays `size` at the edges)
+        n, c, h, w = x.shape
+        sq = x * x
+        acc = np.zeros_like(x)
+        half = size // 2
+        for i in range(c):
+            lo, hi = max(0, i - half), min(c, i + half + 1)
+            acc[:, i] = sq[:, lo:hi].sum(1)
+        return x / np.power(1.0 + (1e-4 / size) * acc, 0.75)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(2, 5, 3, 3)).astype(np.float32)}
+
+
+class TestFloorDivideOp(OpTest):
+    op_fn = staticmethod(paddle.floor_divide)
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x, y):
+        return np.floor_divide(x, y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": (r.normal(size=(4, 4)) * 5).astype(np.float32),
+                "y": (np.abs(r.normal(size=(4, 4))) + 0.5)
+                .astype(np.float32)}
+
+
+class TestRemainderOp(OpTest):
+    op_fn = staticmethod(paddle.remainder)
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x, y):
+        return np.mod(x, y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": (r.normal(size=(4, 4)) * 5).astype(np.float32),
+                "y": (np.abs(r.normal(size=(4, 4))) + 0.5)
+                .astype(np.float32)}
+
+
+class TestFmaxFminOp(OpTest):
+    op_fn = staticmethod(lambda x, y: paddle.fmax(x, y) +
+                         paddle.fmin(x, y))
+
+    @staticmethod
+    def ref_fn(x, y):
+        return np.fmax(x, y) + np.fmin(x, y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 4)).astype(np.float32),
+                "y": r.normal(size=(3, 4)).astype(np.float32)}
+
+
+class TestTruncFracOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.trunc(x) + paddle.frac(x))
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x):
+        return np.trunc(x) + (x - np.trunc(x))
+
+    def inputs(self):
+        return {"x": (_rng().normal(size=(4, 4)) * 3)
+                .astype(np.float32)}
+
+
+class TestLerpOp(OpTest):
+    op_fn = staticmethod(paddle.lerp)
+
+    @staticmethod
+    def ref_fn(x, y, w):
+        return x + w * (y - x)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 4)).astype(np.float32),
+                "y": r.normal(size=(3, 4)).astype(np.float32),
+                "w": np.abs(r.normal(size=(3, 4))).astype(np.float32)}
+
+
+class TestHeavisideOp(OpTest):
+    op_fn = staticmethod(paddle.heaviside)
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x, y):
+        return np.heaviside(x, y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(4, 4)).astype(np.float32),
+                "y": r.normal(size=(4, 4)).astype(np.float32)}
+
+
+class TestAtan2Op(OpTest):
+    op_fn = staticmethod(paddle.atan2)
+
+    @staticmethod
+    def ref_fn(x, y):
+        return np.arctan2(x, y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 4)).astype(np.float32),
+                "y": (np.abs(r.normal(size=(3, 4))) + 0.5)
+                .astype(np.float32)}
+
+
+class TestExpm1Log1pOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.expm1(x) + paddle.log1p(x))
+
+    @staticmethod
+    def ref_fn(x):
+        return np.expm1(x) + np.log1p(x)
+
+    def inputs(self):
+        return {"x": np.abs(_rng().normal(size=(4, 4)))
+                .astype(np.float32)}
+
+
+class TestCopysignOp(OpTest):
+    op_fn = staticmethod(paddle.copysign)
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x, y):
+        return np.copysign(x, y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(4, 4)).astype(np.float32),
+                "y": r.normal(size=(4, 4)).astype(np.float32)}
+
+
+class TestHypotOp(OpTest):
+    op_fn = staticmethod(paddle.hypot)
+
+    @staticmethod
+    def ref_fn(x, y):
+        return np.hypot(x, y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": (np.abs(r.normal(size=(3, 4))) + 0.5)
+                .astype(np.float32),
+                "y": (np.abs(r.normal(size=(3, 4))) + 0.5)
+                .astype(np.float32)}
+
+
+class TestAmaxAminOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.amax(x, axis=1) +
+                         paddle.amin(x, axis=1))
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x):
+        return x.max(1) + x.min(1)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 5)).astype(np.float32)}
+
+
+class TestNanReductionsOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.nansum(x, axis=0) +
+                         paddle.nanmean(x, axis=0))
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x):
+        return np.nansum(x, 0) + np.nanmean(x, 0)
+
+    def inputs(self):
+        x = _rng().normal(size=(4, 5)).astype(np.float32)
+        x[1, 2] = np.nan
+        x[3, 0] = np.nan
+        return {"x": x}
+
+
+class TestProdAxisOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.prod(x, axis=1))
+
+    @staticmethod
+    def ref_fn(x):
+        return np.prod(x, axis=1)
+
+    def inputs(self):
+        return {"x": (_rng().normal(size=(3, 4)) * 0.5 + 1.0)
+                .astype(np.float32)}
+
+
+class TestStdVarOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.std(x, axis=1) +
+                         paddle.var(x, axis=1))
+
+    @staticmethod
+    def ref_fn(x):
+        return x.std(1, ddof=1) + x.var(1, ddof=1)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 6)).astype(np.float32)}
+
+
+class TestMedianOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.median(x, axis=1))
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x):
+        return np.median(x, axis=1)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 5)).astype(np.float32)}
+
+
+class TestCumprodOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.cumprod(x, dim=1))
+
+    @staticmethod
+    def ref_fn(x):
+        return np.cumprod(x, axis=1)
+
+    def inputs(self):
+        return {"x": (_rng().normal(size=(3, 4)) * 0.5 + 1.2)
+                .astype(np.float32)}
+
+
+class TestCummaxValuesOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.cummax(x, axis=1)[0])
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x):
+        return np.maximum.accumulate(x, axis=1)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 5)).astype(np.float32)}
+
+
+class TestIscloseSignOp(OpTest):
+    op_fn = staticmethod(
+        lambda x, y: paddle.cast(paddle.isclose(x, y), "float32") +
+        paddle.sign(x))
+    grad_inputs = ()
+
+    @staticmethod
+    def ref_fn(x, y):
+        return np.isclose(x, y).astype(np.float32) + np.sign(x)
+
+    def inputs(self):
+        r = _rng()
+        x = r.normal(size=(3, 4)).astype(np.float32)
+        y = x.copy()
+        y[0, 0] += 1.0
+        return {"x": x, "y": y}
+
+
+class TestFlattenRangeOp(OpTest):
+    op_fn = staticmethod(
+        lambda x: paddle.flatten(x, start_axis=1, stop_axis=2))
+
+    @staticmethod
+    def ref_fn(x):
+        return x.reshape(x.shape[0], -1, x.shape[3])
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(2, 3, 4, 5)).astype(np.float32)}
+
+
+class TestSplitSectionsOp(OpTest):
+    op_fn = staticmethod(
+        lambda x: paddle.split(x, [2, 3], axis=1)[1])
+
+    @staticmethod
+    def ref_fn(x):
+        return x[:, 2:]
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 5)).astype(np.float32)}
+
+
+class TestUnbindOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.unbind(x, axis=0)[1])
+
+    @staticmethod
+    def ref_fn(x):
+        return x[1]
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 4)).astype(np.float32)}
+
+
+class TestDiffOp(OpTest):
+    op_fn = staticmethod(lambda x: paddle.diff(x, axis=1))
+
+    @staticmethod
+    def ref_fn(x):
+        return np.diff(x, axis=1)
+
+    def inputs(self):
+        return {"x": _rng().normal(size=(3, 5)).astype(np.float32)}
+
+
+class TestLogaddexpOp(OpTest):
+    op_fn = staticmethod(paddle.logaddexp)
+
+    @staticmethod
+    def ref_fn(x, y):
+        return np.logaddexp(x, y)
+
+    def inputs(self):
+        r = _rng()
+        return {"x": r.normal(size=(3, 4)).astype(np.float32),
+                "y": r.normal(size=(3, 4)).astype(np.float32)}

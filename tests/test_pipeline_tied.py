@@ -1,0 +1,158 @@
+"""Tied-weight pipeline realization (VERDICT r4 #7): the compiled
+Engine pipeline path handles SharedLayerDesc-style models — a tied
+embedding/lm-head whose single Parameter is used by both the first and
+last stage — with the gradient merge the reference does via a shared-
+param allreduce across owning stages
+(ref: fleet/meta_parallel/parallel_layers/pp_layers.py:92,257)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.auto_parallel.engine_pp import (
+    PipelineTrainStep, build_pipeline_model, detect_pipeline_split)
+from paddle_tpu.distributed.fleet.pp_layers import (LayerDesc,
+                                                    SharedLayerDesc)
+
+V, H, B, T = 32, 16, 16, 4
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+
+    def forward(self, x):
+        return x + F.gelu(self.fc(x))
+
+
+class TiedHead(nn.Layer):
+    """Projects through the embedding's OWN weight (the tie)."""
+
+    def __init__(self, emb):
+        super().__init__()
+        self.emb = emb
+
+    def forward(self, x):
+        return paddle.matmul(x, paddle.transpose(self.emb.weight, [1, 0]))
+
+
+def _make_tied_model():
+    paddle.seed(0)
+    emb = nn.Embedding(V, H)
+    return nn.Sequential(emb, *[Block() for _ in range(4)],
+                         TiedHead(emb))
+
+
+def _loss_fn(logits, labels):
+    return F.cross_entropy(
+        logits.reshape([-1, V]), labels.reshape([-1])).mean()
+
+
+def _oracle_losses(model_factory, ids, labels, steps):
+    m = model_factory()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+    out = []
+    for _ in range(steps):
+        loss = _loss_fn(m(paddle.to_tensor(ids)),
+                        paddle.to_tensor(labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        out.append(float(loss))
+    return out, m
+
+
+class TestTiedPipeline:
+    def test_detect_split_sees_tied_ends(self):
+        m = _make_tied_model()
+        pre, fam, post = detect_pipeline_split(m)
+        assert len(pre) == 1 and len(fam) == 4 and len(post) == 1
+
+    def test_tied_weights_train_like_serial(self):
+        """pp=4 compiled pipeline on a tied-embedding LM == the serial
+        oracle, loss for loss — the tied weight receives BOTH stages'
+        gradients exactly once."""
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, V, (B, T)).astype(np.int32)
+        labels = rng.integers(0, V, (B, T)).astype(np.int64)
+
+        expected, m_ref = _oracle_losses(_make_tied_model, ids, labels, 3)
+
+        m = _make_tied_model()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        step = PipelineTrainStep(m, _loss_fn, opt, pp=4, micro_batches=4)
+        assert "shared" in step._params, "tied weight not detected"
+        got = [float(step(ids, labels)) for _ in range(3)]
+        np.testing.assert_allclose(got, expected, rtol=2e-4)
+
+        # the embedding weight object stays THE tie and matches serial
+        emb_w = m[0].weight
+        assert m[5].emb.weight is emb_w
+        np.testing.assert_allclose(np.asarray(emb_w._data),
+                                   np.asarray(m_ref[0].weight._data),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_untied_model_has_no_shared_section(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Embedding(V, H),
+                          *[Block() for _ in range(4)],
+                          nn.Linear(H, V))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        step = PipelineTrainStep(m, _loss_fn, opt, pp=4, micro_batches=4)
+        assert "shared" not in step._params
+
+    def test_build_from_layer_descs(self):
+        """fleet's LayerDesc/SharedLayerDesc list realizes into the
+        compiled pipeline: same-key SharedLayerDescs share ONE layer
+        instance and the step ties them."""
+        def head_fwd(emb_layer, x):
+            return paddle.matmul(x, paddle.transpose(emb_layer.weight, [1, 0]))
+
+        paddle.seed(0)
+        descs = [SharedLayerDesc("emb", nn.Embedding, None, "weight",
+                                 V, H)] \
+            + [LayerDesc(Block) for _ in range(4)] \
+            + [SharedLayerDesc("emb", nn.Embedding, head_fwd, "weight",
+                               V, H)]
+        m = build_pipeline_model(descs)
+        # one instance: both use-sites expose the same Tensor
+        assert m[0].inner.weight is m[5].inner.weight
+
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        step = PipelineTrainStep(m, _loss_fn, opt, pp=4, micro_batches=4)
+        assert "shared" in step._params
+
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, V, (B, T)).astype(np.int32)
+        labels = rng.integers(0, V, (B, T)).astype(np.int64)
+
+        def rebuild():
+            paddle.seed(0)
+            return build_pipeline_model(
+                [SharedLayerDesc("emb", nn.Embedding, None, "weight",
+                                 V, H)]
+                + [LayerDesc(Block) for _ in range(4)]
+                + [SharedLayerDesc("emb", nn.Embedding, head_fwd,
+                                   "weight", V, H)])
+
+        expected, _ = _oracle_losses(rebuild, ids, labels, 2)
+        got = [float(step(ids, labels)) for _ in range(2)]
+        np.testing.assert_allclose(got, expected, rtol=2e-4)
+
+
+def test_named_parameters_dedups_tied_across_modules():
+    """A Parameter reachable via two submodules yields ONCE from the
+    whole-model walk (torch/reference semantics) — a per-level memo
+    made eager optimizers double-update tied weights (found by the
+    tied-pipeline oracle comparison above)."""
+    m = _make_tied_model()
+    ps = m.parameters()
+    assert len(ps) == len({id(p) for p in ps})
+    # the tie is still reachable through both paths
+    assert m[0].weight is m[5].emb.weight
