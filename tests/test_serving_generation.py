@@ -181,3 +181,29 @@ class TestServeGenerateEndpoint:
                 assert results[i] == _oracle(model, p, n), i
         finally:
             server.shutdown()
+
+
+class TestServingErrorPaths:
+    def test_overlong_prompt_fails_loudly(self, model):
+        eng = LlamaDecodeEngine(model, max_slots=1, max_seq=16)
+        srv = GenerationServer(eng)
+        with pytest.raises(ValueError, match="prompt length"):
+            srv.generate(list(range(40)), 4, timeout=60)
+        # the loop survives: a valid request still serves
+        out = srv.generate([1, 2, 3], 2, timeout=60)
+        assert out == _oracle(model, [1, 2, 3], 2)
+
+    def test_decode_steps_guards(self, model):
+        eng = LlamaDecodeEngine(model, max_slots=2, max_seq=32)
+        with pytest.raises(ValueError, match="EVERY slot"):
+            eng.decode_steps(2)          # no slot active
+        eng.prefill(0, [1, 2, 3])
+        eng.prefill(1, [4, 5])
+        with pytest.raises(ValueError, match="cache"):
+            eng.decode_steps(64)         # would run past max_seq
+
+    def test_submit_rejects_nonpositive_budget(self, model):
+        eng = LlamaDecodeEngine(model, max_slots=1, max_seq=32)
+        srv = GenerationServer(eng)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            srv.submit([1, 2], 0)
