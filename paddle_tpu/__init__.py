@@ -35,6 +35,7 @@ from .core import dtype as dtype_module  # noqa: F401
 from .core.dtype import bool_  # noqa: F401
 
 # core tensor + autograd
+from .core import fusion  # noqa: F401  (paddle.fusion.stats() surface)
 from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
 from .core.autograd import no_grad, enable_grad, is_grad_enabled, grad  # noqa: F401
 from .core.flags import get_flags, set_flags  # noqa: F401

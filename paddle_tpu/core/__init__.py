@@ -1,2 +1,2 @@
-from . import autograd, device, dtype, flags, random  # noqa: F401
+from . import autograd, device, dtype, flags, fusion, random  # noqa: F401
 from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

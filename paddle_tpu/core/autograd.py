@@ -449,6 +449,7 @@ _backward_observer = None
 _Tensor = None
 _amp_state = None
 _maybe_cast_inputs = None
+_fusion = None
 
 
 def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
@@ -459,15 +460,38 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
     This is the analog of a generated ``*_ad_func`` forward
     (ref: fluid/eager/api/manual/eager_manual/forwards/multiply_fwd_func.cc:68).
     """
-    global _Tensor, _amp_state, _maybe_cast_inputs
+    global _Tensor, _amp_state, _maybe_cast_inputs, _fusion
     if _Tensor is None:
         from .tensor import Tensor as _T
         from ..amp.auto_cast import _state as _s, maybe_cast_inputs as _m
-        _Tensor, _amp_state, _maybe_cast_inputs = _T, _s, _m
+        from . import fusion as _f
+        _Tensor, _amp_state, _maybe_cast_inputs, _fusion = _T, _s, _m, _f
     Tensor = _Tensor
 
     name = op_name or getattr(fn, "__name__", "op")
-    datas = [a._data if isinstance(a, Tensor) else a for a in args]
+
+    # lazy-eager fusion: fusable elementwise ops defer into an expression
+    # DAG and compile per-chain instead of per-op (core/fusion.py). The
+    # _op_gate still runs so arity validation + dispatch_counts see every
+    # dispatch; recorders (SOT/static), AMP, and tracers take the plain
+    # path untouched.
+    if (_op_recorder is None and not _amp_state.enabled
+            and _fusion.enabled()):
+        fused_out = _fusion.try_fuse(name, fn, args, kwargs)
+        if fused_out is not None:
+            _op_gate(name, len(args))
+            return fused_out
+
+    datas = []
+    for a in args:
+        if isinstance(a, Tensor):
+            if a._lazy is not None:
+                # a pending chain meets a non-fusable consumer: flush at
+                # the op boundary (reduction/matmul/gather/...)
+                _fusion.materialize_tensor(a, "op_boundary")
+            datas.append(a._buf)
+        else:
+            datas.append(a)
 
     # AMP hook (the analog of the generated ad_func AMP block,
     # ref: multiply_fwd_func.cc:49-70)
@@ -480,10 +504,13 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
             return _fn(*_maybe_cast_inputs(_name, list(a)), **kw)
 
     has_vjp = _op_gate(name, len(args))
+    # _buf, not the _data property: the unwrap loop above already
+    # materialized every Tensor arg, so the lazy-flush branch is dead
+    # weight on this measured hot path
     diff_idx = [
         i for i, a in enumerate(args)
         if isinstance(a, Tensor) and not a.stop_gradient
-        and _is_diff_dtype(a._data)
+        and _is_diff_dtype(a._buf)
     ]
     record = _state.enabled and bool(diff_idx) and has_vjp
 
@@ -799,6 +826,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
     flush_nan_checks()  # drain forward-pass flags before walking the tape
     if isinstance(tensors, Tensor):
         tensors = [tensors]
+    if _fusion is not None:
+        for t in tensors:
+            if t._lazy is not None:  # flush pending chains: the walk
+                _fusion.materialize_tensor(t, "backward")  # needs nodes
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
     elif isinstance(grad_tensors, Tensor):
@@ -832,6 +863,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
+    if _fusion is not None:
+        for t in list(outputs) + list(inputs):
+            if t._lazy is not None:
+                _fusion.materialize_tensor(t, "backward")
     if grad_outputs is None:
         grad_outputs = [None] * len(outputs)
     elif isinstance(grad_outputs, Tensor):

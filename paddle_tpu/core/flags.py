@@ -102,6 +102,19 @@ define_flag("check_nan_inf_stride", 1,
             ">1 amortizes the host sync (one fetch per stride ops; "
             "essential over a high-RTT device link)")
 define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (no-op on TPU; XLA owns memory)")
+define_flag("eager_fusion",
+            _parse_bool(os.environ.get("PADDLE_TPU_EAGER_FUSION", "1")),
+            "Lazy-eager elementwise fusion: defer fusable op chains and "
+            "compile each chain as ONE jitted executable at the flush "
+            "point (host read / non-fusable boundary / backward / chain "
+            "cap). Kill switch: FLAGS_eager_fusion=0 or "
+            "PADDLE_TPU_EAGER_FUSION=0 restores per-op dispatch")
+define_flag("eager_fusion_max_chain", 32,
+            "Deferred-op count at which a fusion chain force-flushes; "
+            "bounds compile time and the retained expression DAG")
+define_flag("eager_fusion_cache", 256,
+            "LRU capacity of the fusion program cache (entries keyed by "
+            "DAG structure + input shapes/dtypes)")
 define_flag("use_bf16_matmul", True, "Prefer bfloat16 matmul accumulation defaults")
 define_flag("log_level", 0, "Framework verbosity")
 define_flag("benchmark", False, "Synchronize after each op for timing")

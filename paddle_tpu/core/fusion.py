@@ -1,0 +1,633 @@
+"""Lazy-eager elementwise fusion runtime.
+
+The eager hot path dispatches one jitted pair per op (core/autograd
+apply_op), so an N-op elementwise chain costs N host dispatches and N
+HBM round-trips — the locality problem operator-fusion compilers
+(Neptune, FlashFuser; the reference's CINN pass) attack at the graph
+level. Here the same win is taken WITHOUT leaving eager semantics:
+
+* Ops flagged ``fusable: true`` in ``ops/ops.yaml`` do not execute at
+  dispatch. ``apply_op`` routes them here; each builds a ``LazyExpr``
+  node over its inputs and returns a real ``Tensor`` whose ``_data``
+  materializes on demand (the handle is indistinguishable to user code).
+* The expression DAG flushes at materialization points — a host read
+  (``.numpy()``/``item``/``__array__``), a non-fusable op consuming the
+  tensor (reduction/matmul/...), ``backward()``, an in-place mutation,
+  a gradient hook, or the chain-length cap — by compiling the WHOLE
+  reachable chain as ONE jitted executable.
+* Compiled programs live in an LRU cache keyed by (DAG structure, input
+  shapes/dtypes/weak-types, diff pattern, live outputs), so steady-state
+  loops hit the cache and dispatch once per chain.
+* Gradients: the flush records ONE GradNode against the fused program's
+  VJP (``jax.vjp`` of the generated pure function), with per-edge
+  ``stop_gradient`` inserts reproducing exactly the dispatch-time
+  stop_gradient/no_grad semantics the per-op tape would have had.
+
+Kill switch: ``FLAGS_eager_fusion=0`` (or env ``PADDLE_TPU_EAGER_FUSION=0``)
+restores the exact pre-fusion dispatch path. Observability:
+``fusion.stats()`` — chains built, cache hits/misses, flush reasons,
+ops-per-chain histogram.
+"""
+from __future__ import annotations
+
+import math as _math
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd as _ag
+from . import memory as _memory
+from .flags import _registry as _flag_registry
+
+__all__ = ["stats", "reset_stats", "clear_cache", "register_impl",
+           "enabled", "materialize_tensor"]
+
+_INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31
+
+# python scalar -> weak-typed device array, interned so a recurring
+# literal (the `0.25` in a loop's `add(t, 0.25)`) is the SAME jax.Array
+# every dispatch: the fused executable then takes only committed arrays
+# (pjit's C++ fast path; a raw python scalar argument re-uploads a fresh
+# scalar buffer per call) and identity-dedup collapses repeats to one
+# program slot. jnp.asarray keeps python scalars weak-typed, so
+# promotion semantics match the eager `jnp.add(x, 0.25)` exactly.
+_scalar_cache: Dict[tuple, Any] = {}
+
+
+def _intern_scalar(v):
+    key = (type(v), v)
+    if v == 0 and isinstance(v, float):
+        # 0.0 == -0.0 hash-collide but differ for sign-sensitive ops
+        # (copysign/atan2/1/x): key the sign in explicitly
+        key = (type(v), v, _math.copysign(1.0, v))
+    hit = _scalar_cache.get(key)
+    if hit is None:
+        if len(_scalar_cache) > 4096:
+            _scalar_cache.clear()
+        hit = _scalar_cache[key] = jnp.asarray(v)
+    return hit
+
+# op name -> canonical pure-JAX implementation. Registration (from
+# ops/math.py, ops/extra_math.py) pins a STRONG reference, so the fn's
+# identity is stable for the lifetime of the process: a dispatch fuses
+# only when its fn IS the registered object, which makes the structural
+# cache key (op names) a faithful key for the generated program.
+_IMPLS: Dict[str, Any] = {}
+
+# name -> bool: ops.yaml `fusable` gate (resolved lazily; ops.yaml loads
+# after the op modules that register impls)
+_YAML_OK: Dict[str, bool] = {}
+
+_flag = _flag_registry["eager_fusion"]
+_max_chain = _flag_registry["eager_fusion_max_chain"]
+_cache_cap = _flag_registry["eager_fusion_cache"]
+_nan_flag = _flag_registry["check_nan_inf"]
+
+_Tensor = None  # resolved on first dispatch (core.tensor imports us)
+
+# hot-path type handles: jax.Array/jax.core.Tracer lookups go through
+# module __getattr__ shims, and jax.Array isinstance is an ABC walk —
+# cache the names once and the concrete ArrayImpl type for a one-check
+# fast path (it covers every committed eager buffer). _ArrayImpl is
+# resolved on FIRST DISPATCH, not at import: `type(jnp.zeros(()))` here
+# would initialize the JAX backend when `import paddle_tpu` runs —
+# grabbing the exclusive TPU from every subprocess and pinning the
+# platform before user code can override it.
+_Tracer = jax.core.Tracer
+_JaxArray = jax.Array
+_ArrayImpl = None
+
+
+def register_impl(name: str, fn) -> None:
+    """Declare ``fn`` the canonical implementation of op ``name`` for
+    fusion codegen. First registration wins (e.g. math.tanh vs the
+    nn.functional wrapper): later dispatches of a DIFFERENT fn object
+    under the same name simply fall back to the eager path."""
+    _IMPLS.setdefault(name, fn)
+
+
+def enabled() -> bool:
+    # check_nan_inf wants per-op NaN attribution — a debug mode where
+    # chain-level deferral would blur the blame; turn fusion off with it
+    return bool(_flag.value) and not _nan_flag.value
+
+
+def _yaml_fusable(name: str) -> bool:
+    ok = _YAML_OK.get(name)
+    if ok is None:
+        try:
+            from ..ops.op_registry import OP_TABLE
+            info = OP_TABLE.get(name)
+            ok = bool(info and info.get("fusable") and
+                      info.get("has_vjp", True))
+        except Exception:
+            ok = False
+        _YAML_OK[name] = ok
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# expression DAG
+# ---------------------------------------------------------------------------
+
+class LazyExpr:
+    """One deferred fusable op.
+
+    ``args`` entries are LazyExpr (unmaterialized producer), Tensor
+    (concrete leaf, strong ref — the GradNode-input analog), raw array,
+    or a python scalar. ``adiff[i]`` captures, at dispatch time, whether
+    gradient flows through edge i (grad mode on AND the input was
+    differentiable then) — the fused program inserts
+    ``lax.stop_gradient`` on every adiff=False edge, reproducing the
+    per-op tape's stop_gradient semantics edge-exactly.
+    """
+
+    __slots__ = ("op", "args", "bufs", "adiff", "shape", "dtype", "weak",
+                 "rg", "nops", "val", "anchor", "tref")
+
+    def __init__(self, op, args, bufs, adiff, shape, dtype, weak, nops):
+        self.op = op
+        self.args = args
+        # per-arg buffer captured AT DISPATCH for Tensor leaves (None for
+        # expr children / raw arrays): jax arrays are immutable, so an
+        # in-place mutation of the leaf later (set_value/zero_/[...]=)
+        # only REBINDS t._buf — the flush must compute from the
+        # dispatch-time value, exactly as the eager op would have
+        self.bufs = bufs
+        self.adiff = adiff
+        self.shape = shape
+        self.dtype = dtype
+        self.weak = weak
+        self.rg = any(adiff)
+        self.nops = nops
+        self.val = None      # set at flush for live outputs
+        self.anchor = None   # strong Tensor ref after flush (grad chaining)
+        self.tref = None     # weakref to the owning Tensor
+
+
+# (op, input descriptors) -> (shape, dtype, weak_type); jax.eval_shape
+# costs ~100µs, a dict hit ~100ns — steady-state chains never re-infer
+_aval_cache: Dict[tuple, tuple] = {}
+
+
+def _infer_aval(name, fn, descs, entries):
+    key = (name,) + descs
+    hit = _aval_cache.get(key)
+    if hit is not None:
+        return hit
+    if len(_aval_cache) > 8192:  # bound it like the other fusion caches
+        _aval_cache.clear()
+    try:
+        eval_args = []
+        for d, e in zip(descs, entries):
+            if d[0] == "a":
+                try:
+                    s = jax.ShapeDtypeStruct(d[1], d[2], weak_type=d[3])
+                except TypeError:  # older jax: no weak_type kwarg
+                    s = jax.ShapeDtypeStruct(d[1], d[2])
+                eval_args.append(s)
+            else:
+                eval_args.append(e)  # python scalar, passed verbatim
+        out = jax.eval_shape(fn, *eval_args)
+        if isinstance(out, (tuple, list)):
+            return None  # fusable ops are single-output by contract
+        aval = (tuple(out.shape), np.dtype(out.dtype),
+                bool(getattr(out, "weak_type", False)))
+    except Exception:
+        return None
+    _aval_cache[key] = aval
+    return aval
+
+
+def _new_lazy_tensor(expr: LazyExpr):
+    t = _Tensor.__new__(_Tensor)
+    t._buf = None
+    t._lazy = expr
+    t.stop_gradient = not expr.rg
+    t.grad = None
+    t._node = None
+    t._out_index = 0
+    t._retain_grads = False
+    t._hooks = {}
+    t._hook_counter = 0
+    t.name = ""
+    t.trainable = False
+    t._dist_attr = None
+    expr.tref = weakref.ref(t)
+    return t
+
+
+def try_fuse(name: str, fn, args, kwargs):
+    """Defer one fusable dispatch; returns the handle Tensor, or None to
+    take the normal eager path. Hot path: isinstance dispatch is ordered
+    Tensor -> exact scalar types -> arrays, and input descriptors are
+    built inline so nothing is touched twice."""
+    global _Tensor, _ArrayImpl
+    if kwargs or _IMPLS.get(name) is not fn or not _yaml_fusable(name):
+        return None
+    if _Tensor is None:
+        from .tensor import Tensor as _T
+        _Tensor = _T
+        _ArrayImpl = type(jnp.zeros(()))
+    grad_on = _ag._state.enabled
+    entries: List[Any] = []
+    bufs: List[Any] = []
+    adiff: List[bool] = []
+    descs: List[tuple] = []
+    nops = 1
+    for a in args:
+        if isinstance(a, _Tensor):
+            lz = a._lazy
+            if lz is not None and lz.val is None:
+                d = grad_on and not a.stop_gradient \
+                    and _ag._is_diff_dtype(lz)
+                if not (d and not lz.rg):
+                    entries.append(lz)
+                    bufs.append(None)
+                    adiff.append(d)
+                    descs.append(("a", lz.shape, lz.dtype, lz.weak))
+                    nops += lz.nops
+                    continue
+                # stop_gradient was flipped to False on a chain built
+                # under no_grad: eager semantics make this tensor a grad
+                # LEAF (grads accumulate here, not through its history) —
+                # flush it so it enters the new chain as a concrete leaf
+                materialize_tensor(a, "grad_leaf")
+            buf = a._buf
+            if type(buf) is _ArrayImpl:
+                weak = buf.weak_type
+            elif isinstance(buf, np.ndarray):
+                weak = False
+            elif isinstance(buf, _JaxArray) and \
+                    not isinstance(buf, _Tracer):
+                weak = bool(getattr(buf, "weak_type", False))
+            else:
+                return None
+            entries.append(a)
+            bufs.append(buf)  # dispatch-time snapshot (mutation safety)
+            adiff.append(grad_on and not a.stop_gradient
+                         and _ag._is_diff_dtype(buf))
+            descs.append(("a", buf.shape, buf.dtype, weak))
+        else:
+            ta = type(a)
+            if ta is float or ta is int or ta is bool:
+                # huge python ints overflow the weak-int32 coercion;
+                # bail to the eager path rather than fail at trace time
+                if ta is int and not (_INT32_MIN <= a < _INT32_MAX):
+                    return None
+                s = _intern_scalar(a)
+                entries.append(s)
+                bufs.append(None)
+                adiff.append(False)
+                descs.append(("a", (), s.dtype, True))
+            elif isinstance(a, (_JaxArray, np.ndarray)):
+                if isinstance(a, _Tracer):
+                    return None
+                entries.append(a)
+                bufs.append(None)
+                adiff.append(False)
+                descs.append(("a", tuple(a.shape), a.dtype,
+                              bool(getattr(a, "weak_type", False))))
+            elif isinstance(a, (bool, int, float)):  # np scalar subclasses
+                s = _intern_scalar(a)
+                entries.append(s)
+                bufs.append(None)
+                adiff.append(False)
+                descs.append(("a", (), s.dtype, bool(s.weak_type)))
+            else:
+                return None
+    aval = _infer_aval(name, fn, tuple(descs), entries)
+    if aval is None:
+        return None
+    expr = LazyExpr(name, tuple(entries), tuple(bufs), tuple(adiff),
+                    aval[0], aval[1], aval[2], nops)
+    t = _new_lazy_tensor(expr)
+    _stats["ops_deferred"] += 1
+    if nops >= max(int(_max_chain.value or 32), 2):
+        _flush(expr, "cap")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# program cache + codegen
+# ---------------------------------------------------------------------------
+
+_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+_cache_lock = threading.Lock()
+
+
+def _build_pure(sig):
+    """Decode a structural signature into the pure fused function. It is
+    rebuilt from the signature alone — the impl registry maps op names
+    back to their canonical jnp callables — so one program serves every
+    flush with the same structure."""
+    nodes, leaf_descs, out_idx, diff_idx = sig
+    impls = tuple(_IMPLS[op] for op, _ in nodes)
+
+    def fused(*leaf_vals):
+        env: List[Any] = []
+        for (op, children), impl in zip(nodes, impls):
+            vals = []
+            for kind, j, ad in children:
+                v = env[j] if kind == "n" else leaf_vals[j]
+                if not ad:
+                    v = jax.lax.stop_gradient(v)
+                vals.append(v)
+            env.append(impl(*vals))
+        return tuple(env[i] for i in out_idx)
+
+    return fused
+
+
+def _build_program(sig):
+    """(pure fn, jitted fwd, jitted vjp) for a chain structure."""
+    diff_idx = sig[3]
+    fused = _build_pure(sig)
+    jfwd = jax.jit(fused)
+
+    def bwd(leaf_vals, cts):
+        prims = [leaf_vals[i] for i in diff_idx]
+
+        def g(*ps):
+            call = list(leaf_vals)
+            for i, p in zip(diff_idx, ps):
+                call[i] = p
+            return fused(*call)
+
+        return jax.vjp(g, *prims)[1](cts)
+
+    jbwd = jax.jit(bwd)
+    return fused, jfwd, jbwd
+
+
+_SEEN = object()  # first-sighting marker: structure noted, not compiled
+
+
+def _get_program(sig):
+    """Compile policy mirrors autograd's pair cache: a chain structure
+    only compiles on its SECOND sighting. One-off chains (test suites,
+    cold paths) run un-jitted — op-by-op jnp cost, no XLA compile — and
+    steady-state loops compile once on iteration two and hit the cache
+    thereafter. Returns (pure fn, jfwd|None, jbwd|None)."""
+    with _cache_lock:
+        entry = _cache.get(sig)
+        if entry is not None and entry is not _SEEN:
+            _cache.move_to_end(sig)
+            _stats["cache_hits"] += 1
+            return entry
+    if entry is _SEEN:
+        _stats["cache_misses"] += 1
+        built = _build_program(sig)
+        with _cache_lock:
+            _cache[sig] = built
+            cap = max(int(_cache_cap.value or 256), 8)
+            while len(_cache) > cap:
+                _cache.popitem(last=False)
+        return built
+    _stats["uncompiled_runs"] += 1
+    with _cache_lock:
+        _cache[sig] = _SEEN
+        cap = max(int(_cache_cap.value or 256), 8)
+        while len(_cache) > cap:
+            _cache.popitem(last=False)
+    return _build_pure(sig), None, None
+
+
+# ---------------------------------------------------------------------------
+# flush
+# ---------------------------------------------------------------------------
+
+def materialize_tensor(t, reason: str = "host_read") -> None:
+    """Flush the chain the lazy tensor ``t`` heads (no-op if concrete)."""
+    lz = t._lazy
+    if lz is None:
+        return
+    if lz.val is not None:  # flushed via a shared DAG; just bind
+        t._lazy = None
+        if t._buf is None:
+            t._buf = lz.val
+        return
+    _flush(lz, reason)
+
+
+def _flush(root: LazyExpr, reason: str) -> None:
+    # -- collect the reachable unmaterialized DAG (postorder) ------------
+    order: List[LazyExpr] = []
+    node_index: Dict[int, int] = {}
+    leaf_vals: List[Any] = []
+    leaf_tensors: List[Optional[Any]] = []
+    leaf_descs: List[tuple] = []
+    leaf_index: Dict[int, int] = {}
+    sig_nodes: List[tuple] = []
+
+    def leaf_slot(a, buf):
+        # scalars were interned to arrays at dispatch, so every leaf is
+        # LazyExpr (materialized earlier) / Tensor / raw array
+        if type(a) is LazyExpr:
+            key, val, tens = id(a), a.val, a.anchor
+        elif buf is not None:
+            # Tensor leaf: use the dispatch snapshot. Key by BOTH the
+            # buffer and the tensor: same tensor mutated between
+            # dispatches -> distinct slots (different bufs), while two
+            # tensors SHARING one buffer (x and x.detach()) also stay
+            # distinct — merging them would let the first-seen tensor's
+            # grad identity swallow the other's cotangent
+            key, val, tens = (id(buf), id(a)), buf, a
+        else:
+            key, val, tens = id(a), a, None
+        idx = leaf_index.get(key)
+        if idx is None:
+            idx = leaf_index[key] = len(leaf_vals)
+            leaf_vals.append(val)
+            leaf_tensors.append(tens)
+            leaf_descs.append(("a", val.shape, val.dtype,
+                               bool(getattr(val, "weak_type", False))))
+        return idx
+
+    seen = set()
+    stack: List[Tuple[LazyExpr, int]] = [(root, 0)]
+    while stack:
+        e, phase = stack.pop()
+        if phase == 0:
+            if id(e) in seen:
+                continue
+            seen.add(id(e))
+            stack.append((e, 1))
+            for a in e.args:
+                if isinstance(a, LazyExpr) and a.val is None and \
+                        id(a) not in seen:
+                    stack.append((a, 0))
+        else:
+            children = []
+            for a, buf, ad in zip(e.args, e.bufs, e.adiff):
+                if isinstance(a, LazyExpr) and a.val is None:
+                    children.append(("n", node_index[id(a)], ad))
+                else:
+                    children.append(("l", leaf_slot(a, buf), ad))
+            node_index[id(e)] = len(order)
+            order.append(e)
+            sig_nodes.append((e.op, tuple(children)))
+
+    # -- outputs: every node whose Tensor handle is still alive ----------
+    out_idx = []
+    out_tensors = []
+    for i, e in enumerate(order):
+        t = e.tref() if e.tref is not None else None
+        # the handle must still OWN this expr: a direct `t._data = ...`
+        # rebind discarded the chain for t, and binding here would
+        # silently revert the user's buffer to the stale fused value.
+        # (The expr itself stays valid for OTHER pending consumers,
+        # which by eager semantics see the dispatch-time value.)
+        if t is not None and t._lazy is e:
+            out_idx.append(i)
+            out_tensors.append(t)
+
+    # Live requires-grad INTERIOR tensors must sit on real tape edges —
+    # eager users inspect them later (paddle.grad(loss, [y]), post-hoc
+    # retain_grads()/register_hook()), and a single fused GradNode only
+    # exposes the chain's leaves. Cut the chain there: flush each such
+    # producer first (its own GradNode, producers-before-consumers via
+    # the postorder), then re-walk — the cut points re-enter as concrete
+    # anchored leaves. Hot loops never hit this: their intermediates are
+    # dead by flush time.
+    root_i = node_index[id(root)]
+    cuts = [order[i] for i in out_idx if i != root_i and order[i].rg]
+    if cuts:
+        for e in cuts:
+            if e.val is None:
+                _flush(e, reason)
+        _flush(root, reason)
+        return
+
+    if not out_idx:  # root's handle died mid-flush; nothing observes it
+        out_idx = [root_i]
+        out_tensors = [None]
+
+    diff_set = set()
+    for op, children in sig_nodes:
+        for kind, j, ad in children:
+            if kind == "l" and ad:
+                diff_set.add(j)
+    diff_idx = tuple(sorted(diff_set))
+
+    sig = (tuple(sig_nodes), tuple(leaf_descs), tuple(out_idx), diff_idx)
+    fused, jfwd, jbwd = _get_program(sig)
+
+    if jfwd is None:  # first sighting of this structure: run un-jitted
+        outs = fused(*leaf_vals)
+    else:
+        try:
+            outs = jfwd(*leaf_vals)
+        except FloatingPointError:
+            raise
+        except Exception:
+            # jit-specific failure (e.g. resource pressure during the
+            # compile): the un-jitted trace has identical semantics
+            _stats["jit_fallbacks"] += 1
+            outs = fused(*leaf_vals)
+
+    # -- grad wiring: ONE GradNode over the fused program ----------------
+    node = None
+    if diff_idx and any(order[i].rg for i in out_idx):
+        diff_tensors = tuple(leaf_tensors[i] for i in diff_idx)
+        out_avals = tuple(_ag._Aval(o.shape, o.dtype) for o in outs)
+        datas = list(leaf_vals)
+
+        def vjp_fn(cts, _lv=tuple(leaf_vals), _jb=jbwd):
+            if _jb is not None:
+                try:
+                    return _jb(_lv, cts)
+                except FloatingPointError:
+                    raise
+                except Exception:
+                    pass  # exotic cotangent (float0/sparse): retrace
+            # un-compiled first sighting, or jitted-vjp bail: one plain
+            # jax.vjp retrace with identical semantics
+            prims = [_lv[i] for i in diff_idx]
+
+            def g(*ps):
+                call = list(_lv)
+                for i, p in zip(diff_idx, ps):
+                    call[i] = p
+                return fused(*call)
+
+            return jax.vjp(g, *prims)[1](cts)
+
+        node = _ag.GradNode(vjp_fn, diff_tensors, out_avals, "fused_chain",
+                            fn=fused, datas=datas, kwargs={},
+                            diff_idx=list(diff_idx))
+
+    _ag._maybe_check_nan_inf("fused_chain", outs)
+
+    # -- bind results back into the live handles -------------------------
+    for k, (i, t) in enumerate(zip(out_idx, out_tensors)):
+        if t is None:
+            continue  # dead handle: value unobservable, keep expr interior
+        e = order[i]
+        o = outs[k]
+        _memory.track(o)
+        e.val = o
+        e.anchor = t  # strong: later chains grad-link through this Tensor
+        t._buf = o
+        t._lazy = None
+        if node is not None and e.rg:
+            t._node = node
+            t._out_index = k
+
+    _stats["chains_flushed"] += 1
+    _stats["ops_fused"] += len(order)
+    _stats["flush_reasons"][reason] = \
+        _stats["flush_reasons"].get(reason, 0) + 1
+    h = _stats["chain_length_hist"]
+    h[len(order)] = h.get(len(order), 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def _fresh_stats() -> Dict[str, Any]:
+    return {
+        "ops_deferred": 0,      # fusable dispatches deferred into DAGs
+        "chains_flushed": 0,    # fused programs executed
+        "ops_fused": 0,         # total ops executed through fused programs
+        "cache_hits": 0,        # flushes served by a cached executable
+        "cache_misses": 0,      # flushes that compiled a new program
+        "uncompiled_runs": 0,   # first-sighting flushes run un-jitted
+        "jit_fallbacks": 0,     # flushes that fell back to un-jitted eval
+        "flush_reasons": {},    # reason -> count
+        "chain_length_hist": {},  # ops-per-chain -> count
+    }
+
+
+_stats = _fresh_stats()
+
+
+def stats() -> Dict[str, Any]:
+    """Counter snapshot: chains built, cache hits/misses, flush reasons,
+    ops-per-chain histogram, live cache size."""
+    snap = dict(_stats)
+    snap["flush_reasons"] = dict(_stats["flush_reasons"])
+    snap["chain_length_hist"] = dict(_stats["chain_length_hist"])
+    snap["cache_size"] = len(_cache)
+    snap["avg_ops_per_chain"] = (
+        _stats["ops_fused"] / _stats["chains_flushed"]
+        if _stats["chains_flushed"] else 0.0)
+    return snap
+
+
+def reset_stats() -> None:
+    global _stats
+    _stats = _fresh_stats()
+
+
+def clear_cache() -> None:
+    with _cache_lock:
+        _cache.clear()
+    _aval_cache.clear()
+    _scalar_cache.clear()

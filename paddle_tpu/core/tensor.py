@@ -28,14 +28,15 @@ _mutation_hook = None
 
 
 class Tensor:
-    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
-                 "_retain_grads", "_hooks", "_hook_counter", "name",
-                 "trainable", "__weakref__", "_dist_attr",
+    __slots__ = ("_buf", "_lazy", "stop_gradient", "grad", "_node",
+                 "_out_index", "_retain_grads", "_hooks", "_hook_counter",
+                 "name", "trainable", "__weakref__", "_dist_attr",
                  "_static_feed_name", "_static_rng")
 
     def __init__(self, data, stop_gradient: bool = True, node=None,
                  out_index: int = 0, name: Optional[str] = None):
-        self._data = data
+        self._buf = data
+        self._lazy = None
         self.stop_gradient = stop_gradient
         self.grad = None
         self._node = node
@@ -47,6 +48,24 @@ class Tensor:
         self.trainable = False
         self._dist_attr = None
 
+    # -- lazy-eager fusion seam ---------------------------------------------
+    # ``_data`` is the universal flush point: any consumer that needs the
+    # concrete device buffer (host reads, non-fusable ops, backward,
+    # mutation) reads this property, and a pending fused chain
+    # materializes exactly there. Shape/dtype introspection below stays
+    # lazy — it answers from the inferred aval without forcing the chain.
+    @property
+    def _data(self):
+        if self._lazy is not None:
+            from . import fusion
+            fusion.materialize_tensor(self, "host_read")
+        return self._buf
+
+    @_data.setter
+    def _data(self, value):
+        self._lazy = None  # rebinding the buffer discards a pending chain
+        self._buf = value
+
     # -- basic properties ---------------------------------------------------
     @property
     def data(self):
@@ -54,19 +73,30 @@ class Tensor:
 
     @property
     def shape(self):
-        return list(self._data.shape)
+        lz = self._lazy
+        if lz is not None:
+            return list(lz.shape)
+        return list(self._buf.shape)
 
     @property
     def ndim(self):
-        return self._data.ndim
+        lz = self._lazy
+        if lz is not None:
+            return len(lz.shape)
+        return self._buf.ndim
 
     @property
     def size(self):
-        return int(np.prod(self._data.shape)) if self._data.shape else 1
+        shape = tuple(self._lazy.shape) if self._lazy is not None \
+            else self._buf.shape
+        return int(np.prod(shape)) if shape else 1
 
     @property
     def dtype(self):
-        return np.dtype(self._data.dtype)
+        lz = self._lazy
+        if lz is not None:
+            return np.dtype(lz.dtype)
+        return np.dtype(self._buf.dtype)
 
     @property
     def place(self):
@@ -75,13 +105,16 @@ class Tensor:
 
     @property
     def is_leaf(self):
+        lz = self._lazy
+        if lz is not None and lz.rg:
+            return False  # the pending fused chain will attach a node
         return self._node is None
 
     def numel(self):
         return self.size
 
     def dim(self):
-        return self._data.ndim
+        return self.ndim
 
     # -- host interop -------------------------------------------------------
     def numpy(self):
@@ -117,9 +150,9 @@ class Tensor:
         return bool(self.item())
 
     def __len__(self):
-        if self._data.ndim == 0:
+        if self.ndim == 0:
             raise TypeError("len() of a 0-d tensor")
-        return self._data.shape[0]
+        return self.shape[0]
 
     def __repr__(self):
         grad_str = "" if self.stop_gradient else ", stop_gradient=False"
@@ -144,10 +177,21 @@ class Tensor:
             self.grad = None
 
     def retain_grads(self):
+        # a pending fused chain has no per-tensor tape node to retain a
+        # grad at; flush so this tensor becomes a grad-graph boundary
+        if self._lazy is not None:
+            from . import fusion
+            fusion.materialize_tensor(self, "retain_grads")
         self._retain_grads = True
 
     def register_hook(self, hook):
         """ref: tensor_patch_methods.py register_hook; returns removable handle."""
+        if self._lazy is not None:
+            # hooks observe the gradient flowing INTO this tensor, which
+            # requires it to sit on a tape edge — flush the fused chain
+            # so subsequent ops consume it as a concrete grad leaf
+            from . import fusion
+            fusion.materialize_tensor(self, "hook")
         hook_id = self._hook_counter
         self._hook_counter += 1
         self._hooks[hook_id] = hook
@@ -163,6 +207,11 @@ class Tensor:
         return t
 
     def detach_(self):
+        if self._lazy is not None:
+            # flush first: a later chain flush would re-attach the fused
+            # node, resurrecting the edge detach_ is meant to sever
+            from . import fusion
+            fusion.materialize_tensor(self, "detach")
         self._node = None
         self.stop_gradient = True
         return self

@@ -13,9 +13,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import fusion as _fusion
 from ..core.autograd import apply_op
 from ..core import random as random_mod
 from ..core.tensor import Tensor
+
+# elementwise extra_math ops that opt into lazy-eager chain fusion
+# (ops.yaml flags them `fusable`); the registered object must be the
+# exact fn each wrapper dispatches through apply_op
+_fusion.register_impl("sinc", jnp.sinc)
+_fusion.register_impl("copysign", jnp.copysign)
+_fusion.register_impl("rad2deg", jnp.rad2deg)
+_fusion.register_impl("deg2rad", jnp.deg2rad)
 
 __all__ = [
     "addmm", "add_n", "as_complex", "as_real", "block_diag",

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import fusion as _fusion
 from ..core.autograd import apply_op
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
@@ -26,6 +27,10 @@ def _t(x):
 
 
 def _unary(jfn, name):
+    # pin jfn as the op's canonical impl for lazy-eager chain fusion;
+    # whether dispatches actually defer is gated by ops.yaml `fusable`
+    _fusion.register_impl(name, jfn)
+
     def op(x, name=None):
         return apply_op(jfn, _t(x), op_name=name)
     op.__name__ = name
@@ -33,6 +38,8 @@ def _unary(jfn, name):
 
 
 def _binary(jfn, name):
+    _fusion.register_impl(name, jfn)
+
     def op(x, y, name=None):
         return apply_op(jfn, _t(x), _t(y), op_name=name)
     op.__name__ = name

@@ -19,6 +19,79 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 OP_TABLE: Dict[str, dict] = {}
 
 
+# YAML 1.1 scalar resolution, mirroring PyYAML's SafeLoader resolvers
+# exactly (tests assert agreement): bool WORDS in their three accepted
+# casings; ints incl. sign/underscores and the 0x/0o-less octal, hex,
+# binary forms; floats REQUIRE a dot (so `1e5` stays a string, as
+# PyYAML resolves it). The old ``int(v) if v.isdigit()`` mis-parsed
+# ``-1``/``1.5e-3`` as strings — silent descriptor corruption when
+# PyYAML is absent.
+import re as _re
+
+_YAML_BOOLS = {}
+for _w, _b in (("yes", True), ("no", False), ("true", True),
+               ("false", False), ("on", True), ("off", False)):
+    for _form in (_w, _w.capitalize(), _w.upper()):
+        _YAML_BOOLS[_form] = _b
+_YAML_NULLS = {"", "~", "null", "Null", "NULL"}
+_YAML_INT = _re.compile(
+    r"^[-+]?(0b[0-1_]+|0x[0-9a-fA-F_]+|0[0-7_]+|(0|[1-9][0-9_]*))$")
+_YAML_FLOAT = _re.compile(  # YAML 1.1: the exponent SIGN is mandatory
+    r"^[-+]?([0-9][0-9_]*\.[0-9_]*([eE][-+][0-9]+)?"
+    r"|\.[0-9_]+([eE][-+][0-9]+)?)$")
+_YAML_INF = _re.compile(r"^[-+]?\.(inf|Inf|INF)$")
+_YAML_NAN = _re.compile(r"^\.(nan|NaN|NAN)$")
+
+
+def _parse_scalar(v: str):
+    if len(v) >= 2 and v[0] == v[-1] and v[0] in ("'", '"'):
+        return v[1:-1]
+    if v in _YAML_NULLS:
+        return None
+    b = _YAML_BOOLS.get(v)
+    if b is not None:
+        return b
+    if _YAML_INT.match(v):
+        s = v.replace("_", "")
+        sign, mag = (s[0], s[1:]) if s[0] in "+-" else ("", s)
+        try:
+            if mag.startswith(("0b", "0x")):
+                n = int(mag, 0)
+            elif mag.startswith("0") and mag != "0":
+                n = int(mag, 8)  # YAML 1.1 leading-zero octal
+            else:
+                n = int(mag)
+        except ValueError:  # degenerate all-underscore digits
+            return v
+        return -n if sign == "-" else n
+    if _YAML_FLOAT.match(v):
+        return float(v.replace("_", ""))
+    if _YAML_INF.match(v):
+        return float("-inf") if v[0] == "-" else float("inf")
+    if _YAML_NAN.match(v):
+        return float("nan")
+    return v
+
+
+def _parse_yaml_fallback(text: str) -> list:
+    """Minimal parser for our flat ``ops:`` list-of-mappings schema;
+    asserted against PyYAML in tests/test_ops_yaml_coverage.py."""
+    ops, cur = [], None
+    for line in text.splitlines():
+        s = line.strip()
+        if s.startswith("#") or not s:
+            continue
+        if s.startswith("- name:"):
+            cur = {"name": _parse_scalar(s.split(":", 1)[1].strip())}
+            ops.append(cur)
+        elif cur is not None and ":" in s and s != "ops:":
+            # exact header match: a prefix test would silently drop any
+            # future descriptor key that happens to start with "ops"
+            k, v = s.split(":", 1)
+            cur[k.strip()] = _parse_scalar(v.strip())
+    return ops
+
+
 def _load_yaml() -> list:
     path = os.path.join(_HERE, "ops.yaml")
     with open(path) as f:
@@ -26,21 +99,8 @@ def _load_yaml() -> list:
     try:
         import yaml
         return yaml.safe_load(text)["ops"]
-    except ImportError:  # minimal fallback parser for our flat schema
-        ops, cur = [], None
-        for line in text.splitlines():
-            s = line.strip()
-            if s.startswith("#") or not s:
-                continue
-            if s.startswith("- name:"):
-                cur = {"name": s.split(":", 1)[1].strip()}
-                ops.append(cur)
-            elif cur is not None and ":" in s and not s.startswith("ops"):
-                k, v = s.split(":", 1)
-                v = v.strip()
-                cur[k.strip()] = (v == "true" if v in ("true", "false")
-                                  else int(v) if v.isdigit() else v)
-        return ops
+    except ImportError:
+        return _parse_yaml_fallback(text)
 
 
 def _register_all():
@@ -56,6 +116,10 @@ def _register_all():
             # variadic ops (concat/stack/einsum/...) dispatch one
             # positional per tensor: the arity gate skips the cap
             "variadic": bool(entry.get("variadic", False)),
+            # elementwise ops eligible for lazy-eager chain fusion
+            # (core/fusion.py); Python-mirror-only — the native
+            # descriptor layout predates the field
+            "fusable": bool(entry.get("fusable", False)),
         }
         OP_TABLE[name] = info
         if lib is not None:

@@ -1,0 +1,396 @@
+"""Lazy-eager elementwise fusion: equivalence, flush triggers, caching.
+
+The fusion runtime (core/fusion.py) defers ops flagged ``fusable`` in
+ops/ops.yaml into per-chain jitted executables. These tests pin the
+contract:
+
+* numerical equivalence fused vs. eager across every fusable op,
+  forward AND gradient (via ``backward()``), under BOTH
+  ``FLAGS_eager_fusion`` settings (the kill switch must restore the
+  exact pre-fusion path);
+* flush-trigger correctness — host read, non-fusable op boundary,
+  in-place mutation, ``backward()``, chain-length cap;
+* steady-state caching — a 12-op chain compiles at most once after
+  warmup (≤1 new compile, the rest cache hits).
+"""
+import numpy as np
+import pytest
+import yaml
+
+import paddle_tpu as paddle
+from paddle_tpu.core import fusion
+from paddle_tpu.core.flags import get_flags, set_flags
+
+RNG = np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _restore_fusion_flags():
+    prev = get_flags(["FLAGS_eager_fusion", "FLAGS_eager_fusion_max_chain"])
+    yield
+    set_flags(prev)
+
+
+def _fusable_names():
+    d = yaml.safe_load(open("paddle_tpu/ops/ops.yaml"))["ops"]
+    return sorted({o["name"] for o in d if o.get("fusable")})
+
+
+FUSABLE = _fusable_names()
+
+# input domains: (generator per positional tensor arg)
+_POS = {"log", "log10", "log1p", "log2", "sqrt", "rsqrt", "lgamma",
+        "digamma", "reciprocal"}
+_UNIT = {"asin", "acos", "atanh", "erfinv"}
+_GE1 = {"acosh"}
+_BINARY = {"add", "subtract", "multiply", "divide", "maximum", "minimum",
+           "fmax", "fmin", "atan2", "hypot", "logaddexp", "pow", "mod",
+           "copysign"}
+
+
+def _make_inputs(name):
+    if name in _POS:
+        arrs = [(RNG.random((3, 4)) + 0.5).astype(np.float32)]
+    elif name in _UNIT:
+        arrs = [(RNG.random((3, 4)) * 1.6 - 0.8).astype(np.float32)]
+    elif name in _GE1:
+        arrs = [(RNG.random((3, 4)) + 1.5).astype(np.float32)]
+    elif name in _BINARY:
+        arrs = [RNG.standard_normal((3, 4)).astype(np.float32),
+                (RNG.random((3, 4)) + 0.5).astype(np.float32)]
+    else:
+        arrs = [RNG.standard_normal((3, 4)).astype(np.float32)]
+    return arrs
+
+
+def _run_chain(name, arrs, fused):
+    """op under test embedded in a small fusable chain; returns
+    (output ndarray, [input grad ndarrays])."""
+    set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
+    fn = getattr(paddle, name)
+    ts = [paddle.to_tensor(a, stop_gradient=False) for a in arrs]
+    z = fn(*ts)
+    w = paddle.add(paddle.multiply(z, 0.5), 0.25)  # extend the chain
+    if fused:
+        assert w._lazy is not None, f"{name}: chain did not defer"
+    else:
+        assert w._lazy is None, f"{name}: kill switch did not disable"
+    s = paddle.sum(w)  # non-fusable boundary + backward root
+    s.backward()
+    grads = [None if t.grad is None else t.grad.numpy() for t in ts]
+    return w.numpy(), grads
+
+
+@pytest.mark.parametrize("name", FUSABLE)
+def test_fused_matches_eager(name):
+    arrs = _make_inputs(name)
+    out_f, g_f = _run_chain(name, [a.copy() for a in arrs], fused=True)
+    out_e, g_e = _run_chain(name, [a.copy() for a in arrs], fused=False)
+    np.testing.assert_allclose(out_f, out_e, rtol=1e-5, atol=1e-6,
+                               err_msg=f"{name}: fused forward mismatch")
+    assert len(g_f) == len(g_e)
+    for i, (gf, ge) in enumerate(zip(g_f, g_e)):
+        assert (gf is None) == (ge is None), (name, i)
+        if gf is not None:
+            np.testing.assert_allclose(
+                gf, ge, rtol=1e-5, atol=1e-6,
+                err_msg=f"{name}: fused grad mismatch (input {i})")
+
+
+class TestFlushTriggers:
+    def _chain(self, x, b):
+        t = x
+        for _ in range(3):
+            t = paddle.multiply(t, b)
+            t = paddle.add(t, 0.5)
+        return t
+
+    def test_host_read_flushes(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        z = self._chain(x, x)
+        assert z._lazy is not None
+        before = fusion.stats()["flush_reasons"].get("host_read", 0)
+        z.numpy()
+        assert z._lazy is None
+        assert fusion.stats()["flush_reasons"]["host_read"] == before + 1
+
+    def test_non_fusable_boundary_flushes(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        z = self._chain(x, x)
+        assert z._lazy is not None
+        before = fusion.stats()["flush_reasons"].get("op_boundary", 0)
+        s = paddle.sum(z)  # reduction: not fusable
+        assert z._lazy is None
+        assert fusion.stats()["flush_reasons"]["op_boundary"] == before + 1
+        assert s.numpy() == pytest.approx(float(np.sum(z.numpy())))
+
+    def test_inplace_mutation_flushes(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
+        z = self._chain(x, x)
+        assert z._lazy is not None
+        z[0, 0] = 99.0  # __setitem__ routes through the _data property
+        assert z._lazy is None
+        expect = np.array(self._chain(paddle.to_tensor(
+            np.full((2, 3), 2.0, np.float32)),
+            paddle.to_tensor(np.full((2, 3), 2.0, np.float32))).numpy())
+        expect[0, 0] = 99.0
+        np.testing.assert_allclose(z.numpy(), expect)
+
+    def test_leaf_mutation_after_defer_uses_dispatch_value(self):
+        """Mutating a LEAF after a dependent chain deferred must not
+        change the chain's result: the flush computes from the
+        dispatch-time buffer, exactly as the eager op would have."""
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        y = paddle.exp(x)      # deferred, reads x@dispatch
+        x.zero_()              # rebinds x's buffer
+        np.testing.assert_allclose(y.numpy(), np.e, rtol=1e-6)
+        z = paddle.add(y, x)   # new chain sees the MUTATED x
+        np.testing.assert_allclose(z.numpy(), np.e, rtol=1e-6)
+
+    def test_detach_alias_keeps_grad_identity(self):
+        """x and x.detach() share one buffer but are distinct grad
+        leaves: the fused program must not merge their slots."""
+        def run(fused):
+            set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
+            x = paddle.to_tensor(np.float32([2.0]), stop_gradient=False)
+            d = x.detach()
+            y = paddle.multiply(d, x)  # detached alias FIRST
+            paddle.sum(y).backward()
+            return None if x.grad is None else float(x.grad.numpy())
+        gf, ge = run(True), run(False)
+        assert gf == ge == pytest.approx(2.0)
+
+    def test_signed_zero_scalar_not_conflated(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        t = paddle.to_tensor(np.float32([3.0]))
+        pos = paddle.copysign(t, 0.0)
+        neg = paddle.copysign(t, -0.0)
+        np.testing.assert_allclose(pos.numpy(), [3.0])
+        np.testing.assert_allclose(neg.numpy(), [-3.0])
+
+    def test_set_value_discards_chain(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        z = paddle.add(x, 1.0)
+        z.set_value(np.zeros((2, 2), np.float32))
+        assert z._lazy is None
+        np.testing.assert_allclose(z.numpy(), 0.0)
+
+    def test_rebind_with_pending_consumer_not_reverted(self):
+        """A direct _data rebind discards y's chain; a later flush of a
+        consumer that captured y's expr must not resurrect the stale
+        fused value into y, while the consumer itself still sees y's
+        dispatch-time value (eager semantics)."""
+        import jax.numpy as jnp
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        y = paddle.exp(x)                 # lazy
+        z = paddle.add(y, 1.0)            # pending consumer of y's expr
+        y._data = jnp.zeros((2, 2), jnp.float32)  # no-read rebind
+        np.testing.assert_allclose(z.numpy(), np.e + 1.0, rtol=1e-6)
+        np.testing.assert_allclose(y.numpy(), 0.0)  # user value kept
+
+    def test_backward_flushes(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.float32(0.7), stop_gradient=False)
+        z = paddle.multiply(paddle.sin(x), paddle.cos(x))
+        assert z._lazy is not None
+        before = fusion.stats()["flush_reasons"].get("backward", 0)
+        z.backward()
+        assert fusion.stats()["flush_reasons"]["backward"] == before + 1
+        expect = float(np.cos(0.7) ** 2 - np.sin(0.7) ** 2)
+        assert float(x.grad.numpy()) == pytest.approx(expect, rel=1e-5)
+
+    def test_chain_cap_flushes(self):
+        set_flags({"FLAGS_eager_fusion": 1,
+                   "FLAGS_eager_fusion_max_chain": 6})
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        before = fusion.stats()["flush_reasons"].get("cap", 0)
+        t = x
+        for _ in range(10):
+            t = paddle.add(t, 1.0)
+        assert fusion.stats()["flush_reasons"].get("cap", 0) > before
+        np.testing.assert_allclose(t.numpy(), 11.0)
+
+    def test_lazy_shape_introspection_does_not_flush(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        z = paddle.add(x, 1.0)
+        assert z._lazy is not None
+        assert z.shape == [2, 3]
+        assert z.ndim == 2 and z.size == 6
+        assert z.dtype == np.float32
+        assert len(z) == 2
+        assert z._lazy is not None  # aval answered without materializing
+
+
+class TestCaching:
+    def test_12op_chain_steady_state_compiles_at_most_once(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(
+            RNG.standard_normal((8, 8)).astype(np.float32),
+            stop_gradient=False)
+        b = paddle.to_tensor(
+            RNG.standard_normal((8, 8)).astype(np.float32))
+
+        def chain(t):
+            for _ in range(4):
+                t = paddle.multiply(t, b)
+                t = paddle.add(t, b)
+                t = paddle.subtract(t, 0.125)
+            return t
+
+        for _ in range(3):  # warmup
+            chain(x).numpy()
+        s0 = fusion.stats()
+        for _ in range(10):
+            chain(x).numpy()
+        s1 = fusion.stats()
+        assert s1["chains_flushed"] - s0["chains_flushed"] == 10
+        assert s1["cache_misses"] - s0["cache_misses"] <= 1, \
+            "steady-state 12-op chain must hit the fusion cache"
+        assert s1["cache_hits"] - s0["cache_hits"] >= 9
+        # ops-per-chain histogram sees the 12-op chains
+        assert s1["chain_length_hist"].get(12, 0) >= \
+            s0["chain_length_hist"].get(12, 0) + 9
+
+    def test_kill_switch_restores_eager_path(self):
+        set_flags({"FLAGS_eager_fusion": 0})
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        s0 = fusion.stats()["ops_deferred"]
+        z = paddle.add(paddle.multiply(x, 2.0), 1.0)
+        assert z._lazy is None  # executed immediately, pre-PR path
+        assert fusion.stats()["ops_deferred"] == s0
+        np.testing.assert_allclose(z.numpy(), 3.0)
+
+
+class TestGradSemantics:
+    def test_shared_subexpression_grads(self):
+        """Diamond DAG: u feeds two consumers; grads accumulate once per
+        path, exactly as the per-op tape would."""
+        def run(fused):
+            set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
+            x = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+            u = paddle.multiply(x, 2.0)
+            a = paddle.add(u, 1.0)
+            c = paddle.multiply(u, a)  # u used twice
+            paddle.sum(c).backward()
+            return float(c.numpy()), float(x.grad.numpy())
+        cf, gf = run(True)
+        ce, ge = run(False)
+        assert cf == pytest.approx(ce, rel=1e-6)
+        assert gf == pytest.approx(ge, rel=1e-6)
+
+    def test_partial_flush_then_continue(self):
+        """Reading an intermediate mid-chain materializes it; the rest of
+        the chain keeps building and grads still match eager."""
+        def run(fused):
+            set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
+            x = paddle.to_tensor(np.float32(0.3), stop_gradient=False)
+            u = paddle.sin(x)
+            _ = u.numpy()  # mid-chain host read
+            z = paddle.multiply(u, u)
+            paddle.sum(z).backward()
+            return float(z.numpy()), float(x.grad.numpy())
+        zf, gf = run(True)
+        ze, ge = run(False)
+        assert zf == pytest.approx(ze, rel=1e-6)
+        assert gf == pytest.approx(ge, rel=1e-6)
+
+    def test_no_grad_segment_blocks_gradient(self):
+        def run(fused):
+            set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
+            x = paddle.to_tensor(np.float32(0.4), stop_gradient=False)
+            with paddle.no_grad():
+                frozen = paddle.multiply(x, 3.0)
+            z = paddle.add(paddle.multiply(x, 2.0), frozen)
+            paddle.sum(z).backward()
+            return float(z.numpy()), float(x.grad.numpy())
+        zf, gf = run(True)
+        ze, ge = run(False)
+        assert zf == pytest.approx(ze, rel=1e-6)
+        assert gf == pytest.approx(ge, rel=1e-6)  # 2.0: no_grad leg cut
+
+    def test_functional_grad_through_fused_chain(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.float32(0.9), stop_gradient=False)
+        y = paddle.multiply(paddle.exp(x), 2.0)
+        (g,) = paddle.grad(y, [x])
+        assert float(g.numpy()) == pytest.approx(
+            2.0 * float(np.exp(0.9)), rel=1e-5)
+
+    def test_double_grad_through_fused_chain(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.float32(0.6), stop_gradient=False)
+        y = paddle.multiply(paddle.sin(x), paddle.sin(x))
+        (g,) = paddle.grad(y, [x], create_graph=True)
+        (gg,) = paddle.grad(g, [x])
+        # d2/dx2 sin^2 = 2 cos(2x)
+        assert float(gg.numpy()) == pytest.approx(
+            2.0 * float(np.cos(1.2)), rel=1e-4)
+
+    def test_hook_on_lazy_intermediate(self):
+        def run(fused):
+            set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
+            x = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+            u = paddle.multiply(x, 2.0)
+            seen = []
+            u.register_hook(lambda g: seen.append(float(g.numpy())))
+            z = paddle.multiply(u, 4.0)
+            paddle.sum(z).backward()
+            return seen, float(x.grad.numpy())
+        sf, gf = run(True)
+        se, ge = run(False)
+        assert sf == se == [4.0]
+        assert gf == ge == pytest.approx(8.0)
+
+    def test_live_intermediate_is_a_tape_edge(self):
+        """A HELD requires-grad intermediate must stay inspectable after
+        the chain flushes: functional grad, post-hoc retain_grads, and
+        post-hoc hooks all behave exactly as eager."""
+        def run(fused):
+            set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
+            x = paddle.to_tensor(np.ones(3, np.float32),
+                                 stop_gradient=False)
+            y = paddle.multiply(x, 2.0)   # held intermediate
+            z = paddle.multiply(y, 3.0)
+            loss = paddle.sum(z)          # flush boundary
+            (gy,) = paddle.grad(loss, [y], retain_graph=True)
+            return None if gy is None else gy.numpy().tolist()
+        assert run(True) == run(False) == [3.0, 3.0, 3.0]
+
+    def test_posthoc_retain_grads_and_hook(self):
+        def run(fused):
+            set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
+            x = paddle.to_tensor(np.ones(2, np.float32),
+                                 stop_gradient=False)
+            y = paddle.multiply(x, 2.0)
+            z = paddle.multiply(y, 3.0)
+            loss = paddle.sum(z)          # chain flushed here
+            seen = []
+            y.retain_grads()              # AFTER the flush
+            y.register_hook(lambda g: seen.append(g.numpy().tolist()))
+            loss.backward()
+            yg = None if y.grad is None else y.grad.numpy().tolist()
+            return yg, seen
+        assert run(True) == run(False) == ([3.0, 3.0], [[3.0, 3.0]])
+
+    def test_fused_node_appears_on_tape(self):
+        set_flags({"FLAGS_eager_fusion": 1})
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        z = paddle.add(paddle.multiply(x, 3.0), 1.0)
+        z.numpy()
+        assert z._node is not None and z._node.name == "fused_chain"
+        assert not z.stop_gradient
+
+
+def test_stats_surface_shape():
+    s = fusion.stats()
+    for key in ("ops_deferred", "chains_flushed", "ops_fused",
+                "cache_hits", "cache_misses", "flush_reasons",
+                "chain_length_hist", "cache_size", "avg_ops_per_chain"):
+        assert key in s

@@ -94,6 +94,11 @@ spec("isinf", lambda: [np.array([1.0, np.nan, np.inf], np.float32)],
      np.isinf, grad=False)
 spec("isfinite", lambda: [np.array([1.0, np.nan, np.inf], np.float32)],
      np.isfinite, grad=False)
+spec("sinc", lambda: [_std(3, 4)], np.sinc)
+spec("copysign", lambda: [_std(3, 4), _std(3, 4)], np.copysign,
+     grad=False)
+spec("rad2deg", lambda: [_std(3, 4)], np.rad2deg)
+spec("deg2rad", lambda: [_std(3, 4)], np.deg2rad)
 
 # -- binary math -------------------------------------------------------------
 _BINARY = {
@@ -659,6 +664,71 @@ def test_check_grad(name):
             assert abs(numeric - analytic) <= \
                 5e-2 * max(1.0, abs(numeric), abs(analytic)), \
                 (name, i, pos, analytic, numeric)
+
+
+def test_fallback_parser_agrees_with_pyyaml():
+    """The PyYAML-free fallback parser must produce the exact structure
+    PyYAML does for ops.yaml AND for the scalar forms it historically
+    mis-parsed (negatives, floats, exponents, quoted strings)."""
+    from paddle_tpu.ops.op_registry import _parse_yaml_fallback
+    text = open("paddle_tpu/ops/ops.yaml").read()
+    assert _parse_yaml_fallback(text) == yaml.safe_load(text)["ops"]
+
+    snippet = "\n".join([
+        "ops:",
+        "  - name: demo",
+        "    module: math",
+        "    nin: -1",
+        "    scale: -2.5",
+        "    eps: 1.5e-3",
+        '    tag: "quoted: value"',
+        "    alt: 'single quoted'",
+        "    plain: a_string",
+        "    vjp: false",
+        "    fusable: true",
+        # YAML 1.1 resolution corners where naive parsing diverges:
+        "    notafloat: 1e5",      # no dot -> str in YAML 1.1
+        "    wordbool: on",        # yes/no/on/off words are bools
+        "    wordbool2: No",
+        "    octal: 010",          # leading zero -> octal 8
+        "    hexa: 0x1A",
+        "    mixedcase: tRue",     # non-canonical casing stays str
+        "    unsignedexp: 1.5e3",  # YAML 1.1 needs a signed exp -> str
+        "    underscored: 1_000",
+        "",
+    ])
+    assert _parse_yaml_fallback(snippet) == yaml.safe_load(snippet)["ops"]
+
+
+def test_fusable_field_validation():
+    """`fusable` may only be declared on elementwise-arity ops, and every
+    fusable op must have a registered VJP (grads flow through the fused
+    program's jax.vjp) plus a registered fusion impl."""
+    from paddle_tpu.core import fusion
+    from paddle_tpu.ops.op_registry import get_op_info
+
+    d = yaml.safe_load(open("paddle_tpu/ops/ops.yaml"))["ops"]
+    fusable = [o for o in d if o.get("fusable")]
+    assert len(fusable) >= 40  # the elementwise families are opted in
+    for o in fusable:
+        name = o["name"]
+        assert o.get("vjp", True) is True, \
+            f"fusable op {name} lacks a VJP (vjp: false)"
+        assert not o.get("variadic", False), \
+            f"fusable op {name} is variadic — not an elementwise arity"
+        assert 1 <= int(o["nin"]) <= 2, \
+            f"fusable op {name} has non-elementwise nin={o['nin']}"
+        assert int(o["nargs"]) <= 3, \
+            f"fusable op {name} has non-elementwise nargs={o['nargs']}"
+        info = get_op_info(name)
+        assert info is not None and info.get("has_vjp"), name
+    # every fusable name that wins its OP_TABLE slot has a registered
+    # canonical impl so the fused program can be rebuilt from its name
+    from paddle_tpu.ops.op_registry import OP_TABLE
+    for name in {o["name"] for o in fusable}:
+        if OP_TABLE[name].get("fusable"):
+            assert name in fusion._IMPLS, \
+                f"fusable op {name} has no fusion impl registered"
 
 
 def test_yaml_fully_covered():
