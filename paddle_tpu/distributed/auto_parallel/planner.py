@@ -114,7 +114,8 @@ def detect_cluster(probe: bool = False) -> Cluster:
         if len(devs) > 1:
             from jax.sharding import Mesh, PartitionSpec as P
             mesh = Mesh(np.array(devs), ("x",))
-            g = jax.jit(jax.shard_map(
+            from .._mesh_axes import shard_map
+            g = jax.jit(shard_map(
                 lambda a: jax.lax.psum(a, "x"), mesh=mesh,
                 in_specs=P(), out_specs=P()))
             z = jnp.ones((8,), jnp.float32)

@@ -37,6 +37,11 @@ class ElasticManager:
 
     PREFIX = "elastic/hb"
 
+    # consecutive store failures a beat/watch thread tolerates before
+    # concluding the job is over (transient flakes below this are
+    # absorbed — on top of the store's own per-op retry)
+    MAX_CONSECUTIVE_FAILURES = 5
+
     def __init__(self, store, node_id: str, ttl: float = 6.0,
                  interval: float = 1.5, stability_ticks: int = 2,
                  on_membership_change: Optional[Callable] = None,
@@ -55,6 +60,7 @@ class ElasticManager:
         self._pending_ticks = 0
         # nid -> (last beat value, monotonic time the value last changed)
         self._beat_seen: dict = {}
+        self.store_faults_survived = 0
 
     # -- registry ----------------------------------------------------------
     def _register(self):
@@ -112,45 +118,65 @@ class ElasticManager:
                 alive.append(nid)
         return self._sort(alive)
 
+    # -- watcher core ------------------------------------------------------
+    def _watch_tick(self, alive: Optional[List[str]] = None):
+        """One debounced membership scan (the watch thread's body,
+        extracted so tests can drive it deterministically). A changed
+        alive set must repeat for ``stability_ticks`` consecutive scans
+        before the rewrite callback fires — a node flapping around its
+        TTL (slow beat, GC pause) never triggers a restart. Returns the
+        new alive list when a stable change was committed, else None."""
+        if alive is None:
+            alive = self.alive_nodes()
+        if alive == self._known:
+            self._pending = None
+            self._pending_ticks = 0
+            return None
+        if alive == self._pending:
+            self._pending_ticks += 1
+        else:
+            self._pending = alive
+            self._pending_ticks = 1
+        if self._pending_ticks < self.stability_ticks:
+            return None
+        self._pending = None
+        self._pending_ticks = 0
+        # fire BEFORE committing _known: if the rewrite callback raises
+        # (and the resilient wrapper absorbs it), the next scans still
+        # see a changed set, re-debounce, and re-fire — the membership
+        # change cannot be silently lost
+        if self.on_membership_change is not None:
+            my = alive.index(self.node_id) \
+                if self.node_id in alive else -1
+            self.on_membership_change(alive, my)
+        self._known = alive
+        return alive
+
     # -- threads -----------------------------------------------------------
     def start(self):
         self._register()
         self._heartbeat_once()
         self._known = self.alive_nodes()
 
-        def beat():
+        def resilient(step):
+            # transient store errors (coordinator restarting, network
+            # flake) must not silently kill the thread — that turns one
+            # dropped packet into a false node death. Tolerate a bounded
+            # run of consecutive failures, then conclude the job ended.
+            failures = 0
             while not self._stop.wait(self.interval):
                 try:
-                    self._heartbeat_once()
-                except Exception:
-                    return  # store gone: the job is ending
+                    step()
+                    failures = 0
+                except Exception:  # noqa: BLE001 — bounded tolerance
+                    failures += 1
+                    self.store_faults_survived += 1
+                    if failures >= self.MAX_CONSECUTIVE_FAILURES:
+                        return  # store gone for good: the job is ending
 
-        def watch():
-            while not self._stop.wait(self.interval):
-                try:
-                    alive = self.alive_nodes()
-                except Exception:
-                    return
-                if alive == self._known:
-                    self._pending = None
-                    self._pending_ticks = 0
-                    continue
-                if alive == self._pending:
-                    self._pending_ticks += 1
-                else:
-                    self._pending = alive
-                    self._pending_ticks = 1
-                if self._pending_ticks >= self.stability_ticks:
-                    old, self._known = self._known, alive
-                    self._pending = None
-                    self._pending_ticks = 0
-                    if self.on_membership_change is not None:
-                        my = alive.index(self.node_id) \
-                            if self.node_id in alive else -1
-                        self.on_membership_change(alive, my)
-
-        for target in (beat, watch):
-            t = threading.Thread(target=target, daemon=True)
+        for step in (self._heartbeat_once, self._watch_tick):
+            t = threading.Thread(target=resilient, args=(step,),
+                                 daemon=True)
             t.start()
             self._threads.append(t)
         return self

@@ -6,24 +6,48 @@ implementation in paddle_tpu/_native/native.cpp. The multi-host mesh
 bootstrap (PJRT distributed init) uses this for address exchange the same
 way the reference's ProcessGroup creation broadcasts NCCL unique ids
 through its store (ref: process_group_nccl.cc CreateNCCLEnvCache).
+
+Client ops retry transient transport failures with exponential backoff
+under a per-op deadline (a preempted/restarting coordinator must not
+take every worker down with one reset connection); a deliberate server
+shutdown (the native call returning None) still aborts immediately.
+Fault-injection sites ``store.<op>`` sit inside the retry loop so tests
+can prove the retry path without a flaky network.
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from .._native import lib as _lib
+from ..utils import fault_injection as _fi
 
 __all__ = ["TCPStore"]
+
+# transient transport errors worth retrying (BrokenPipeError is already
+# a ConnectionError). Deliberately NOT all of OSError: a structurally
+# broken client (EBADF after shutdown, ENOSPC) should fail fast, not
+# burn the backoff budget. The abort-path ConnectionError (native None
+# return) is raised OUTSIDE the retry loop on purpose.
+_RETRYABLE = (ConnectionError, TimeoutError)
 
 
 class TCPStore:
     """ref-parity API: TCPStore(host, port, is_master, world_size, timeout).
 
     set/get/add/wait; `wait` blocks until the key exists (server-side
-    condition variable, no polling)."""
+    condition variable, no polling).
+
+    max_retries/backoff/op_deadline govern the transient-failure retry
+    of every client op: attempt, sleep backoff*2^n (capped at
+    backoff_max), re-attempt, until success, max_retries exhausted, or
+    op_deadline seconds have passed — whichever comes first, with the
+    last transport error chained into the final ConnectionError."""
 
     def __init__(self, host: str, port: int, is_master: bool = False,
-                 world_size: int = 1, timeout: float = 30.0):
+                 world_size: int = 1, timeout: float = 30.0,
+                 max_retries: int = 4, backoff: float = 0.05,
+                 backoff_max: float = 2.0, op_deadline: float = 15.0):
         if _lib is None:
             raise RuntimeError(
                 "paddle_tpu native runtime unavailable (g++ build failed)")
@@ -31,20 +55,71 @@ class TCPStore:
         self.port = port
         self.is_master = is_master
         self.world_size = world_size
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.backoff_max = float(backoff_max)
+        self.op_deadline = float(op_deadline)
+        self.op_retries = 0   # total transient failures absorbed
         self._server = None
         self._barrier_gen = 0
         if is_master:
             self._server = _lib.store_server_start(port)
         self._client = _lib.store_client_connect(host, port, timeout)
 
+    # -- retry core --------------------------------------------------------
+    def _call(self, op: str, fn):
+        """Run one client op with bounded retry + exponential backoff +
+        deadline. Retries only exceptions raised BY the transport (or
+        the ``store.<op>`` injection site); the caller interprets the
+        return value (None = deliberate server-side abort, not retried).
+        Retries reuse the SAME connection (no reconnect): a response
+        lost to a broken connection keeps failing on retry rather than
+        re-applying, so a non-idempotent `add` cannot double-count
+        today. If reconnect-per-op is ever added, barrier() arrival
+        must first become idempotent (per-participant keys), or one
+        lost add response could release a barrier early.
+        """
+        # the deadline bounds the RECOVERY window, so it starts at the
+        # first failure — a get/wait/take legitimately blocked for
+        # minutes before the coordinator restarted must still get its
+        # full retry budget
+        deadline = None
+        attempt = 0
+        while True:
+            try:
+                _fi.fire(f"store.{op}")
+                return fn()
+            except _RETRYABLE as e:
+                attempt += 1
+                if deadline is None:
+                    deadline = time.monotonic() + self.op_deadline
+                remaining = deadline - time.monotonic()
+                if attempt > self.max_retries or remaining <= 0:
+                    why = ("retry budget exhausted "
+                           f"({self.max_retries} retries)"
+                           if attempt > self.max_retries else
+                           f"op deadline exceeded ({self.op_deadline}s)")
+                    raise ConnectionError(
+                        f"TCPStore {op} to {self.host}:{self.port} failed "
+                        f"after {attempt} attempt(s): {why}; last error: "
+                        f"{type(e).__name__}: {e}") from e
+                self.op_retries += 1
+                sleep = min(self.backoff * (2 ** (attempt - 1)),
+                            self.backoff_max, max(remaining, 0.0))
+                if sleep > 0:
+                    time.sleep(sleep)
+
+    # -- ops ---------------------------------------------------------------
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode()
-        _lib.store_set(self._client, key, bytes(value))
+        value = bytes(value)
+        self._call("set", lambda: _lib.store_set(self._client, key, value))
 
     def get(self, key: str) -> bytes:
         """Blocks until the key is set (reference wait-then-get contract)."""
-        v = _lib.store_get(self._client, key, True)
+        v = self._call("get",
+                       lambda: _lib.store_get(self._client, key, True))
         if v is None:
             raise ConnectionError(
                 f"TCPStore wait for {key!r} aborted (server shut down)")
@@ -52,28 +127,37 @@ class TCPStore:
 
     def get_nowait(self, key: str) -> Optional[bytes]:
         """None means the key does not exist; b'' is a real empty value."""
-        return _lib.store_get(self._client, key, False)
+        return self._call(
+            "get_nowait",
+            lambda: _lib.store_get(self._client, key, False))
 
     def add(self, key: str, amount: int = 1) -> int:
-        return _lib.store_add(self._client, key, int(amount))
+        amount = int(amount)
+        return self._call("add",
+                          lambda: _lib.store_add(self._client, key, amount))
 
     def take(self, key: str) -> bytes:
         """Blocking get that atomically deletes the key — the single-consumer
         channel primitive backing eager p2p (send/recv) transport."""
-        v = _lib.store_take(self._client, key)
+        v = self._call("take", lambda: _lib.store_take(self._client, key))
         if v is None:
             raise ConnectionError(
                 f"TCPStore take of {key!r} aborted (server shut down)")
         return v
 
     def delete(self, key: str) -> None:
-        _lib.store_delete(self._client, key)
+        self._call("delete", lambda: _lib.store_delete(self._client, key))
 
     def wait(self, keys) -> None:
         if isinstance(keys, str):
             keys = [keys]
         for k in keys:
-            _lib.store_get(self._client, k, True)
+            v = self._call("wait",
+                           lambda k=k: _lib.store_get(self._client, k,
+                                                      True))
+            if v is None:
+                raise ConnectionError(
+                    f"TCPStore wait for {k!r} aborted (server shut down)")
 
     def barrier(self, name: str = "barrier") -> None:
         """All world_size participants arrive, then proceed. Keys carry a

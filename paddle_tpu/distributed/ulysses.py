@@ -55,7 +55,7 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp",
     jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
     sizes = dict(zip(jmesh.axis_names, jmesh.devices.shape))
     n = sizes[axis]
-    from ._mesh_axes import classify_axes
+    from ._mesh_axes import classify_axes, shard_map
     batch_axes, head_axes = classify_axes(jmesh, axis)
     mp = 1
     for a in head_axes:
@@ -66,7 +66,7 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp",
             f"count {h}//{mp}={h // mp} (Ulysses scatters heads across "
             f"the sequence axis during attention)")
     spec = P(batch_axes or None, axis, head_axes or None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ulysses_local, axis=axis, scale=s,
                           causal=causal),
         mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,

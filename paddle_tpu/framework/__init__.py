@@ -1,1 +1,4 @@
 from .io import save, load  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointCorruptError, CheckpointManager, atomic_save,
+    load_checkpoint, verify_checkpoint)

@@ -1,0 +1,406 @@
+"""Crash-safe persistence: atomic checkpoint writes, per-tensor CRC32
+manifests, retention + corruption fallback, async snapshot-then-persist.
+
+``paddle.save`` historically wrote one pickle straight to its final
+path — a preemption mid-save (the exact failure mode
+``distributed/elastic.py`` exists to survive) truncated the only copy
+and the elastic restart had nothing valid to resume from. This module
+makes durability a subsystem:
+
+- **Atomic saves** (:func:`atomic_save`): serialize to ``path.tmp.<pid>``,
+  flush + fsync, then ``os.replace`` onto the final name — readers see
+  either the old complete file or the new complete file, never a
+  partial. The record embeds a format version and a manifest mapping
+  each tensor's tree path to the CRC32 of its bytes, so silent
+  corruption (not just truncation) is detectable at load.
+- **Legacy compat**: files written by the pre-manifest ``paddle.save``
+  (a bare pickle of the packed tree) still load; the loader sniffs the
+  version marker and falls back to the v1 decode.
+- **:class:`CheckpointManager`**: ``save(obj, step)`` with ``keep_n``
+  retention and ``latest()`` that verifies manifests and silently walks
+  back past truncated/corrupt checkpoints to the newest good one.
+- **Async mode**: ``save`` snapshots device arrays to host (the only
+  step the training loop must wait for), then a background thread
+  serializes, fsyncs and renames — following T3's overlap theme the
+  durability cost leaves the step's critical path. The next ``save`` /
+  ``wait`` / ``close`` barriers on (and re-raises from) the in-flight
+  persist.
+
+Fault-injection sites (``paddle_tpu.utils.fault_injection``):
+``checkpoint.snapshot``, ``checkpoint.write``, ``checkpoint.rename`` —
+the tests kill, truncate and error each one and assert recovery.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.flags import define_flag, flag_value
+from ..utils import fault_injection as _fi
+from .io import _TensorPayload, _pack, _unpack
+
+__all__ = ["atomic_save", "load_checkpoint", "verify_checkpoint",
+           "CheckpointManager", "CheckpointCorruptError",
+           "FORMAT_VERSION"]
+
+FORMAT_KEY = "__paddle_tpu_ckpt__"
+FORMAT_VERSION = 2
+
+define_flag("checkpoint_fsync", True,
+            "fsync checkpoint temp files (and their directory) before "
+            "the atomic rename. Durability contract against power loss; "
+            "disable only in tests/benchmarks on throwaway dirs")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed CRC/structure verification at load."""
+
+
+# -- manifest -------------------------------------------------------------
+
+def _build_manifest(packed) -> Dict[str, Dict[str, Any]]:
+    """Tree-path -> {crc32, nbytes, shape, dtype} for every tensor
+    payload in the packed tree."""
+    entries: Dict[str, Dict[str, Any]] = {}
+
+    def walk(obj, path):
+        if isinstance(obj, _TensorPayload):
+            entries[path] = {
+                "crc32": zlib.crc32(obj.bytes) & 0xFFFFFFFF,
+                "nbytes": len(obj.bytes),
+                "shape": list(obj.shape),
+                "dtype": obj.dtype_str,
+            }
+        elif isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(v, f"{path}/{k}")
+        elif isinstance(obj, (list, tuple)):
+            for i, v in enumerate(obj):
+                walk(v, f"{path}[{i}]")
+
+    walk(packed, "")
+    return entries
+
+
+def _verify_manifest(manifest, packed) -> List[str]:
+    """Recompute CRCs against the manifest; returns mismatch reasons."""
+    actual = _build_manifest(packed)
+    bad = []
+    for path, want in manifest.items():
+        got = actual.get(path)
+        if got is None:
+            bad.append(f"{path or '/'}: tensor missing from payload")
+        elif (got["crc32"] != want["crc32"]
+              or got["nbytes"] != want["nbytes"]):
+            bad.append(
+                f"{path or '/'}: crc32 {got['crc32']:#010x} != manifest "
+                f"{want['crc32']:#010x} ({got['nbytes']} bytes)")
+    extra = set(actual) - set(manifest)
+    if extra:
+        bad.append(f"{len(extra)} tensor(s) not in manifest")
+    return bad
+
+
+# -- save / load ----------------------------------------------------------
+
+def _fsync_dir(dirname: str) -> None:
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return  # fs without directory fds (or vanished dir)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_save(obj, path: str, protocol: int = 4) -> int:
+    """Snapshot ``obj`` and atomically persist it at ``path``; returns
+    bytes written. Any failure (including a kill mid-write) leaves the
+    previous contents of ``path`` untouched."""
+    _fi.fire("checkpoint.snapshot")
+    packed = _pack(obj)
+    return _persist_packed(packed, path, protocol)
+
+
+def _persist_packed(packed, path: str, protocol: int = 4) -> int:
+    """The durable half of a save (async mode runs this off-thread):
+    serialize the already-host-resident tree, write-fsync-rename."""
+    record = {FORMAT_KEY: FORMAT_VERSION,
+              "manifest": _build_manifest(packed),
+              "payload": packed}
+    blob = pickle.dumps(record, protocol=protocol)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            _fi.write_bytes("checkpoint.write", f, blob)
+            f.flush()
+            if flag_value("checkpoint_fsync"):
+                os.fsync(f.fileno())
+        _fi.fire("checkpoint.rename")
+        os.replace(tmp, path)
+    except Exception:
+        # a REAL error is reported after best-effort cleanup; a
+        # KillPoint (BaseException) skips this and leaves the partial
+        # tmp file behind, exactly like a preemption would
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    if flag_value("checkpoint_fsync"):
+        _fsync_dir(d)
+    return len(blob)
+
+
+def _read_record(path: str):
+    """-> (version, manifest, packed_payload). Legacy bare-pickle files
+    report version 1 with an empty manifest."""
+    with open(path, "rb") as f:
+        record = pickle.load(f)
+    if isinstance(record, dict) and FORMAT_KEY in record \
+            and "payload" in record:
+        version = record[FORMAT_KEY]
+        if not isinstance(version, int) or version > FORMAT_VERSION:
+            raise CheckpointCorruptError(
+                f"{path}: checkpoint format version {version!r} is newer "
+                f"than this build understands (<= {FORMAT_VERSION})")
+        return version, record.get("manifest", {}), record["payload"]
+    return 1, {}, record
+
+
+def load_checkpoint(path: str, return_numpy: bool = False,
+                    verify: bool = True):
+    """Load a checkpoint written by this module OR a legacy
+    ``paddle.save`` pickle. v2 files are CRC-verified before a single
+    tensor is handed back; a mismatch raises
+    :class:`CheckpointCorruptError` instead of returning garbage."""
+    version, manifest, packed = _read_record(path)
+    if verify and version >= 2:
+        bad = _verify_manifest(manifest, packed)
+        if bad:
+            raise CheckpointCorruptError(
+                f"{path}: {len(bad)} corrupt tensor(s): "
+                + "; ".join(bad[:4]))
+    return _unpack(packed, return_numpy=return_numpy)
+
+
+def verify_checkpoint(path: str) -> Tuple[bool, str]:
+    """Full integrity check without materializing tensors on device:
+    (True, "") for a loadable checkpoint, else (False, reason). Never
+    raises for on-disk damage — truncation, unpicklable bytes and CRC
+    mismatches all come back as reasons."""
+    try:
+        version, manifest, packed = _read_record(path)
+    except CheckpointCorruptError as e:
+        return False, str(e)
+    except Exception as e:  # noqa: BLE001 — any decode failure = damage
+        return False, f"unreadable ({type(e).__name__}: {e})"
+    if version >= 2:
+        bad = _verify_manifest(manifest, packed)
+        if bad:
+            return False, "; ".join(bad)
+    return True, ""
+
+
+# -- manager --------------------------------------------------------------
+
+class CheckpointManager:
+    """Step-indexed checkpoints under one directory with retention,
+    corruption fallback and optional async persistence.
+
+    ``save(obj, step)`` writes ``<root>/<prefix>-<step>.pdckpt``
+    atomically and prunes to the newest ``keep_n``. ``latest()`` walks
+    steps newest-first, verifying each manifest, and silently falls
+    back past truncated/corrupt files to the newest good one — the
+    elastic-restart contract: whatever a preemption did to the last
+    save, resume finds a consistent state.
+    """
+
+    _SUFFIX = ".pdckpt"
+
+    def __init__(self, root: str, keep_n: int = 3,
+                 async_save: bool = False, prefix: str = "ckpt"):
+        if keep_n < 1:
+            raise ValueError(f"keep_n must be >= 1, got {keep_n}")
+        self.root = str(root)
+        self.keep_n = int(keep_n)
+        self.async_save = bool(async_save)
+        self.prefix = prefix
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._pending: Optional[threading.Thread] = None
+        self._pending_error: Optional[BaseException] = None
+        self._stats = {"saves": 0, "async_saves": 0, "bytes_written": 0,
+                       "corrupt_skipped": 0, "retired": 0}
+        steps = self.steps()
+        self._next_step = (steps[-1] + 1) if steps else 0
+
+    # -- paths ----------------------------------------------------------
+    def _path(self, step: int) -> str:
+        return os.path.join(self.root,
+                            f"{self.prefix}-{step:08d}{self._SUFFIX}")
+
+    def steps(self) -> List[int]:
+        """Steps with a (possibly damaged) checkpoint file, ascending.
+        In-flight ``.tmp.*`` files are never counted."""
+        pat = re.compile(
+            rf"^{re.escape(self.prefix)}-(\d+){re.escape(self._SUFFIX)}$")
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in names:
+            m = pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # -- save ------------------------------------------------------------
+    def save(self, obj, step: Optional[int] = None) -> str:
+        """Checkpoint ``obj``; returns the final path. Sync mode blocks
+        until the file is durable; async mode returns once the host
+        snapshot exists and persists on the background thread (the
+        previous in-flight persist is barriered first, and its failure
+        re-raised here)."""
+        self.wait()
+        if step is None:
+            step = self._next_step
+        step = int(step)
+        self._next_step = max(self._next_step, step + 1)
+        path = self._path(step)
+        _fi.fire("checkpoint.snapshot")
+        packed = _pack(obj)  # device -> host; the only sync cost
+        if not self.async_save:
+            self._persist(packed, path)
+            return path
+
+        def run():
+            try:
+                self._persist(packed, path)
+            except BaseException as e:  # noqa: BLE001 — incl. KillPoint
+                with self._lock:
+                    self._pending_error = e
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"ckpt-persist-{step}")
+        # start BEFORE publishing: joining an unstarted thread raises,
+        # and a concurrent reader may _join_pending() the moment the
+        # slot is visible
+        t.start()
+        with self._lock:
+            self._pending = t
+            self._stats["async_saves"] += 1
+        return path
+
+    def _persist(self, packed, path: str) -> None:
+        n = _persist_packed(packed, path)
+        with self._lock:
+            self._stats["saves"] += 1
+            self._stats["bytes_written"] += n
+        self._retire()
+
+    def _retire(self) -> None:
+        for step in self.steps()[:-self.keep_n]:
+            try:
+                os.remove(self._path(step))
+                with self._lock:
+                    self._stats["retired"] += 1
+            except OSError:
+                pass  # already gone / transient: retry next save
+
+    # -- async barrier ---------------------------------------------------
+    def _join_pending(self) -> None:
+        """Join the in-flight persist thread (if any) and clear the
+        slot ONLY if it still holds that same thread — a reader joining
+        concurrently with a trainer's save() must never null out a
+        freshly started persist."""
+        t = self._pending
+        if t is not None:
+            t.join()
+            with self._lock:
+                if self._pending is t:
+                    self._pending = None
+
+    def wait(self) -> None:
+        """Barrier on the in-flight async persist; re-raises its
+        failure (KillPoint included) exactly once."""
+        self._join_pending()
+        with self._lock:
+            err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise err
+
+    def _drain_quietly(self) -> None:
+        """Read-side barrier: the reader wants the newest durable state,
+        not the background writer's exception — that stays parked for
+        the next save()/wait()/close()."""
+        self._join_pending()
+
+    # -- restore ---------------------------------------------------------
+    def latest(self) -> Optional[str]:
+        """Path of the newest checkpoint whose manifest verifies, or
+        None. Damaged files are skipped silently (counted in
+        ``stats()['corrupt_skipped']``) — fallback IS the recovery
+        path, not an error."""
+        self._drain_quietly()
+        for step in reversed(self.steps()):
+            path = self._path(step)
+            ok, _reason = verify_checkpoint(path)
+            if ok:
+                return path
+            with self._lock:
+                self._stats["corrupt_skipped"] += 1
+        return None
+
+    def _step_of(self, path: str) -> int:
+        return int(os.path.basename(path)[len(self.prefix) + 1:
+                                          -len(self._SUFFIX)])
+
+    def latest_step(self) -> Optional[int]:
+        path = self.latest()
+        return None if path is None else self._step_of(path)
+
+    def restore(self, return_numpy: bool = False):
+        """(step, obj) from the newest good checkpoint, or None when no
+        loadable checkpoint exists. One read+verify pass per candidate
+        (latest()-then-load would decode and CRC the winner twice)."""
+        self._drain_quietly()
+        for step in reversed(self.steps()):
+            try:
+                obj = load_checkpoint(self._path(step),
+                                      return_numpy=return_numpy)
+            except Exception:  # noqa: BLE001 — damaged: fall back
+                with self._lock:
+                    self._stats["corrupt_skipped"] += 1
+                continue
+            return step, obj
+        return None
+
+    # -- lifecycle / observability ---------------------------------------
+    def close(self) -> None:
+        self.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._stats)
+        t = self._pending
+        out["async_queue_depth"] = int(t is not None and t.is_alive())
+        return out

@@ -1,13 +1,17 @@
-"""paddle.save / paddle.load: pickle-based single-process checkpointing.
+"""paddle.save / paddle.load: single-process checkpointing.
 
 ref: python/paddle/framework/io.py. Tensors are serialized as numpy arrays
 with dtype preserved (bfloat16 via ml_dtypes view trick); nested dicts/lists
 (state_dicts, optimizer states) round-trip transparently.
+
+Durability lives in ``framework/checkpoint.py``: ``save`` writes
+atomically (tmp + fsync + rename) with a per-tensor CRC32 manifest, and
+``load`` verifies the manifest before handing tensors back. Files
+written by the pre-manifest bare-pickle format still load — the
+``_TensorPayload`` class must stay importable from THIS module path,
+which is what legacy pickles reference.
 """
 from __future__ import annotations
-
-import os
-import pickle
 
 import jax.numpy as jnp
 import numpy as np
@@ -58,14 +62,10 @@ def _unpack(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    from .checkpoint import atomic_save  # lazy: avoids an import cycle
+    atomic_save(obj, path, protocol=protocol)
 
 
 def load(path, return_numpy=False, **configs):
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
-    return _unpack(obj, return_numpy=return_numpy)
+    from .checkpoint import load_checkpoint
+    return load_checkpoint(path, return_numpy=return_numpy)

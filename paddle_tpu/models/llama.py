@@ -322,6 +322,25 @@ class LlamaForCausalLM(Layer):
         return ids
 
 
+@jax.custom_vjp
+def _grad_safe_barrier(lg, lb):
+    return jax.lax.optimization_barrier((lg, lb))
+
+
+def _grad_safe_barrier_fwd(lg, lb):
+    return jax.lax.optimization_barrier((lg, lb)), None
+
+
+def _grad_safe_barrier_bwd(_, ct):
+    return ct
+
+
+# optimization_barrier has no differentiation rule in jax 0.4.37; the
+# barrier only orders the forward dependency chain, so the cotangents
+# pass through untouched
+_grad_safe_barrier.defvjp(_grad_safe_barrier_fwd, _grad_safe_barrier_bwd)
+
+
 class LlamaPretrainingCriterion(Layer):
     """Causal-LM loss: shifted next-token cross entropy
     (ref: LlamaPretrainingCriterion in semi_auto_parallel_llama_model.py)."""
@@ -341,7 +360,7 @@ class LlamaPretrainingCriterion(Layer):
             # collective chain and can race it on the XLA:CPU in-process
             # rendezvous (deadlock in the CP dryrun); on TPU the labels
             # are tiny and the barrier costs nothing
-            lg, lb = jax.lax.optimization_barrier((lg, lb))
+            lg, lb = _grad_safe_barrier(lg, lb)
             # shift the LABELS (tiny int array), not the logits: slicing
             # lg[:, :-1] copies the whole [B, L, V] tensor (262 MB at
             # the 1B-scale geometry) and leaves an odd L-1 chunk size;

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..distributed._mesh_axes import shard_map
+
 __all__ = ["spmd_pipeline", "spmd_pipeline_interleaved",
            "stack_layer_params", "remat_policy"]
 
@@ -139,7 +141,7 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, microbatches, mesh,
                   *([None] * (ndim - 2)))
     param_specs = jax.tree.map(
         lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pipeline_local, stage_fn=stage_fn, axis=axis),
         mesh=jmesh, in_specs=(param_specs, data_spec),
         out_specs=data_spec, check_vma=False)
@@ -256,7 +258,7 @@ def spmd_pipeline_interleaved(stage_fn: Callable, stacked_params,
                   *([None] * (ndim - 2)))
     param_specs = jax.tree.map(
         lambda a: P(None, axis, *([None] * (a.ndim - 2))), params_vsg)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pipeline_interleaved_local, stage_fn=stage_fn,
                           axis=axis, num_chunks=V),
         mesh=jmesh, in_specs=(param_specs, data_spec),

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -416,7 +417,17 @@ class GenerationServer:
     step advances all active requests together, finished requests free
     their slot for the next admission — no request waits for another
     to finish (ref role: the multi-stream request loop of the
-    reference's serving predictor)."""
+    reference's serving predictor).
+
+    Robustness contract: ``submit(..., deadline=s)`` bounds a request's
+    wall time — expiry (checked at step boundaries, queued or active)
+    fails THAT request with TimeoutError, keeping whatever tokens it
+    already produced in ``req["out"]``. ``shutdown()`` drains: new
+    submissions are rejected immediately, in-flight and already-queued
+    requests run to completion, then the loop exits — no completed
+    token is ever dropped by a shutdown."""
+
+    _STOP = object()  # queue sentinel: wake the loop for shutdown
 
     def __init__(self, engine: LlamaDecodeEngine):
         self.engine = engine
@@ -424,36 +435,78 @@ class GenerationServer:
         self._slots: Dict[int, dict] = {}
         self.steps_run = 0
         self.admitted = 0
+        self.rejected = 0           # submissions after shutdown
+        self.deadline_expired = 0   # requests failed by their deadline
+        self._stopping = threading.Event()
+        self._drained = threading.Event()
+        # orders submit's stopping-check+enqueue against shutdown's
+        # stopping.set(): a request that passed the check is enqueued
+        # BEFORE stopping becomes visible, so the drain loop (which
+        # only exits on stopping AND empty queue) cannot strand it
+        self._submit_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def submit(self, prompt_ids, max_new_tokens: int = 32) -> dict:
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               deadline: Optional[float] = None) -> dict:
+        """Enqueue a request. ``deadline`` (seconds from now) bounds its
+        total wall time; None = unbounded."""
+        if self._stopping.is_set():
+            self.rejected += 1
+            raise RuntimeError(
+                "GenerationServer is shutting down; new submissions are "
+                "rejected (in-flight requests are draining)")
         if int(max_new_tokens) < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
                 f"(prefill always produces the first token)")
+        if deadline is not None and deadline <= 0:
+            raise ValueError(f"deadline must be > 0, got {deadline}")
         req = {"prompt": np.asarray(prompt_ids, np.int32).reshape(-1),
                "max_new": int(max_new_tokens), "out": [],
-               "done": threading.Event(), "error": None}
-        self._q.put(req)
+               "done": threading.Event(), "error": None,
+               "expires": (time.monotonic() + deadline
+                           if deadline is not None else None)}
+        with self._submit_lock:
+            if self._stopping.is_set():
+                self.rejected += 1
+                raise RuntimeError(
+                    "GenerationServer is shutting down; new submissions "
+                    "are rejected (in-flight requests are draining)")
+            self._q.put(req)
         return req
 
     def generate(self, prompt_ids, max_new_tokens: int = 32,
-                 timeout: float = 300.0) -> List[int]:
-        req = self.submit(prompt_ids, max_new_tokens)
+                 timeout: float = 300.0,
+                 deadline: Optional[float] = None) -> List[int]:
+        req = self.submit(prompt_ids, max_new_tokens, deadline=deadline)
         if not req["done"].wait(timeout):
             raise TimeoutError("generation timed out")
         if req["error"] is not None:
             raise req["error"]
         return list(req["out"])
 
+    def _expired(self, req) -> bool:
+        return (req["expires"] is not None
+                and time.monotonic() > req["expires"])
+
+    def _fail(self, req, error) -> None:
+        req["error"] = error
+        req["done"].set()
+
     def _admit_one(self, req, slot) -> None:
         eng = self.engine
+        if req is self._STOP or req["done"].is_set():
+            return  # sentinel, or already failed while queued
+        if self._expired(req):
+            self.deadline_expired += 1
+            self._fail(req, TimeoutError(
+                "request deadline expired while queued"))
+            return
         try:
             first = eng.prefill(slot, req["prompt"])
         except Exception as e:  # noqa: BLE001 — surfaced per request
-            req["error"] = e
-            req["done"].set()
+            self._fail(req, e)
             return
         req["out"].append(first)
         self._slots[slot] = req
@@ -471,7 +524,12 @@ class GenerationServer:
                 req = self._q.get_nowait()
             except _queue.Empty:
                 return
-            self._admit_one(req, free.pop(0))
+            if req is self._STOP or req["done"].is_set():
+                continue  # sentinel, or failed while queued (deadline)
+            self._admit_one(req, free[0])
+            if req["done"].is_set() and req["error"] is not None:
+                continue  # rejected before prefill: the slot is still free
+            free.pop(0)
 
     def _finish_if_done(self, slot, req):
         eng = self.engine
@@ -485,15 +543,47 @@ class GenerationServer:
             req["done"].set()
         return done
 
+    def _expire_active(self):
+        """Step-boundary deadline sweep: an expired active request is
+        failed with TimeoutError and its slot freed; the tokens it
+        already produced stay in ``req['out']``."""
+        for slot in list(self._slots):
+            req = self._slots[slot]
+            if self._expired(req):
+                self.deadline_expired += 1
+                self.engine.release(slot)
+                del self._slots[slot]
+                self._fail(req, TimeoutError(
+                    f"request deadline expired after "
+                    f"{len(req['out'])} token(s)"))
+
+    def _expire_queued(self):
+        """Fail expired requests still WAITING in the queue — even when
+        every slot is busy, a starved request's caller is unblocked at
+        the next step boundary, not when a slot eventually frees. The
+        failed entry stays enqueued; _admit() discards it on dequeue."""
+        with self._q.mutex:
+            waiting = list(self._q.queue)
+        for req in waiting:
+            if req is not self._STOP and not req["done"].is_set() \
+                    and self._expired(req):
+                self.deadline_expired += 1
+                self._fail(req, TimeoutError(
+                    "request deadline expired while queued"))
+
     def _loop(self):
         while True:
             try:
                 self._admit()
                 if not self._slots:
+                    if self._stopping.is_set() and self._q.empty():
+                        break  # drained: nothing active, nothing queued
                     # idle: block for the next request and admit it
                     # DIRECTLY — a get-then-requeue would let requests
                     # submitted in the window jump ahead of it (FIFO)
                     req = self._q.get()
+                    if req is self._STOP:
+                        continue
                     self._admit_one(req, self._free_slots()[0])
                     continue
                 nxt = self.engine.step()
@@ -502,9 +592,49 @@ class GenerationServer:
                     req = self._slots[slot]
                     req["out"].append(int(nxt[slot]))
                     self._finish_if_done(slot, req)
+                self._expire_active()
+                self._expire_queued()
             except Exception as e:  # noqa: BLE001 — fail loudly, stay up
                 for slot, req in list(self._slots.items()):
-                    req["error"] = e
-                    req["done"].set()
+                    self._fail(req, e)
                     self.engine.release(slot)
                 self._slots.clear()
+        self._drained.set()
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 300.0) -> bool:
+        """Stop the server. ``drain=True`` (default) lets in-flight and
+        already-queued requests finish while new submissions are
+        rejected; ``drain=False`` additionally cancels everything still
+        waiting in the queue (active requests still finish — a decode
+        step cannot be abandoned mid-flight without corrupting slots).
+        Returns True once the loop has fully drained."""
+        with self._submit_lock:
+            self._stopping.set()
+        if not drain:
+            # cancel queued work; requests already in slots complete
+            while True:
+                try:
+                    req = self._q.get_nowait()
+                except _queue.Empty:
+                    break
+                if req is not self._STOP:
+                    self._fail(req, RuntimeError(
+                        "request cancelled: server shut down before "
+                        "admission"))
+        self._q.put(self._STOP)  # wake an idle loop
+        # Event.wait(None) blocks until drained — timeout=None means
+        # "wait as long as it takes", never "skip the wait"
+        return self._drained.wait(timeout)
+
+    def stats(self) -> Dict[str, int]:
+        with self._q.mutex:  # don't count _STOP sentinels as work
+            queued = sum(1 for r in self._q.queue
+                         if r is not self._STOP
+                         and not r["done"].is_set())
+        return {"steps_run": self.steps_run, "admitted": self.admitted,
+                "rejected": self.rejected,
+                "deadline_expired": self.deadline_expired,
+                "in_flight": len(self._slots), "queued": queued,
+                "draining": int(self._stopping.is_set()),
+                "drained": int(self._drained.is_set())}

@@ -1,6 +1,7 @@
 from . import clip_grad  # noqa: F401
 from . import custom_op  # noqa: F401
 from . import download  # noqa: F401
+from . import fault_injection  # noqa: F401
 from .custom_op import register_op  # noqa: F401
 from .helpers import (  # noqa: F401
     deprecated, require_version, run_check, try_import)

@@ -1,0 +1,194 @@
+"""Site-based fault injection for robustness tests.
+
+Production code threads named *sites* through its failure-prone
+operations (``fire("checkpoint.write")`` before a file write,
+``fire("store.add")`` inside the TCPStore retry loop, ...). Tests arm a
+site with :func:`inject` (or the :func:`injected` context manager) and
+the next ``times`` passages through it raise the armed exception,
+truncate the write, or simulate a process kill. Unarmed sites cost one
+dict lookup on a module-level table — nothing in the hot path imports,
+locks, or allocates.
+
+Kill-points raise :class:`KillPoint`, a BaseException subclass, so
+``except Exception`` recovery code cannot accidentally "survive" a
+simulated preemption — only the test harness catches it.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+__all__ = ["KillPoint", "InjectedFault", "inject", "clear", "fire",
+           "write_bytes", "injected", "stats", "armed"]
+
+
+class KillPoint(BaseException):
+    """Simulated process death (SIGKILL / preemption) at a named site.
+
+    BaseException on purpose: recovery paths that swallow ``Exception``
+    must not treat a kill as a survivable I/O error.
+    """
+
+
+class InjectedFault(OSError):
+    """Default exception raised by an armed site."""
+
+
+class _Fault:
+    __slots__ = ("exc", "times", "truncate_at", "kill", "skip", "fired")
+
+    def __init__(self, exc, times, truncate_at, kill, skip):
+        self.exc = exc
+        self.times = times
+        self.truncate_at = truncate_at
+        self.kill = kill
+        self.skip = skip
+        self.fired = 0
+
+
+_lock = threading.Lock()
+_sites: Dict[str, _Fault] = {}
+_fired_total: Dict[str, int] = {}
+
+
+def inject(site: str, exc: Optional[BaseException] = None, times: int = 1,
+           truncate_at: Optional[int] = None, kill: bool = False,
+           skip: int = 0) -> None:
+    """Arm ``site`` to fail its next ``times`` passages (after ``skip``
+    clean ones).
+
+    exc:         exception instance to raise (default InjectedFault).
+    truncate_at: for write sites — persist only the first N bytes
+                 (combine with ``kill=True`` for a mid-write preemption).
+    kill:        raise KillPoint instead of ``exc``.
+    skip:        let this many passages through unharmed first (fail the
+                 Nth save, not the first).
+    """
+    with _lock:
+        _sites[site] = _Fault(exc, int(times), truncate_at, kill, int(skip))
+
+
+def clear(site: Optional[str] = None) -> None:
+    """Disarm one site, or every site when called with no argument."""
+    with _lock:
+        if site is None:
+            _sites.clear()
+        else:
+            _sites.pop(site, None)
+
+
+def armed(site: str) -> bool:
+    return site in _sites
+
+
+def stats() -> Dict[str, int]:
+    """site -> total faults fired (survives clear(); for test asserts)."""
+    with _lock:
+        return dict(_fired_total)
+
+
+def _consume(site: str) -> Optional[_Fault]:
+    """Take one shot from an armed site, or None for a clean passage."""
+    with _lock:
+        f = _sites.get(site)
+        if f is None:
+            return None
+        if f.skip > 0:
+            f.skip -= 1
+            return None
+        if f.times <= 0:
+            return None
+        f.times -= 1
+        f.fired += 1
+        _fired_total[site] = _fired_total.get(site, 0) + 1
+        if f.times <= 0:
+            del _sites[site]
+        return f
+
+
+def fire(site: str) -> None:
+    """Checkpoint a failure-prone operation: raises if ``site`` is armed
+    with an exception or kill-point; no-op otherwise (truncation-only
+    arms are left for :func:`write_bytes` to consume)."""
+    if site not in _sites:  # unlocked fast path; arming is test-side
+        return
+    f = _consume(site)
+    if f is None:
+        return
+    if f.kill and f.truncate_at is None:
+        raise KillPoint(site)
+    if f.truncate_at is not None:
+        # a truncation arm belongs to write_bytes; re-arm the shot
+        with _lock:
+            f.times += 1
+            f.fired -= 1
+            _fired_total[site] -= 1
+            _sites[site] = f
+        return
+    raise f.exc if f.exc is not None else InjectedFault(
+        f"injected fault at {site!r}")
+
+
+def write_bytes(site: str, fileobj, blob: bytes) -> int:
+    """Write ``blob`` through an injectable site. An armed truncation
+    writes only ``truncate_at`` bytes then raises (KillPoint when
+    ``kill=True``, else the armed/default exception) — the on-disk state
+    a real preemption mid-write leaves behind."""
+    f = _consume(site) if site in _sites else None
+    if f is None:
+        fileobj.write(blob)
+        return len(blob)
+    if f.truncate_at is None:
+        if f.kill:
+            raise KillPoint(site)
+        raise f.exc if f.exc is not None else InjectedFault(
+            f"injected fault at {site!r}")
+    n = max(0, min(int(f.truncate_at), len(blob)))
+    fileobj.write(blob[:n])
+    fileobj.flush()
+    if f.kill:
+        raise KillPoint(site)
+    raise f.exc if f.exc is not None else InjectedFault(
+        f"injected truncation at {site!r} after {n} bytes")
+
+
+@contextmanager
+def injected(site: str, **kwargs):
+    """``with injected("store.add", times=2): ...`` — arm for the block,
+    disarm on exit even if the block dies."""
+    inject(site, **kwargs)
+    try:
+        yield
+    finally:
+        clear(site)
+
+
+class FlakyStore:
+    """Store wrapper failing the first ``fail_times`` calls of each
+    wrapped op with ConnectionResetError — a transport-level flake for
+    components (elastic membership) tested against a pure-python store
+    double, where the in-store injection sites don't exist."""
+
+    _OPS = ("set", "get", "get_nowait", "add", "take", "delete", "wait")
+
+    def __init__(self, store, fail_times: int = 1, ops=None):
+        self._store = store
+        self._remaining = {op: int(fail_times)
+                           for op in (ops or self._OPS)}
+        self.faults_fired = 0
+
+    def __getattr__(self, name):
+        target = getattr(self._store, name)
+        if name not in self._remaining or not callable(target):
+            return target
+
+        def flaky(*a, **kw):
+            if self._remaining[name] > 0:
+                self._remaining[name] -= 1
+                self.faults_fired += 1
+                raise ConnectionResetError(
+                    f"injected flaky store op {name!r}")
+            return target(*a, **kw)
+
+        return flaky

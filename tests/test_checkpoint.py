@@ -1,0 +1,230 @@
+"""Crash-safe checkpointing: atomicity, CRC manifests, retention,
+corruption fallback, async persistence, legacy-format compat
+(ISSUE 2 tentpole; ref role: the reference's save/load contract in
+python/paddle/framework/io.py hardened for preemptible TPU jobs)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import (CheckpointCorruptError, CheckpointManager,
+                                  atomic_save, load_checkpoint,
+                                  verify_checkpoint)
+from paddle_tpu.framework.io import _pack
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def _state(seed=0, n=64):
+    r = np.random.default_rng(seed)
+    return {
+        "model": {
+            "w": paddle.to_tensor(r.standard_normal((n, 8))
+                                  .astype(np.float32)),
+            "b16": paddle.to_tensor(
+                r.standard_normal(n).astype(np.float32)).astype("bfloat16"),
+        },
+        "opt": [paddle.to_tensor(np.zeros(n, np.float32)), {"lr": 0.1}],
+        "step": int(seed),
+    }
+
+
+def _assert_state_equal(got, seed):
+    want = _state(seed)
+    np.testing.assert_array_equal(got["model"]["w"].numpy(),
+                                  want["model"]["w"].numpy())
+    np.testing.assert_array_equal(
+        got["model"]["b16"].astype("float32").numpy(),
+        want["model"]["b16"].astype("float32").numpy())
+    assert got["opt"][1]["lr"] == 0.1
+    assert got["step"] == seed
+
+
+class TestAtomicSaveLoad:
+    def test_roundtrip_nested_and_bf16(self, tmp_path):
+        p = str(tmp_path / "ck")
+        atomic_save(_state(3), p)
+        ok, why = verify_checkpoint(p)
+        assert ok, why
+        _assert_state_equal(load_checkpoint(p), 3)
+
+    def test_save_via_paddle_api_is_versioned(self, tmp_path):
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(_state(1), p)
+        with open(p, "rb") as f:
+            record = pickle.load(f)
+        assert record["__paddle_tpu_ckpt__"] == 2
+        assert record["manifest"], "manifest must cover the tensors"
+        _assert_state_equal(paddle.load(p), 1)
+
+    def test_legacy_bare_pickle_still_loads(self, tmp_path):
+        """Files from the pre-manifest paddle.save (a bare pickle of the
+        packed tree) load unchanged — the PR-seed checkpoint corpus must
+        survive this refactor."""
+        p = str(tmp_path / "legacy.pdparams")
+        with open(p, "wb") as f:
+            pickle.dump(_pack(_state(5)), f, protocol=4)
+        _assert_state_equal(paddle.load(p), 5)
+        ok, why = verify_checkpoint(p)
+        assert ok, why  # legacy = loadable, just without CRCs
+
+    def test_future_version_refused(self, tmp_path):
+        p = str(tmp_path / "future")
+        with open(p, "wb") as f:
+            pickle.dump({"__paddle_tpu_ckpt__": 99, "manifest": {},
+                         "payload": {}}, f)
+        with pytest.raises(CheckpointCorruptError, match="version"):
+            load_checkpoint(p)
+
+    def test_kill_mid_write_preserves_previous_file(self, tmp_path):
+        """A preemption mid-write (truncated temp + kill) leaves the
+        previous complete checkpoint at the final path untouched."""
+        p = str(tmp_path / "ck")
+        atomic_save(_state(1), p)
+        fi.inject("checkpoint.write", truncate_at=64, kill=True)
+        with pytest.raises(fi.KillPoint):
+            atomic_save(_state(2), p)
+        # tmp litter exists; the real file is the OLD complete state
+        assert any(".tmp." in n for n in os.listdir(tmp_path))
+        ok, why = verify_checkpoint(p)
+        assert ok, why
+        _assert_state_equal(load_checkpoint(p), 1)
+
+    def test_injected_io_error_cleans_tmp(self, tmp_path):
+        p = str(tmp_path / "ck")
+        fi.inject("checkpoint.write", exc=OSError("ENOSPC"))
+        with pytest.raises(OSError, match="ENOSPC"):
+            atomic_save(_state(0), p)
+        assert os.listdir(tmp_path) == []  # survivable error: tmp removed
+
+    def test_corrupted_tensor_bytes_detected(self, tmp_path):
+        """Flip one byte inside a tensor's payload: the pickle still
+        decodes, but the CRC manifest refuses to hand the data back."""
+        p = str(tmp_path / "ck")
+        atomic_save({"w": paddle.to_tensor(
+            np.full((32,), 2.0, np.float32))}, p)
+        blob = bytearray(open(p, "rb").read())
+        idx = blob.rfind(np.float32(2.0).tobytes())
+        assert idx > 0
+        blob[idx] ^= 0x55
+        with open(p, "wb") as f:
+            f.write(bytes(blob))
+        ok, why = verify_checkpoint(p)
+        assert not ok and "crc32" in why
+        with pytest.raises(CheckpointCorruptError, match="corrupt"):
+            load_checkpoint(p)
+
+    def test_truncated_file_detected(self, tmp_path):
+        p = str(tmp_path / "ck")
+        atomic_save(_state(0), p)
+        blob = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(blob[:len(blob) // 2])
+        ok, why = verify_checkpoint(p)
+        assert not ok and "unreadable" in why
+
+
+class TestCheckpointManager:
+    def test_retention_keeps_newest_n(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_n=2)
+        for s in range(5):
+            m.save(_state(s), step=s)
+        assert m.steps() == [3, 4]
+        assert m.stats()["retired"] == 3
+
+    def test_auto_step_resumes_numbering(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_n=5)
+        m.save(_state(0))
+        m.save(_state(1))
+        m2 = CheckpointManager(str(tmp_path), keep_n=5)  # fresh process
+        m2.save(_state(2))
+        assert m2.steps() == [0, 1, 2]
+
+    def test_latest_falls_back_past_killed_save(self, tmp_path):
+        """THE acceptance scenario: a save killed mid-write leaves
+        latest() resolving to the previous good checkpoint."""
+        m = CheckpointManager(str(tmp_path), keep_n=3)
+        m.save(_state(0), step=0)
+        fi.inject("checkpoint.write", truncate_at=100, kill=True)
+        with pytest.raises(fi.KillPoint):
+            m.save(_state(1), step=1)
+        fi.clear()
+        assert m.latest_step() == 0
+        step, got = m.restore()
+        assert step == 0
+        _assert_state_equal(got, 0)
+
+    def test_latest_skips_corrupt_newest(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_n=3)
+        m.save(_state(0), step=0)
+        m.save(_state(1), step=1)
+        newest = m.latest()
+        blob = bytearray(open(newest, "rb").read())
+        blob[-40] ^= 0xFF  # damage tensor bytes near the end
+        with open(newest, "wb") as f:
+            f.write(bytes(blob))
+        assert m.latest_step() == 0
+        assert m.stats()["corrupt_skipped"] >= 1
+        step, got = m.restore()
+        assert step == 0
+        _assert_state_equal(got, 0)
+
+    def test_restore_none_when_empty(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        assert m.latest() is None
+        assert m.restore() is None
+
+    def test_async_save_persists_and_barriers(self, tmp_path):
+        """Async mode: save() returns after the host snapshot; the
+        persist completes on the background thread; wait()/close()
+        barrier and the result verifies + restores."""
+        m = CheckpointManager(str(tmp_path), keep_n=3, async_save=True)
+        m.save(_state(0), step=0)
+        m.wait()
+        assert m.stats()["saves"] == 1
+        assert m.stats()["async_saves"] == 1
+        step, got = m.restore()
+        assert step == 0
+        _assert_state_equal(got, 0)
+        m.close()
+
+    def test_async_error_surfaces_on_next_save(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_n=3, async_save=True)
+        fi.inject("checkpoint.write", exc=OSError("disk gone"))
+        m.save(_state(0), step=0)
+        with pytest.raises(OSError, match="disk gone"):
+            # the barrier at the head of the next save joins the
+            # background persist and re-raises its failure instead of
+            # silently dropping the checkpoint
+            m.save(_state(1), step=1)
+        fi.clear()
+
+    def test_async_kill_then_latest_falls_back(self, tmp_path):
+        """Preemption during the BACKGROUND persist: the reader-side
+        latest() must not raise — it drains and falls back."""
+        m = CheckpointManager(str(tmp_path), keep_n=3, async_save=True)
+        m.save(_state(0), step=0)
+        m.wait()
+        fi.inject("checkpoint.write", truncate_at=80, kill=True)
+        m.save(_state(1), step=1)
+        assert m.latest_step() == 0  # drains quietly, falls back
+        fi.clear()
+        with pytest.raises(fi.KillPoint):
+            m.wait()  # the writer-side barrier still reports the kill
+
+    def test_stats_shape(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        m.save(_state(0))
+        s = m.stats()
+        for key in ("saves", "async_saves", "bytes_written",
+                    "corrupt_skipped", "retired", "async_queue_depth"):
+            assert key in s
+        assert s["saves"] == 1 and s["bytes_written"] > 0

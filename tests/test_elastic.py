@@ -250,3 +250,118 @@ class TestReviewRegressions:
             assert f"all_reduce(group={g.id})" in wd.open_span_report()
         finally:
             dist.uninstall_watchdog()
+
+
+class TestElasticRobustness:
+    """ISSUE 2 satellite: flapping debounce, graceful leave, membership
+    under a fault-injected (flaky) store."""
+
+    def _store(self):
+        port = _free_port()
+        return TCPStore("127.0.0.1", port, is_master=True, world_size=1,
+                        backoff=0.01, backoff_max=0.05)
+
+    def test_flapping_heartbeat_is_debounced(self):
+        """A node blinking in and out of the alive set (slow beat, GC
+        pause) must NOT fire the rank-rewrite callback: the watch tick
+        requires the changed set to repeat for stability_ticks
+        consecutive scans. Driven through _watch_tick directly so no
+        sleep tuning is involved."""
+        store = self._store()
+        try:
+            events = []
+            m = ElasticManager(store, "0", ttl=5.0, interval=0.1,
+                               stability_ticks=3,
+                               on_membership_change=lambda a, i:
+                               events.append((list(a), i)))
+            m._register()
+            m._heartbeat_once()
+            m._known = ["0", "1"]
+            # node 1 flaps: absent for one scan, back, absent, back...
+            for flap in (["0"], ["0", "1"], ["0"], ["0", "1"]):
+                assert m._watch_tick(alive=flap) is None
+            assert events == []
+            # a REAL departure (stable for stability_ticks scans) fires
+            for _ in range(3):
+                m._watch_tick(alive=["0"])
+            assert events == [(["0"], 0)]
+        finally:
+            store.shutdown()
+
+    def test_graceful_leave_immediate(self):
+        """leave() deletes the heartbeat key: peers drop the node on the
+        very next scan instead of waiting out the TTL."""
+        store = self._store()
+        try:
+            m0 = ElasticManager(store, "0", ttl=30.0, interval=0.2)
+            m1 = ElasticManager(store, "1", ttl=30.0, interval=0.2)
+            for m in (m0, m1):
+                m._register()
+                m._heartbeat_once()
+            assert m0.alive_nodes() == ["0", "1"]
+            m1.leave()
+            # no TTL wait: the beat key is gone, exclusion is immediate
+            assert m0.alive_nodes() == ["0"]
+            # the roster slot survives (a rejoining node keeps its slot)
+            assert m1.node_id in m0.roster()
+        finally:
+            store.shutdown()
+
+    def test_membership_survives_flaky_store(self):
+        """Transient store failures during heartbeats/scans are absorbed
+        by the store's retry layer + the threads' consecutive-failure
+        tolerance; membership still converges."""
+        from paddle_tpu.utils import fault_injection as fi
+        store = self._store()
+        try:
+            events = []
+            m0 = ElasticManager(store, "0", ttl=2.0, interval=0.2,
+                                stability_ticks=2,
+                                on_membership_change=lambda a, i:
+                                events.append((list(a), i)))
+            m0.start()
+            # every op type flakes a couple of times while the threads run
+            fi.inject("store.add", exc=ConnectionResetError("flake"),
+                      times=3)
+            fi.inject("store.get_nowait",
+                      exc=ConnectionResetError("flake"), times=3)
+            m1 = ElasticManager(store, "1", ttl=2.0, interval=0.2)
+            m1.start()
+            deadline = time.time() + 15
+            while (not events or events[-1][0] != ["0", "1"]) and \
+                    time.time() < deadline:
+                time.sleep(0.2)
+            assert events and events[-1][0] == ["0", "1"], events
+            assert store.op_retries >= 1  # the flakes really happened
+            m1.stop()
+            m0.stop()
+        finally:
+            fi.clear()
+            store.shutdown()
+
+    def test_watch_thread_survives_transient_scan_failures(self):
+        """A run of scan failures below MAX_CONSECUTIVE_FAILURES must
+        not kill the watcher: a later real change still fires."""
+        store = self._store()
+        try:
+            events = []
+            m0 = ElasticManager(store, "0", ttl=2.0, interval=0.15,
+                                stability_ticks=2,
+                                on_membership_change=lambda a, i:
+                                events.append((list(a), i)))
+            m0.start()
+            from paddle_tpu.utils import fault_injection as fi
+            # three consecutive scan-side failures (tolerance is 5)
+            fi.inject("store.get_nowait",
+                      exc=ConnectionResetError("flake"), times=3)
+            m1 = ElasticManager(store, "1", ttl=2.0, interval=0.15)
+            m1.start()
+            deadline = time.time() + 15
+            while (not events or events[-1][0] != ["0", "1"]) and \
+                    time.time() < deadline:
+                time.sleep(0.2)
+            assert events and events[-1][0] == ["0", "1"], events
+            m1.stop()
+            m0.stop()
+        finally:
+            store.shutdown()

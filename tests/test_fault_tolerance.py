@@ -1,0 +1,170 @@
+"""Fault-injection harness semantics + TCPStore retry/backoff/deadline
+(ISSUE 2: store client ops survive transient transport failures; the
+injection utility itself must behave predictably since every robustness
+test in the suite leans on it)."""
+import socket
+import time
+
+import pytest
+
+from paddle_tpu.distributed import TCPStore
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def store():
+    st = TCPStore("127.0.0.1", _free_port(), is_master=True,
+                  world_size=1, backoff=0.01, backoff_max=0.05)
+    yield st
+    st.shutdown()
+
+
+class TestFaultInjection:
+    def test_unarmed_site_is_noop(self):
+        fi.fire("nothing.armed")  # must not raise
+
+    def test_times_and_clear(self):
+        fi.inject("x", times=2)
+        with pytest.raises(fi.InjectedFault):
+            fi.fire("x")
+        with pytest.raises(fi.InjectedFault):
+            fi.fire("x")
+        fi.fire("x")  # exhausted -> disarmed
+        fi.inject("x")
+        fi.clear("x")
+        fi.fire("x")
+
+    def test_skip_arms_the_nth_passage(self):
+        fi.inject("x", skip=2, times=1)
+        fi.fire("x")
+        fi.fire("x")
+        with pytest.raises(fi.InjectedFault):
+            fi.fire("x")
+
+    def test_kill_point_is_not_an_exception(self):
+        fi.inject("x", kill=True)
+        with pytest.raises(fi.KillPoint):
+            try:
+                fi.fire("x")
+            except Exception:  # noqa: BLE001 — the point of the test
+                pytest.fail("KillPoint must not be caught as Exception")
+
+    def test_write_bytes_truncates(self, tmp_path):
+        p = tmp_path / "f"
+        fi.inject("w", truncate_at=3)
+        with pytest.raises(fi.InjectedFault):
+            with open(p, "wb") as f:
+                fi.write_bytes("w", f, b"abcdef")
+        assert p.read_bytes() == b"abc"
+
+    def test_injected_context_manager_disarms(self):
+        with fi.injected("x", times=99):
+            with pytest.raises(fi.InjectedFault):
+                fi.fire("x")
+        fi.fire("x")  # disarmed on exit
+
+    def test_stats_accumulate(self):
+        before = fi.stats().get("y", 0)
+        fi.inject("y", times=3)
+        for _ in range(3):
+            with pytest.raises(fi.InjectedFault):
+                fi.fire("y")
+        assert fi.stats()["y"] == before + 3
+
+
+class TestStoreRetry:
+    def test_transient_failures_absorbed(self, store):
+        """The acceptance path: ops under injected transient failures
+        succeed via retry/backoff within the deadline."""
+        store.set("k", b"v")
+        fi.inject("store.get_nowait", exc=ConnectionResetError("flake"),
+                  times=3)
+        assert store.get_nowait("k") == b"v"
+        assert store.op_retries >= 3
+
+    def test_all_ops_retry(self, store):
+        store.set("seed", b"1")
+        for op, call in [
+            ("set", lambda: store.set("a", b"1")),
+            ("add", lambda: store.add("cnt", 2)),
+            ("get", lambda: store.get("a")),
+            ("get_nowait", lambda: store.get_nowait("a")),
+            ("delete", lambda: store.delete("a")),
+        ]:
+            fi.inject(f"store.{op}", exc=BrokenPipeError("flake"),
+                      times=2)
+            call()  # must succeed through the retries
+        assert store.op_retries >= 10
+
+    def test_retry_budget_exhausts_with_clear_error(self, store):
+        fi.inject("store.add", exc=ConnectionResetError("flake"),
+                  times=999)
+        with pytest.raises(ConnectionError,
+                           match="retry budget exhausted"):
+            store.add("c", 1)
+
+    def test_deadline_exhausts_with_clear_error(self):
+        st = TCPStore("127.0.0.1", _free_port(), is_master=True,
+                      world_size=1, max_retries=10_000, backoff=0.05,
+                      op_deadline=0.4)
+        try:
+            fi.inject("store.add", exc=ConnectionResetError("flake"),
+                      times=10 ** 6)
+            t0 = time.monotonic()
+            with pytest.raises(ConnectionError,
+                               match="deadline exceeded"):
+                st.add("c", 1)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            fi.clear()
+            st.shutdown()
+
+    def test_backoff_is_exponential_and_capped(self, store):
+        """Four retries at backoff=0.01 cap 0.05 sleep ~0.01+0.02+0.04
+        +0.05 — the op takes noticeably longer than a clean one but far
+        less than 4x the cap."""
+        fi.inject("store.add", exc=ConnectionResetError("flake"),
+                  times=4)
+        t0 = time.monotonic()
+        store.add("c", 1)
+        dt = time.monotonic() - t0
+        assert 0.05 < dt < 2.0, dt
+
+    def test_blocking_get_fails_bounded_on_shutdown(self, store):
+        """A blocking get interrupted by server shutdown fails within
+        the bounded retry budget (abort or connection error depending
+        on who wins the race) — it must never hang the caller."""
+        import threading
+        threading.Timer(0.3, store.shutdown).start()
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            store.get("never-set-key")
+        assert time.monotonic() - t0 < 10.0
+
+    def test_dead_server_fails_within_budget(self, store):
+        """Ops against a gone server exhaust the bounded retry budget
+        with a clear error instead of hanging."""
+        store.shutdown()
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="failed after"):
+            store.get("k")
+        assert time.monotonic() - t0 < 5.0
+
+    def test_barrier_still_works_under_flakes(self, store):
+        fi.inject("store.add", exc=ConnectionResetError("flake"),
+                  times=2)
+        store.barrier("b")  # world_size=1: arrive-and-release
+        assert store.op_retries >= 2

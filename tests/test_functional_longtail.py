@@ -2,6 +2,8 @@
 
 Oracles: torch (CPU) where the reference semantics match torch, else
 hand-rolled NumPy DPs (ref: test/legacy_test per-op tests)."""
+import os
+
 import numpy as np
 import pytest
 import torch
@@ -11,6 +13,8 @@ import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 
 
+@pytest.mark.skipif(not os.path.isdir("/root/reference"),
+                    reason="reference checkout absent in this container")
 class TestAPISurfaceComplete:
     def _ref_all(self, path):
         import ast

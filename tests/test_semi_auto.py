@@ -4,6 +4,8 @@ ref contract: python/paddle/distributed/auto_parallel/api.py
 shard_optimizer/:1613, shard_scaler/:2132, shard_dataloader/:2715,
 to_static/DistModel/Strategy.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -17,6 +19,8 @@ def _mesh1d(n=8, name="x"):
     return ProcessMesh(np.arange(n), dim_names=[name])
 
 
+@pytest.mark.skipif(not os.path.isdir("/root/reference"),
+                    reason="reference checkout absent in this container")
 class TestDistAllSurface:
     def test_distributed_all_covered(self):
         import ast

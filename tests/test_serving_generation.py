@@ -207,3 +207,106 @@ class TestServingErrorPaths:
         srv = GenerationServer(eng)
         with pytest.raises(ValueError, match="max_new_tokens"):
             srv.submit([1, 2], 0)
+
+
+class TestDeadlinesAndDrain:
+    """ISSUE 2: per-request deadlines + graceful drain-on-shutdown."""
+
+    def test_shutdown_drains_in_flight(self, model):
+        """Requests in flight (and already queued) when shutdown starts
+        run to completion with their full oracle token streams — no
+        completed token is dropped; new submissions are rejected."""
+        eng = LlamaDecodeEngine(model, max_slots=2, max_seq=64)
+        srv = GenerationServer(eng)
+        reqs = [srv.submit([1, 2, 3], 10), srv.submit([40, 41], 8),
+                srv.submit([7, 9, 2], 6)]  # 3rd waits queued
+        import time
+        for _ in range(200):
+            if srv.steps_run >= 1:
+                break
+            time.sleep(0.05)
+        assert srv.shutdown(drain=True, timeout=180)
+        for req, (p, n) in zip(reqs, [([1, 2, 3], 10), ([40, 41], 8),
+                                      ([7, 9, 2], 6)]):
+            assert req["done"].is_set()
+            assert req["error"] is None, req["error"]
+            assert list(req["out"]) == _oracle(model, p, n)
+        with pytest.raises(RuntimeError, match="shutting down"):
+            srv.submit([5], 2)
+        assert srv.stats()["rejected"] == 1
+        assert srv.stats()["drained"] == 1
+
+    def test_shutdown_no_drain_cancels_queued(self, model):
+        import time
+        eng = LlamaDecodeEngine(model, max_slots=1, max_seq=64)
+        orig_step = eng.step
+
+        def slow_step():  # hold the slot long enough that the queue
+            time.sleep(0.15)  # is still populated at shutdown time
+            return orig_step()
+
+        eng.step = slow_step
+        srv = GenerationServer(eng)
+        first = srv.submit([1, 2, 3], 8)
+        queued = [srv.submit([4, 5], 8) for _ in range(3)]
+        for _ in range(200):
+            if srv.steps_run >= 1:
+                break
+            time.sleep(0.05)
+        assert srv.shutdown(drain=False, timeout=180)
+        # the active request still finished intact
+        assert first["done"].is_set() and first["error"] is None
+        assert list(first["out"]) == _oracle(model, [1, 2, 3], 8)
+        # at least the tail of the queue was cancelled cleanly
+        cancelled = [r for r in queued
+                     if isinstance(r["error"], RuntimeError)]
+        assert cancelled, [r["error"] for r in queued]
+        for r in queued:
+            assert r["done"].is_set()
+
+    def test_queued_deadline_expires(self, model):
+        """A request whose deadline passes while it waits in the queue
+        fails with TimeoutError without consuming a slot."""
+        import time
+        eng = LlamaDecodeEngine(model, max_slots=1, max_seq=64)
+        orig_step = eng.step
+
+        def slow_step():  # hold the slot past the queued deadline on
+            time.sleep(0.02)  # fast hosts too
+            return orig_step()
+
+        eng.step = slow_step
+        srv = GenerationServer(eng)
+        blocker = srv.submit([1, 2, 3], 30)      # hog the only slot
+        starved = srv.submit([9, 8], 8, deadline=0.2)
+        with pytest.raises(ValueError, match="deadline"):
+            srv.submit([1, 2], 4, deadline=0.0)
+        assert starved["done"].wait(60)
+        assert isinstance(starved["error"], TimeoutError)
+        assert blocker["done"].wait(120)
+        assert blocker["error"] is None
+        assert srv.stats()["deadline_expired"] >= 1
+        srv.shutdown()
+
+    def test_active_deadline_keeps_partial_tokens(self, model):
+        """An active request that exceeds its deadline is failed at a
+        step boundary but keeps the tokens it already produced."""
+        import time
+        eng = LlamaDecodeEngine(model, max_slots=1, max_seq=256)
+        orig_step = eng.step
+
+        def slow_step():  # pin step cost so the deadline bites on any
+            time.sleep(0.05)  # host, fast or slow
+            return orig_step()
+
+        eng.step = slow_step
+        srv = GenerationServer(eng)
+        req = srv.submit(list(range(1, 6)), 200, deadline=0.75)
+        assert req["done"].wait(120)
+        assert isinstance(req["error"], TimeoutError)
+        assert len(req["out"]) >= 1          # partial stream retained
+        assert len(req["out"]) < 200
+        # the slot was freed: a fresh request still serves
+        out = srv.generate([1, 2, 3], 2, timeout=60)
+        assert out == _oracle(model, [1, 2, 3], 2)
+        srv.shutdown()

@@ -383,7 +383,8 @@ class TestGatherAvoidsGspmdReplicate:
         def local(t_, i_):
             return jnp.take(t_, i_, axis=0)
 
-        f = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+        from paddle_tpu.distributed._mesh_axes import shard_map
+        f = jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
                                   out_specs=out_spec, check_vma=False))
         tr = jax.device_put(table, NamedSharding(mesh, P(None, None)))
         ids = jax.device_put(jnp.asarray(ids_np),
