@@ -70,6 +70,7 @@ from . import models  # noqa: F401
 from . import audio  # noqa: F401
 from . import text  # noqa: F401
 from . import utils  # noqa: F401
+from . import observability  # noqa: F401  (unified telemetry runtime)
 from . import inference  # noqa: F401
 # NOTE: paddle_tpu.profiler is intentionally NOT imported here — it pulls
 # in the native extension, whose first import compiles C++; users import

@@ -1,6 +1,6 @@
 """paddle.callbacks namespace (ref: python/paddle/callbacks.py re-exports
 the hapi callbacks)."""
 from .hapi.callbacks import (  # noqa: F401
-    Callback, EarlyStopping, History, LRScheduler, ModelCheckpoint,
-    ProgBarLogger, VisualDL,
+    Callback, EarlyStopping, History, LRScheduler, MetricsLogger,
+    ModelCheckpoint, ProgBarLogger, VisualDL,
 )

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import math as _math
 import threading
+import time as _time
 import weakref
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
@@ -43,6 +44,7 @@ import numpy as np
 from . import autograd as _ag
 from . import memory as _memory
 from .flags import _registry as _flag_registry
+from ..observability import metrics as _om
 
 __all__ = ["stats", "reset_stats", "clear_cache", "register_impl",
            "enabled", "materialize_tensor"]
@@ -57,6 +59,33 @@ _INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31
 # program slot. jnp.asarray keeps python scalars weak-typed, so
 # promotion semantics match the eager `jnp.add(x, 0.25)` exactly.
 _scalar_cache: Dict[tuple, Any] = {}
+
+# -- telemetry: the registry IS the storage; fusion.stats() below is a
+# view reconstructing the legacy dict shape from these instruments
+_M_flag = _om.flag_info()
+_M = _om.scope("fusion")
+_M_deferred = _M.counter("ops_deferred_total",
+                         "Fusable dispatches deferred into expression DAGs")
+_M_chains = _M.counter("chains_flushed_total", "Fused programs executed")
+_M_ops_fused = _M.counter("ops_fused_total",
+                          "Ops executed through fused programs")
+_M_hits = _M.counter("cache_hits_total",
+                     "Flushes served by a cached executable")
+_M_misses = _M.counter("cache_misses_total",
+                       "Flushes that compiled a new program")
+_M_uncompiled = _M.counter("uncompiled_runs_total",
+                           "First-sighting flushes run un-jitted")
+_M_fallbacks = _M.counter("jit_fallbacks_total",
+                          "Flushes that fell back to un-jitted eval")
+_M_flushes = _M.counter("flushes_total", "Chain flushes by reason")
+_M_chain_len = _M.counter("chain_length", "Ops-per-chain distribution")
+_M_compile_s = _M.histogram(
+    "compile_seconds", "First execution (trace+compile) of a freshly "
+    "built fused program")
+_om.default_registry().gauge(
+    "fusion.cache_size",
+    "Live fused-program cache entries").set_function(
+        lambda: len(_cache))
 
 
 def _intern_scalar(v):
@@ -307,7 +336,8 @@ def try_fuse(name: str, fn, args, kwargs):
     expr = LazyExpr(name, tuple(entries), tuple(bufs), tuple(adiff),
                     aval[0], aval[1], aval[2], nops)
     t = _new_lazy_tensor(expr)
-    _stats["ops_deferred"] += 1
+    if _M_flag.value:
+        _M_deferred._v += 1  # inline fast cell: per-deferral hot path
     if nops >= max(int(_max_chain.value or 32), 2):
         _flush(expr, "cap")
     return t
@@ -368,6 +398,24 @@ def _build_program(sig):
 _SEEN = object()  # first-sighting marker: structure noted, not compiled
 
 
+def _timed_first_call(jf):
+    """Wrap a freshly built jitted forward so its FIRST execution (the
+    one that traces+compiles) lands in fusion.compile_seconds; later
+    calls pay one flag check."""
+    done = [False]
+
+    def wrapper(*a):
+        if done[0]:
+            return jf(*a)
+        t0 = _time.perf_counter()
+        out = jf(*a)
+        done[0] = True
+        _M_compile_s.observe(_time.perf_counter() - t0)
+        return out
+
+    return wrapper
+
+
 def _get_program(sig):
     """Compile policy mirrors autograd's pair cache: a chain structure
     only compiles on its SECOND sighting. One-off chains (test suites,
@@ -378,18 +426,19 @@ def _get_program(sig):
         entry = _cache.get(sig)
         if entry is not None and entry is not _SEEN:
             _cache.move_to_end(sig)
-            _stats["cache_hits"] += 1
+            _M_hits.inc()
             return entry
     if entry is _SEEN:
-        _stats["cache_misses"] += 1
+        _M_misses.inc()
         built = _build_program(sig)
+        built = (built[0], _timed_first_call(built[1]), built[2])
         with _cache_lock:
             _cache[sig] = built
             cap = max(int(_cache_cap.value or 256), 8)
             while len(_cache) > cap:
                 _cache.popitem(last=False)
         return built
-    _stats["uncompiled_runs"] += 1
+    _M_uncompiled.inc()
     with _cache_lock:
         _cache[sig] = _SEEN
         cap = max(int(_cache_cap.value or 256), 8)
@@ -528,7 +577,7 @@ def _flush(root: LazyExpr, reason: str) -> None:
         except Exception:
             # jit-specific failure (e.g. resource pressure during the
             # compile): the un-jitted trace has identical semantics
-            _stats["jit_fallbacks"] += 1
+            _M_fallbacks.inc()
             outs = fused(*leaf_vals)
 
     # -- grad wiring: ONE GradNode over the fused program ----------------
@@ -579,51 +628,50 @@ def _flush(root: LazyExpr, reason: str) -> None:
             t._node = node
             t._out_index = k
 
-    _stats["chains_flushed"] += 1
-    _stats["ops_fused"] += len(order)
-    _stats["flush_reasons"][reason] = \
-        _stats["flush_reasons"].get(reason, 0) + 1
-    h = _stats["chain_length_hist"]
-    h[len(order)] = h.get(len(order), 0) + 1
+    _M_chains.inc()
+    _M_ops_fused.inc(len(order))
+    _M_flushes.inc(reason=reason)
+    _M_chain_len.inc(**{"len": len(order)})
 
 
 # ---------------------------------------------------------------------------
 # observability
 # ---------------------------------------------------------------------------
 
-def _fresh_stats() -> Dict[str, Any]:
-    return {
-        "ops_deferred": 0,      # fusable dispatches deferred into DAGs
-        "chains_flushed": 0,    # fused programs executed
-        "ops_fused": 0,         # total ops executed through fused programs
-        "cache_hits": 0,        # flushes served by a cached executable
-        "cache_misses": 0,      # flushes that compiled a new program
-        "uncompiled_runs": 0,   # first-sighting flushes run un-jitted
-        "jit_fallbacks": 0,     # flushes that fell back to un-jitted eval
-        "flush_reasons": {},    # reason -> count
-        "chain_length_hist": {},  # ops-per-chain -> count
-    }
-
-
-_stats = _fresh_stats()
-
-
 def stats() -> Dict[str, Any]:
     """Counter snapshot: chains built, cache hits/misses, flush reasons,
-    ops-per-chain histogram, live cache size."""
-    snap = dict(_stats)
-    snap["flush_reasons"] = dict(_stats["flush_reasons"])
-    snap["chain_length_hist"] = dict(_stats["chain_length_hist"])
-    snap["cache_size"] = len(_cache)
-    snap["avg_ops_per_chain"] = (
-        _stats["ops_fused"] / _stats["chains_flushed"]
-        if _stats["chains_flushed"] else 0.0)
+    ops-per-chain histogram, live cache size.
+
+    Since the telemetry unification this is a VIEW over the process
+    registry (``observability.snapshot()['fusion']`` carries the same
+    counters); with ``FLAGS_metrics=0`` the counters freeze."""
+    chains = _M_chains.value()
+    ops_fused = _M_ops_fused.value()
+    snap = {
+        "ops_deferred": _M_deferred.value(),
+        "chains_flushed": chains,
+        "ops_fused": ops_fused,
+        "cache_hits": _M_hits.value(),
+        "cache_misses": _M_misses.value(),
+        "uncompiled_runs": _M_uncompiled.value(),
+        "jit_fallbacks": _M_fallbacks.value(),
+        # labeled registry cells back to the legacy dict shapes (label
+        # values keep their Python type, so chain lengths come back int)
+        "flush_reasons": {k[0][1]: v
+                          for k, v in _M_flushes.series().items() if k},
+        "chain_length_hist": {k[0][1]: v
+                              for k, v in _M_chain_len.series().items()
+                              if k},
+        "cache_size": len(_cache),
+        "avg_ops_per_chain": ops_fused / chains if chains else 0.0,
+    }
     return snap
 
 
 def reset_stats() -> None:
-    global _stats
-    _stats = _fresh_stats()
+    for m in (_M_deferred, _M_chains, _M_ops_fused, _M_hits, _M_misses,
+              _M_uncompiled, _M_fallbacks, _M_flushes, _M_chain_len):
+        m.reset()
 
 
 def clear_cache() -> None:

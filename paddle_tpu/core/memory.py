@@ -35,6 +35,7 @@ import jax
 from jax.sharding import SingleDeviceSharding
 
 from .._native import lib as _native
+from ..observability import metrics as _om
 
 _ALLOC = "allocated"
 
@@ -241,6 +242,26 @@ def stats_for(device) -> Optional[Dict[str, int]]:
         "reserved.peak": int(peak),
         "pjrt": None,
     }
+
+
+# snapshot-time registry view over the op-funnel tracker counters —
+# nothing added to the per-buffer track() hot path
+def _collect_memory():
+    cur: Dict[str, int] = {}
+    peak: Dict[str, int] = {}
+    for key in list({k for k in _key_cache.values()}):
+        c, p = _get(key)
+        label = key[len(_ALLOC) + 1:]  # "cpu:0", "tpu:3", ...
+        cur[label] = int(c)
+        peak[label] = int(p)
+    out = {}
+    if cur:
+        out["memory.tracked_bytes"] = cur
+        out["memory.tracked_peak_bytes"] = peak
+    return out
+
+
+_om.register_collector("memory", _collect_memory)
 
 
 def reset_peak(device) -> None:

@@ -726,13 +726,55 @@ def destroy_process_group(group: Optional[Group] = None):
         _group_map.pop(group.id, None)
 
 
-# -- watchdog instrumentation -------------------------------------------------
+# -- watchdog + telemetry instrumentation -------------------------------------
 # every eager collective runs inside a named span so an installed watchdog
 # (watchdog.install_watchdog) attributes hangs to the exact operation —
 # the reference's per-CommTask start/end tracking
 # (ref: comm_task_manager.h:37-57). Free when no watchdog is installed.
+# The registry additionally gets per-collective call + payload-byte
+# counters (the comm_task_manager bytes attribution); span latency lands
+# in watchdog.span_seconds when a watchdog is installed.
+
+from ..observability import metrics as _om  # noqa: E402
+
+_M_coll_calls = _om.counter(
+    "collectives.calls_total", "Eager collective invocations by op")
+_M_coll_bytes = _om.counter(
+    "collectives.bytes_total",
+    "Input tensor payload bytes entering eager collectives by op "
+    "(best-effort: positional payload args only)")
+
+# which positional arg(s) carry the INPUT payload per op — several
+# collectives take their output buffer first (all_gather, scatter,
+# reduce_scatter, alltoall), and counting that would inflate bytes with
+# buffers no payload entered through
+_PAYLOAD_ARGS = {
+    "all_reduce": (0,), "all_gather": (1,), "broadcast": (0,),
+    "reduce": (0,), "scatter": (1,), "reduce_scatter": (1,),
+    "alltoall": (1,), "alltoall_single": (1,), "send": (0,),
+}
+
+
+def _payload_bytes(opname, args) -> int:
+    """Concrete input-tensor bytes for one collective call (lists of
+    tensors included — scatter/alltoall take them). Lazy
+    (unmaterialized) fusion handles and payloads passed as kwargs are
+    skipped rather than forced/guessed."""
+    n = 0
+    for i in _PAYLOAD_ARGS.get(opname, ()):
+        if i >= len(args):
+            continue
+        a = args[i]
+        for t in (a if isinstance(a, (list, tuple)) else (a,)):
+            buf = getattr(t, "_buf", None)
+            if buf is not None:
+                n += int(getattr(buf, "nbytes", 0) or 0)
+    return n
+
 
 def _spanned(fn):
+    opname = fn.__name__
+
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         from .watchdog import collective_span
@@ -740,7 +782,12 @@ def _spanned(fn):
         if not isinstance(g, Group):  # group may be passed positionally
             g = next((a for a in args if isinstance(a, Group)), None)
         gid = g.id if isinstance(g, Group) else 0
-        with collective_span(f"{fn.__name__}(group={gid})"):
+        if _om.enabled():
+            _M_coll_calls.inc(op=opname)
+            nbytes = _payload_bytes(opname, args)
+            if nbytes:
+                _M_coll_bytes.inc(nbytes, op=opname)
+        with collective_span(f"{opname}(group={gid})"):
             return fn(*args, **kwargs)
     return wrapper
 

@@ -20,9 +20,17 @@ import time
 from typing import Optional
 
 from .._native import lib as _lib
+from ..observability import metrics as _om
 from ..utils import fault_injection as _fi
 
 __all__ = ["TCPStore"]
+
+_M_retries = _om.counter(
+    "store.op_retries_total",
+    "Transient TCPStore transport failures absorbed by the retry loop")
+_M_failures = _om.counter(
+    "store.op_failures_total",
+    "TCPStore ops that exhausted their retry budget/deadline")
 
 # transient transport errors worth retrying (BrokenPipeError is already
 # a ConnectionError). Deliberately NOT all of OSError: a structurally
@@ -99,11 +107,13 @@ class TCPStore:
                            f"({self.max_retries} retries)"
                            if attempt > self.max_retries else
                            f"op deadline exceeded ({self.op_deadline}s)")
+                    _M_failures.inc(op=op)
                     raise ConnectionError(
                         f"TCPStore {op} to {self.host}:{self.port} failed "
                         f"after {attempt} attempt(s): {why}; last error: "
                         f"{type(e).__name__}: {e}") from e
                 self.op_retries += 1
+                _M_retries.inc(op=op)
                 sleep = min(self.backoff * (2 ** (attempt - 1)),
                             self.backoff_max, max(remaining, 0.0))
                 if sleep > 0:

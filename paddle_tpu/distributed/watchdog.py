@@ -17,8 +17,19 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..observability import metrics as _om
+
 __all__ = ["Watchdog", "WatchdogTimeout", "WatchdogBusy",
            "collective_span", "install_watchdog", "uninstall_watchdog"]
+
+# span completions feed the process registry (the reference's
+# comm_task_manager per-collective attribution, now queryable without a
+# trace dump): latency histogram per span name + timeout counters
+_M_span_s = _om.histogram(
+    "watchdog.span_seconds",
+    "Completed watchdog span durations (collectives, steps) by name")
+_M_timeouts = _om.counter(
+    "watchdog.timeouts_total", "Spans/steps that exceeded the timeout")
 
 
 class WatchdogTimeout(RuntimeError):
@@ -77,11 +88,13 @@ class Watchdog:
         finally:
             with self._span_lock:
                 entry = self._open_spans.pop(sid, None)
-                if entry is not None:
-                    name_, t0, flagged = entry
+            if entry is not None:
+                name_, t0, flagged = entry
+                dt = time.monotonic() - t0
+                _M_span_s.observe(dt, name=name_)
+                with self._span_lock:
                     self._recent_spans.append(
-                        (name_ + (" [timed out]" if flagged else ""),
-                         time.monotonic() - t0))
+                        (name_ + (" [timed out]" if flagged else ""), dt))
 
     def open_span_report(self) -> str:
         with self._span_lock:
@@ -114,6 +127,7 @@ class Watchdog:
                         if entry is None or entry[2]:
                             continue
                         entry[2] = True  # flag in place; span stays open
+                    _M_timeouts.inc()
                     dump = self._dump_trace()
                     self.timed_out_spans.append((name, age, dump))
                     import sys
@@ -181,6 +195,7 @@ class Watchdog:
         t.start()
         if not done.wait(self.timeout):
             self._stuck_thread = t
+            _M_timeouts.inc()
             dump = self._dump_trace()
             abort_err = None
             if self.on_timeout is not None:

@@ -36,10 +36,12 @@ import os
 import pickle
 import re
 import threading
+import time as _time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.flags import define_flag, flag_value
+from ..observability import metrics as _om
 from ..utils import fault_injection as _fi
 from .io import _TensorPayload, _pack, _unpack
 
@@ -58,6 +60,23 @@ define_flag("checkpoint_fsync", True,
 
 class CheckpointCorruptError(RuntimeError):
     """A checkpoint failed CRC/structure verification at load."""
+
+
+# process-wide durability telemetry (aggregates across every manager and
+# bare paddle.save; per-instance CheckpointManager.stats() stays the
+# legacy per-directory view)
+_M = _om.scope("checkpoint")
+_M_saves = _M.counter("saves_total", "Durable checkpoint persists")
+_M_bytes = _M.counter("bytes_written_total", "Serialized checkpoint bytes")
+_M_save_s = _M.histogram(
+    "save_seconds", "Wall seconds per durable persist "
+    "(serialize + write + fsync + rename)")
+_M_loads = _M.counter("loads_total", "Checkpoints loaded successfully")
+_M_corrupt = _M.counter(
+    "corrupt_skipped_total",
+    "Damaged checkpoints skipped by latest()/restore() fallback")
+_M_async = _M.counter("async_saves_total", "Async save submissions")
+_M_retired = _M.counter("retired_total", "Checkpoints pruned by retention")
 
 
 # -- manifest -------------------------------------------------------------
@@ -132,6 +151,7 @@ def atomic_save(obj, path: str, protocol: int = 4) -> int:
 def _persist_packed(packed, path: str, protocol: int = 4) -> int:
     """The durable half of a save (async mode runs this off-thread):
     serialize the already-host-resident tree, write-fsync-rename."""
+    t0 = _time.perf_counter()
     record = {FORMAT_KEY: FORMAT_VERSION,
               "manifest": _build_manifest(packed),
               "payload": packed}
@@ -159,6 +179,9 @@ def _persist_packed(packed, path: str, protocol: int = 4) -> int:
         raise
     if flag_value("checkpoint_fsync"):
         _fsync_dir(d)
+    _M_saves.inc()
+    _M_bytes.inc(len(blob))
+    _M_save_s.observe(_time.perf_counter() - t0)
     return len(blob)
 
 
@@ -191,6 +214,7 @@ def load_checkpoint(path: str, return_numpy: bool = False,
             raise CheckpointCorruptError(
                 f"{path}: {len(bad)} corrupt tensor(s): "
                 + "; ".join(bad[:4]))
+    _M_loads.inc()
     return _unpack(packed, return_numpy=return_numpy)
 
 
@@ -301,6 +325,7 @@ class CheckpointManager:
         with self._lock:
             self._pending = t
             self._stats["async_saves"] += 1
+        _M_async.inc()
         return path
 
     def _persist(self, packed, path: str) -> None:
@@ -316,6 +341,7 @@ class CheckpointManager:
                 os.remove(self._path(step))
                 with self._lock:
                     self._stats["retired"] += 1
+                _M_retired.inc()
             except OSError:
                 pass  # already gone / transient: retry next save
 
@@ -361,6 +387,7 @@ class CheckpointManager:
                 return path
             with self._lock:
                 self._stats["corrupt_skipped"] += 1
+            _M_corrupt.inc()
         return None
 
     def _step_of(self, path: str) -> int:
@@ -383,6 +410,7 @@ class CheckpointManager:
             except Exception:  # noqa: BLE001 — damaged: fall back
                 with self._lock:
                     self._stats["corrupt_skipped"] += 1
+                _M_corrupt.inc()
                 continue
             return step, obj
         return None

@@ -10,8 +10,8 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL", "History", "CallbackList",
-           "config_callbacks"]
+           "EarlyStopping", "VisualDL", "History", "MetricsLogger",
+           "CallbackList", "config_callbacks"]
 
 
 class Callback:
@@ -225,6 +225,75 @@ class EarlyStopping(Callback):
                           f"{self.patience} evals")
                 if self.best_weights is not None:
                     self.model.network.set_state_dict(self.best_weights)
+
+
+class MetricsLogger(Callback):
+    """Telemetry bridge for ``Model.fit``: drives an
+    ``observability.timeline.StepTimer`` through the batch boundaries
+    (whole-step wall time lands in ``step.step_seconds`` and as
+    chrome-trace counter events merged into ``export_chrome_tracing``)
+    and mirrors batch/epoch logs into registry gauges
+    (``train.<metric>`` / ``eval.<metric>``), so one
+    ``observability.snapshot()`` after fit() carries loss curves next to
+    dispatch/fusion/checkpoint counters.
+
+    ``log_freq > 0`` additionally prints a compact one-line registry
+    digest every N batches (dispatched ops, fused chains, step
+    seconds) — the "what did the last N steps look like" answer without
+    a trace file."""
+
+    def __init__(self, log_freq: int = 0, timer_name: str = "hapi"):
+        super().__init__()
+        self.log_freq = int(log_freq)
+        self.timer_name = timer_name
+        self.timer = None
+
+    def _gauges(self):
+        from ..observability import metrics as om
+        return om
+
+    def on_train_begin(self, logs=None):
+        from ..observability.timeline import StepTimer
+        if self.timer is None:
+            self.timer = StepTimer(self.timer_name)
+        self._phase_cm = None
+
+    def on_train_batch_begin(self, step, logs=None):
+        if self.timer is None:
+            return
+        self._phase_cm = self.timer.phase("step")
+        self._phase_cm.__enter__()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.timer is None:
+            return
+        if self._phase_cm is not None:
+            self._phase_cm.__exit__(None, None, None)
+            self._phase_cm = None
+        phases = self.timer.step()
+        om = self._gauges()
+        for k, v in (logs or {}).items():
+            try:
+                om.gauge(f"train.{k}").set(
+                    float(np.asarray(v).reshape(-1)[0]))
+            except (TypeError, ValueError):
+                continue
+        if self.log_freq > 0 and step % self.log_freq == 0:
+            snap = om.snapshot()
+            disp = snap.get("dispatch", {}).get("ops_total", 0)
+            chains = snap.get("fusion", {}).get("chains_flushed_total", 0)
+            print(f"[metrics] step {step}: "
+                  f"step_s={phases.get('step', 0.0):.4f} "
+                  f"ops_dispatched={disp} fused_chains={chains}")
+
+    def on_eval_end(self, logs=None):
+        om = self._gauges()
+        for k, v in (logs or {}).items():
+            try:
+                om.gauge(f"eval.{k}").set(
+                    float(np.asarray(v).reshape(-1)[0]))
+            except (TypeError, ValueError):
+                continue
 
 
 class VisualDL(Callback):

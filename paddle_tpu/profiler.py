@@ -28,20 +28,24 @@ class ProfilerTarget:
 class RecordEvent:
     """Host-span annotation (ref: paddle.profiler.RecordEvent; native analog
     platform/profiler/event_tracing.h RecordEvent). Usable as context
-    manager or begin()/end() pair."""
+    manager or begin()/end() pair.
+
+    Reentrant: a second ``begin()`` before ``end()`` nests (each ``end``
+    closes the most recent open ``begin``, LIFO) instead of silently
+    dropping the first span's start."""
 
     def __init__(self, name: str):
         self.name = name
-        self._t0: Optional[float] = None
+        self._starts: list = []
 
     def begin(self):
         if _lib is not None and _lib.tracer_enabled():
-            self._t0 = _lib.tracer_now()
+            self._starts.append(_lib.tracer_now())
 
     def end(self):
-        if _lib is not None and self._t0 is not None:
-            _lib.tracer_record(self.name, self._t0, _lib.tracer_now())
-            self._t0 = None
+        if _lib is not None and self._starts:
+            _lib.tracer_record(self.name, self._starts.pop(),
+                               _lib.tracer_now())
 
     def __enter__(self):
         self.begin()
@@ -63,15 +67,22 @@ class Profiler:
                  profile_memory=False, scheduler=None):
         self.targets = targets or [ProfilerTarget.CPU]
         self.on_trace_ready = on_trace_ready
+        self.timer_only = bool(timer_only)
         self._device_dir: Optional[str] = None
         self._running = False
         self._step_count = 0
+        self._step_t0: Optional[float] = None
 
     def start(self):
         if _lib is not None:
             _lib.tracer_start()
-        if ProfilerTarget.TPU in self.targets or \
-                ProfilerTarget.GPUTrace in self.targets:
+            self._step_t0 = _lib.tracer_now()
+        # timer_only (ref: Profiler(timer_only=True) — step timing
+        # without event collection) keeps the cheap host plane but skips
+        # the device (XLA) trace entirely
+        if not self.timer_only and (
+                ProfilerTarget.TPU in self.targets
+                or ProfilerTarget.GPUTrace in self.targets):
             import jax
             self._device_dir = os.environ.get(
                 "PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
@@ -98,7 +109,18 @@ class Profiler:
             self.on_trace_ready(self)
 
     def step(self):
+        """Mark a step boundary: the window since start()/the previous
+        step() lands in the host trace as a ``ProfileStep#N`` span (ref:
+        profiler.py RecordEvent(\"ProfileStep#{id}\") around each
+        scheduler step) — summary() and the chrome export then break
+        time down per step instead of one undifferentiated run."""
         self._step_count += 1
+        if _lib is not None and _lib.tracer_enabled() \
+                and self._step_t0 is not None:
+            now = _lib.tracer_now()
+            _lib.tracer_record(f"ProfileStep#{self._step_count}",
+                               self._step_t0, now)
+            self._step_t0 = now
 
     def __enter__(self):
         return self.start()
@@ -122,6 +144,8 @@ class Profiler:
         agg = {}
         grand = 0.0
         for e in data.get("traceEvents", []):
+            if e.get("ph") == "C":
+                continue  # timeline counter events are not spans
             dur = float(e.get("dur", 0.0))
             rec = agg.setdefault(e["name"], [0, 0.0, 0.0, float("inf")])
             rec[0] += 1
@@ -129,6 +153,10 @@ class Profiler:
             rec[2] = max(rec[2], dur)
             rec[3] = min(rec[3], dur)
             grand += dur
+        if not agg:
+            return ("no events recorded (host tracer buffer is empty — "
+                    "was the profiler started, and did any RecordEvent/"
+                    "step() run inside it?)")
         units = {"ms": 1e3, "us": 1.0, "s": 1e6}
         if time_unit not in units:
             raise ValueError(
@@ -151,12 +179,26 @@ class Profiler:
 
 def export_chrome_tracing(path: str, worker_name=None):
     """Write the host plane as chrome://tracing JSON
-    (ref: chrometracing_logger.cc)."""
+    (ref: chrometracing_logger.cc), merged with the step-timeline
+    plane: every live ``observability.timeline.StepTimer``'s per-step
+    phase counter events (``"ph": "C"``) land in the same file, so one
+    trace carries spans AND metric time series (chrome://tracing /
+    Perfetto render counters as stacked area tracks)."""
     if _lib is None:
         raise RuntimeError("native tracer unavailable")
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    dump = _lib.tracer_dump()
+    try:
+        from .observability import timeline as _timeline
+        counters = _timeline.chrome_events()
+    except Exception:
+        counters = []
+    if counters:
+        data = json.loads(dump)
+        data.setdefault("traceEvents", []).extend(counters)
+        dump = json.dumps(data)
     with open(path, "w") as f:
-        f.write(_lib.tracer_dump())
+        f.write(dump)
     return path

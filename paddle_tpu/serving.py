@@ -37,7 +37,30 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .observability import metrics as _om
+
 __all__ = ["LlamaDecodeEngine", "GenerationServer"]
+
+# process registry instruments (one set across all servers; the
+# per-instance stats() dict stays the legacy view)
+_M = _om.scope("serving")
+_M_admitted = _M.counter("admitted_total", "Requests admitted into slots")
+_M_rejected = _M.counter("rejected_total",
+                         "Submissions rejected (server shutting down)")
+_M_expired = _M.counter("deadline_expired_total",
+                        "Requests failed by their deadline")
+_M_failed = _M.counter("failed_total",
+                       "Requests completed with an error")
+_M_steps = _M.counter("steps_total", "Decode steps run by server loops")
+_M_tokens = _M.counter("tokens_total", "Tokens delivered to requests")
+_M_req_s = _M.histogram("request_seconds",
+                        "Submit-to-completion wall time per request")
+_M_token_s = _M.histogram(
+    "token_seconds",
+    "Per-token latency: request wall time / tokens produced")
+_G_queue = _M.gauge("queue_depth",
+                    "Requests waiting in the submission queue")
+_G_inflight = _M.gauge("in_flight", "Requests currently holding a slot")
 
 
 def _quantize_w(w_t):
@@ -444,8 +467,21 @@ class GenerationServer:
         # BEFORE stopping becomes visible, so the drain loop (which
         # only exits on stopping AND empty queue) cannot strand it
         self._submit_lock = threading.Lock()
+        self._metrics_server = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def metrics_endpoint(self, port: int = 0, host: str = "127.0.0.1"):
+        """Serve the process metrics registry over HTTP: ``GET /metrics``
+        (Prometheus text exposition) + ``/metrics.json`` (the nested
+        snapshot). Idempotent per server; the endpoint is closed by
+        ``shutdown()``. Returns the handle (``.url``, ``.port``,
+        ``.close()``)."""
+        if self._metrics_server is None:
+            from .observability.http import start_metrics_server
+            self._metrics_server = start_metrics_server(port=port,
+                                                        host=host)
+        return self._metrics_server
 
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                deadline: Optional[float] = None) -> dict:
@@ -453,6 +489,7 @@ class GenerationServer:
         total wall time; None = unbounded."""
         if self._stopping.is_set():
             self.rejected += 1
+            _M_rejected.inc()
             raise RuntimeError(
                 "GenerationServer is shutting down; new submissions are "
                 "rejected (in-flight requests are draining)")
@@ -465,11 +502,13 @@ class GenerationServer:
         req = {"prompt": np.asarray(prompt_ids, np.int32).reshape(-1),
                "max_new": int(max_new_tokens), "out": [],
                "done": threading.Event(), "error": None,
+               "t0": time.monotonic(),
                "expires": (time.monotonic() + deadline
                            if deadline is not None else None)}
         with self._submit_lock:
             if self._stopping.is_set():
                 self.rejected += 1
+                _M_rejected.inc()
                 raise RuntimeError(
                     "GenerationServer is shutting down; new submissions "
                     "are rejected (in-flight requests are draining)")
@@ -493,6 +532,20 @@ class GenerationServer:
     def _fail(self, req, error) -> None:
         req["error"] = error
         req["done"].set()
+        _M_failed.inc()
+        self._observe_done(req)
+
+    @staticmethod
+    def _observe_done(req) -> None:
+        """Request-completion telemetry: tokens delivered (partial counts
+        too — a deadline-failed request keeps its tokens) + wall time +
+        per-token latency."""
+        tokens = len(req["out"])
+        if tokens:
+            _M_tokens.inc(tokens)
+        dt = time.monotonic() - req["t0"]
+        _M_req_s.observe(dt)
+        _M_token_s.observe(dt / max(tokens, 1))
 
     def _admit_one(self, req, slot) -> None:
         eng = self.engine
@@ -500,6 +553,7 @@ class GenerationServer:
             return  # sentinel, or already failed while queued
         if self._expired(req):
             self.deadline_expired += 1
+            _M_expired.inc()
             self._fail(req, TimeoutError(
                 "request deadline expired while queued"))
             return
@@ -511,6 +565,7 @@ class GenerationServer:
         req["out"].append(first)
         self._slots[slot] = req
         self.admitted += 1
+        _M_admitted.inc()
         self._finish_if_done(slot, req)
 
     def _free_slots(self):
@@ -541,6 +596,7 @@ class GenerationServer:
             eng.release(slot)
             del self._slots[slot]
             req["done"].set()
+            self._observe_done(req)
         return done
 
     def _expire_active(self):
@@ -551,6 +607,7 @@ class GenerationServer:
             req = self._slots[slot]
             if self._expired(req):
                 self.deadline_expired += 1
+                _M_expired.inc()
                 self.engine.release(slot)
                 del self._slots[slot]
                 self._fail(req, TimeoutError(
@@ -568,6 +625,7 @@ class GenerationServer:
             if req is not self._STOP and not req["done"].is_set() \
                     and self._expired(req):
                 self.deadline_expired += 1
+                _M_expired.inc()
                 self._fail(req, TimeoutError(
                     "request deadline expired while queued"))
 
@@ -581,6 +639,7 @@ class GenerationServer:
                     # idle: block for the next request and admit it
                     # DIRECTLY — a get-then-requeue would let requests
                     # submitted in the window jump ahead of it (FIFO)
+                    self._set_gauges()  # idle: a scrape must read 0
                     req = self._q.get()
                     if req is self._STOP:
                         continue
@@ -588,18 +647,29 @@ class GenerationServer:
                     continue
                 nxt = self.engine.step()
                 self.steps_run += 1
+                _M_steps.inc()
                 for slot in list(self._slots):
                     req = self._slots[slot]
                     req["out"].append(int(nxt[slot]))
                     self._finish_if_done(slot, req)
                 self._expire_active()
                 self._expire_queued()
+                # gauges AFTER the completion/expiry sweep: a scrape
+                # between steps must not report finished requests as
+                # in-flight
+                self._set_gauges()
             except Exception as e:  # noqa: BLE001 — fail loudly, stay up
                 for slot, req in list(self._slots.items()):
                     self._fail(req, e)
                     self.engine.release(slot)
                 self._slots.clear()
+                self._set_gauges()
+        self._set_gauges()
         self._drained.set()
+
+    def _set_gauges(self) -> None:
+        _G_queue.set(self._q.qsize())
+        _G_inflight.set(len(self._slots))
 
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = 300.0) -> bool:
@@ -625,7 +695,13 @@ class GenerationServer:
         self._q.put(self._STOP)  # wake an idle loop
         # Event.wait(None) blocks until drained — timeout=None means
         # "wait as long as it takes", never "skip the wait"
-        return self._drained.wait(timeout)
+        drained = self._drained.wait(timeout)
+        if self._metrics_server is not None:
+            try:
+                self._metrics_server.close()
+            finally:
+                self._metrics_server = None
+        return drained
 
     def stats(self) -> Dict[str, int]:
         with self._q.mutex:  # don't count _STOP sentinels as work

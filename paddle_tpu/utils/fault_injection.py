@@ -52,6 +52,24 @@ _sites: Dict[str, _Fault] = {}
 _fired_total: Dict[str, int] = {}
 
 
+def _register_telemetry() -> None:
+    """Expose the injected-fault tally in the process metrics registry
+    (``observability.snapshot()['faults']['injected_total']``) as a
+    snapshot-time view — the unarmed-site fast path stays one dict
+    lookup. ``stats()`` below remains the legacy surface."""
+    from ..observability import metrics as _om
+
+    def collect():
+        with _lock:
+            tally = dict(_fired_total)
+        return {"faults.injected_total": tally} if tally else {}
+
+    _om.register_collector("fault_injection", collect)
+
+
+_register_telemetry()
+
+
 def inject(site: str, exc: Optional[BaseException] = None, times: int = 1,
            truncate_at: Optional[int] = None, kill: bool = False,
            skip: int = 0) -> None:

@@ -1,0 +1,175 @@
+"""First coverage for paddle_tpu.profiler: summary table, chrome export
+(incl. the merged step-timeline counter events), RecordEvent nesting,
+ProfileStep spans from step(), timer_only, and the empty-buffer
+summary."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import Profiler, ProfilerTarget, RecordEvent
+from paddle_tpu._native import lib as _lib
+
+pytestmark = pytest.mark.skipif(
+    _lib is None, reason="native runtime unavailable (no compiler)")
+
+
+def _span_names(path):
+    data = json.load(open(path))
+    return [e["name"] for e in data["traceEvents"]]
+
+
+class TestRecordEvent:
+    def test_context_manager_records_span(self, tmp_path):
+        with Profiler():
+            with RecordEvent("ctx_span"):
+                time.sleep(0.001)
+            out = str(tmp_path / "t.json")
+            profiler.export_chrome_tracing(out)
+        assert "ctx_span" in _span_names(out)
+
+    def test_reentrant_begin_end_keeps_both_spans(self, tmp_path):
+        ev = RecordEvent("nested")
+        with Profiler():
+            ev.begin()
+            time.sleep(0.002)
+            ev.begin()          # before the first end(): must NOT drop
+            time.sleep(0.001)   # the first span's start
+            ev.end()
+            ev.end()
+            out = str(tmp_path / "t.json")
+            profiler.export_chrome_tracing(out)
+        data = json.load(open(out))
+        spans = [e for e in data["traceEvents"] if e["name"] == "nested"]
+        assert len(spans) == 2
+        durs = sorted(float(s["dur"]) for s in spans)
+        # LIFO pairing: the inner span is strictly shorter
+        assert durs[0] < durs[1]
+        assert durs[1] >= 3000  # µs: outer covers both sleeps
+
+    def test_unbalanced_end_is_harmless(self):
+        ev = RecordEvent("lonely")
+        with Profiler():
+            ev.end()  # no begin: no crash, no span recorded
+
+
+class TestProfilerStep:
+    def test_step_emits_profile_step_spans(self, tmp_path):
+        prof = Profiler().start()
+        time.sleep(0.001)
+        prof.step()
+        time.sleep(0.001)
+        prof.step()
+        out = str(tmp_path / "t.json")
+        profiler.export_chrome_tracing(out)
+        prof.stop()
+        names = _span_names(out)
+        assert "ProfileStep#1" in names and "ProfileStep#2" in names
+
+    def test_step_windows_are_consecutive(self, tmp_path):
+        prof = Profiler().start()
+        prof.step()
+        prof.step()
+        out = str(tmp_path / "t.json")
+        profiler.export_chrome_tracing(out)
+        prof.stop()
+        data = json.load(open(out))
+        spans = {e["name"]: e for e in data["traceEvents"]}
+        s1, s2 = spans["ProfileStep#1"], spans["ProfileStep#2"]
+        assert s2["ts"] == pytest.approx(s1["ts"] + s1["dur"], abs=50)
+
+    def test_timer_only_skips_device_trace(self):
+        prof = Profiler(targets=[ProfilerTarget.CPU, ProfilerTarget.TPU],
+                        timer_only=True)
+        prof.start()
+        prof.step()
+        assert prof._device_dir is None  # device plane never started
+        prof.stop()
+
+
+class TestSummary:
+    def test_table_columns_and_aggregation(self):
+        with Profiler() as prof:
+            for _ in range(3):
+                with RecordEvent("agg_span"):
+                    time.sleep(0.001)
+            table = prof.summary()
+        lines = table.splitlines()
+        header = lines[0]
+        for col in ("name", "calls", "total_ms", "avg_ms", "max_ms",
+                    "min_ms", "ratio"):
+            assert col in header
+        row = next(ln for ln in lines if ln.startswith("agg_span"))
+        cells = row.split()
+        assert cells[1] == "3"              # calls
+        assert float(cells[3]) >= 1.0       # avg >= 1ms
+        assert "inf" not in table
+
+    def test_time_units(self):
+        with Profiler() as prof:
+            with RecordEvent("u"):
+                pass
+            assert "total_us" in prof.summary(time_unit="us")
+            with pytest.raises(ValueError):
+                prof.summary(time_unit="fortnights")
+
+    def test_empty_buffer_friendly_message(self):
+        prof = Profiler()
+        prof.start()
+        prof.stop()
+        # fresh start cleared the buffer; no spans were recorded after
+        prof2 = Profiler().start()
+        msg = prof2.summary()
+        prof2.stop()
+        assert "no events recorded" in msg
+        assert "inf" not in msg
+
+
+class TestChromeExport:
+    def test_export_creates_dirs_and_valid_json(self, tmp_path):
+        with Profiler():
+            with RecordEvent("x"):
+                pass
+            out = str(tmp_path / "deep" / "dir" / "trace.json")
+            profiler.export_chrome_tracing(out)
+        assert os.path.exists(out)
+        data = json.load(open(out))
+        assert isinstance(data["traceEvents"], list)
+
+    def test_step_timer_counters_merged(self, tmp_path):
+        from paddle_tpu.observability.timeline import StepTimer
+        with Profiler() as prof:
+            t = StepTimer("proftest")
+            with t.phase("forward"):
+                time.sleep(0.001)
+            t.step()
+            with RecordEvent("span_next_to_counter"):
+                pass
+            out = str(tmp_path / "merged.json")
+            prof.export(out)
+        data = json.load(open(out))
+        counters = [e for e in data["traceEvents"]
+                    if e.get("ph") == "C" and e["name"].startswith(
+                        "proftest")]
+        spans = [e for e in data["traceEvents"]
+                 if e["name"] == "span_next_to_counter"]
+        assert counters and spans, "one trace carries spans AND counters"
+        assert counters[-1]["args"]["forward"] >= 1.0  # ms
+        # counter timestamps share the span clock (same monotonic base)
+        assert abs(counters[-1]["ts"] - spans[0]["ts"]) < 60e6
+
+    def test_summary_ignores_merged_counters(self):
+        from paddle_tpu.observability.timeline import StepTimer
+        with Profiler() as prof:
+            t = StepTimer("sumtest")
+            with t.phase("fwd"):
+                pass
+            t.step()
+            with RecordEvent("real_span"):
+                pass
+            table = prof.summary()
+        assert "real_span" in table
