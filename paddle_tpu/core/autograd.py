@@ -501,9 +501,18 @@ _maybe_cast_inputs = None
 _fusion = None
 
 
-def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
+def apply_op(fn: Callable, *args, op_name: Optional[str] = None,
+             fuse_attrs: Optional[tuple] = None, **kwargs):
     """Run ``fn`` (a pure JAX function) on mixed Tensor/raw args, recording a
     GradNode when grad is enabled and any Tensor input requires grad.
+
+    ``fuse_attrs`` marks a parametric fusable dispatch (reduction
+    terminator / contraction epilogue): a hashable (key, value) tuple
+    the caller guarantees re-expresses everything ``fn`` bakes in beyond
+    its array args, so core/fusion.py can defer the op through its
+    registered parametric impl (see fusion._PIMPLS) with the attrs
+    folded into the program cache key. None (the default) means plain
+    dispatch — elementwise fusion still gates on fn identity.
 
     Returns Tensor or tuple-of-Tensor mirroring fn's output structure.
     This is the analog of a generated ``*_ad_func`` forward
@@ -519,25 +528,33 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **kwargs):
 
     name = op_name or getattr(fn, "__name__", "op")
 
-    # lazy-eager fusion: fusable elementwise ops defer into an expression
-    # DAG and compile per-chain instead of per-op (core/fusion.py). The
-    # _op_gate still runs so arity validation + dispatch_counts see every
-    # dispatch; recorders (SOT/static), AMP, and tracers take the plain
-    # path untouched.
+    # lazy-eager fusion: fusable ops — elementwise chains, reduction
+    # terminators, matmul/linear epilogue hosts — defer into an
+    # expression DAG and compile per-chain instead of per-op
+    # (core/fusion.py). The _op_gate still runs so arity validation +
+    # dispatch_counts see every dispatch; recorders (SOT/static), AMP,
+    # and tracers take the plain path untouched.
     if (_op_recorder is None and not _amp_state.enabled
             and _fusion.enabled()):
-        fused_out = _fusion.try_fuse(name, fn, args, kwargs)
+        fused_out = _fusion.try_fuse(name, fn, args, kwargs, fuse_attrs)
         if fused_out is not None:
             _op_gate(name, len(args))
             return fused_out
 
     datas = []
+    reason = None
     for a in args:
         if isinstance(a, Tensor):
             if a._lazy is not None:
                 # a pending chain meets a non-fusable consumer: flush at
-                # the op boundary (reduction/matmul/gather/...)
-                _fusion.materialize_tensor(a, "op_boundary")
+                # the op boundary (gather/reshape/...). The reason label
+                # distinguishes reductions/contractions that WOULD have
+                # deferred with FLAGS_eager_fusion_reduce/_epilogue on
+                # (reduce_boundary / matmul_boundary) from plain
+                # op_boundary flushes — the bisection taxonomy.
+                if reason is None:
+                    reason = _fusion.boundary_reason(name)
+                _fusion.materialize_tensor(a, reason)
             datas.append(a._buf)
         else:
             datas.append(a)

@@ -109,6 +109,21 @@ define_flag("eager_fusion",
             "point (host read / non-fusable boundary / backward / chain "
             "cap). Kill switch: FLAGS_eager_fusion=0 or "
             "PADDLE_TPU_EAGER_FUSION=0 restores per-op dispatch")
+define_flag("eager_fusion_reduce", True,
+            "Reduction terminators in lazy-eager fusion: ops marked "
+            "`fusable: reduce` (sum/mean/max/min/prod/logsumexp/...) "
+            "join the deferred chain as terminator nodes (axis/keepdim "
+            "in the cache key) instead of flushing it at dispatch. "
+            "Granular kill switch under FLAGS_eager_fusion; 0 restores "
+            "the flush-at-reduction boundary (flush reason "
+            "reduce_boundary)")
+define_flag("eager_fusion_epilogue", True,
+            "Matmul/linear epilogue capture in lazy-eager fusion: ops "
+            "marked `fusable: epilogue` defer as contraction nodes so a "
+            "following bias-add/activation/cast chain compiles as the "
+            "dot's XLA epilogue. Granular kill switch under "
+            "FLAGS_eager_fusion; 0 keeps contractions on the per-op "
+            "path (flush reason matmul_boundary)")
 define_flag("eager_fusion_max_chain", 32,
             "Deferred-op count at which a fusion chain force-flushes; "
             "bounds compile time and the retained expression DAG")

@@ -1,4 +1,5 @@
-"""Lazy-eager elementwise fusion runtime.
+"""Lazy-eager fusion runtime: elementwise chains, reduction terminators,
+matmul epilogues.
 
 The eager hot path dispatches one jitted pair per op (core/autograd
 apply_op), so an N-op elementwise chain costs N host dispatches and N
@@ -10,23 +11,40 @@ level. Here the same win is taken WITHOUT leaving eager semantics:
   dispatch. ``apply_op`` routes them here; each builds a ``LazyExpr``
   node over its inputs and returns a real ``Tensor`` whose ``_data``
   materializes on demand (the handle is indistinguishable to user code).
+* Ops flagged ``fusable: reduce`` (sum/mean/max/min/prod/logsumexp/...)
+  are NOT flush boundaries either: they join the DAG as reduction
+  terminator nodes, with their attrs (axis/keepdim/dtype) folded into
+  the structural cache key — ``mean((x*y+z)**2)`` compiles and runs as
+  ONE executable with no intermediate materialization. Fusable consumers
+  may keep chaining past a terminator (softmax-style
+  ``exp(x - max(x)) / sum(exp(x - max(x)))`` fuses whole).
+* Ops flagged ``fusable: epilogue`` (matmul/linear) defer the same way
+  as contraction nodes, so a following bias-add + activation (+ cast)
+  chain compiles INTO the dot's program and executes as an XLA epilogue
+  of the contraction instead of a second full-tensor pass. A held
+  requires-grad matmul handle stays a real tape edge (the chain cuts
+  there, exactly like any live fused intermediate), so the epilogue only
+  captures contractions with no other live grad consumers.
 * The expression DAG flushes at materialization points — a host read
   (``.numpy()``/``item``/``__array__``), a non-fusable op consuming the
-  tensor (reduction/matmul/...), ``backward()``, an in-place mutation,
+  tensor (gather/reshape/...), ``backward()``, an in-place mutation,
   a gradient hook, or the chain-length cap — by compiling the WHOLE
   reachable chain as ONE jitted executable.
-* Compiled programs live in an LRU cache keyed by (DAG structure, input
-  shapes/dtypes/weak-types, diff pattern, live outputs), so steady-state
-  loops hit the cache and dispatch once per chain.
+* Compiled programs live in an LRU cache keyed by (DAG structure + node
+  attrs, input shapes/dtypes/weak-types, diff pattern, live outputs), so
+  steady-state loops hit the cache and dispatch once per chain.
 * Gradients: the flush records ONE GradNode against the fused program's
   VJP (``jax.vjp`` of the generated pure function), with per-edge
   ``stop_gradient`` inserts reproducing exactly the dispatch-time
   stop_gradient/no_grad semantics the per-op tape would have had.
 
 Kill switch: ``FLAGS_eager_fusion=0`` (or env ``PADDLE_TPU_EAGER_FUSION=0``)
-restores the exact pre-fusion dispatch path. Observability:
-``fusion.stats()`` — chains built, cache hits/misses, flush reasons,
-ops-per-chain histogram.
+restores the exact pre-fusion dispatch path; ``FLAGS_eager_fusion_reduce``
+and ``FLAGS_eager_fusion_epilogue`` turn off just the reduction-terminator
+or matmul-epilogue capture for bisection. Observability: ``fusion.stats()``
+— chains built, cache hits/misses, flush reasons (incl. the granular
+``reduce_boundary``/``matmul_boundary`` labels the kill switches re-create),
+reductions/epilogues fused, ops-per-chain histogram.
 """
 from __future__ import annotations
 
@@ -47,7 +65,8 @@ from .flags import _registry as _flag_registry
 from ..observability import metrics as _om
 
 __all__ = ["stats", "reset_stats", "clear_cache", "register_impl",
-           "enabled", "materialize_tensor"]
+           "register_param_impl", "enabled", "materialize_tensor",
+           "boundary_reason"]
 
 _INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31
 
@@ -79,9 +98,18 @@ _M_fallbacks = _M.counter("jit_fallbacks_total",
                           "Flushes that fell back to un-jitted eval")
 _M_flushes = _M.counter("flushes_total", "Chain flushes by reason")
 _M_chain_len = _M.counter("chain_length", "Ops-per-chain distribution")
+_M_reduce_fused = _M.counter(
+    "reductions_fused_total",
+    "Reduction terminator nodes flushed WITH their producer chain "
+    "(the input edge was an interior node of the same fused program)")
+_M_epi_fused = _M.counter(
+    "epilogues_fused_total",
+    "Contraction (matmul/linear) nodes flushed with at least one "
+    "consumer in the same fused program — the epilogue actually fused")
 _M_compile_s = _M.histogram(
     "compile_seconds", "First execution (trace+compile) of a freshly "
-    "built fused program")
+    "built fused program, labeled by program kind "
+    "(elementwise/reduce/epilogue)")
 _om.default_registry().gauge(
     "fusion.cache_size",
     "Live fused-program cache entries").set_function(
@@ -94,11 +122,20 @@ def _intern_scalar(v):
         # 0.0 == -0.0 hash-collide but differ for sign-sensitive ops
         # (copysign/atan2/1/x): key the sign in explicitly
         key = (type(v), v, _math.copysign(1.0, v))
-    hit = _scalar_cache.get(key)
+    hit = _scalar_cache.get(key)  # lock-free hit: dict get is atomic
     if hit is None:
-        if len(_scalar_cache) > 4096:
-            _scalar_cache.clear()
-        hit = _scalar_cache[key] = jnp.asarray(v)
+        # miss path under the fusion lock: an unguarded check-then-clear
+        # could drop a scalar another thread JUST interned (and whose
+        # identity a pending chain already captured), and two concurrent
+        # misses on one value would intern two distinct arrays — either
+        # breaks the committed-array identity dedup. Evict oldest
+        # entries instead of clearing so live recent literals survive.
+        with _cache_lock:
+            hit = _scalar_cache.get(key)
+            if hit is None:
+                while len(_scalar_cache) > 4096:
+                    _scalar_cache.pop(next(iter(_scalar_cache)))
+                hit = _scalar_cache[key] = jnp.asarray(v)
     return hit
 
 # op name -> canonical pure-JAX implementation. Registration (from
@@ -108,11 +145,25 @@ def _intern_scalar(v):
 # cache key (op names) a faithful key for the generated program.
 _IMPLS: Dict[str, Any] = {}
 
-# name -> bool: ops.yaml `fusable` gate (resolved lazily; ops.yaml loads
-# after the op modules that register impls)
-_YAML_OK: Dict[str, bool] = {}
+# op name -> canonical PARAMETRIC implementation ``fn(*arrays, **attrs)``
+# for reduction terminators and contraction/epilogue ops: the dispatch
+# wrapper bakes its attrs (axis/keepdim/dtype, transpose flags) into a
+# per-call closure for the eager path, so fn identity can't gate fusion
+# here — instead the wrapper passes the SAME attrs explicitly
+# (apply_op's fuse_attrs) and codegen rebuilds the node from this
+# registry + the attrs folded into the structural signature. Contract
+# (held by the in-tree call sites): fn(*arrays, **dict(attrs)) is
+# semantically identical to the eager closure it rides along with.
+_PIMPLS: Dict[str, Any] = {}
+
+# name -> False | True ("elementwise") | "reduce" | "epilogue": ops.yaml
+# `fusable` class gate (resolved lazily; ops.yaml loads after the op
+# modules that register impls)
+_YAML_OK: Dict[str, Any] = {}
 
 _flag = _flag_registry["eager_fusion"]
+_reduce_flag = _flag_registry["eager_fusion_reduce"]
+_epilogue_flag = _flag_registry["eager_fusion_epilogue"]
 _max_chain = _flag_registry["eager_fusion_max_chain"]
 _cache_cap = _flag_registry["eager_fusion_cache"]
 _nan_flag = _flag_registry["check_nan_inf"]
@@ -140,24 +191,54 @@ def register_impl(name: str, fn) -> None:
     _IMPLS.setdefault(name, fn)
 
 
+def register_param_impl(name: str, fn) -> None:
+    """Declare ``fn(*arrays, **attrs)`` the canonical parametric
+    implementation of reduction/contraction op ``name`` (see _PIMPLS).
+    First registration wins."""
+    _PIMPLS.setdefault(name, fn)
+
+
 def enabled() -> bool:
     # check_nan_inf wants per-op NaN attribution — a debug mode where
     # chain-level deferral would blur the blame; turn fusion off with it
     return bool(_flag.value) and not _nan_flag.value
 
 
-def _yaml_fusable(name: str) -> bool:
+def _yaml_class(name: str):
+    """ops.yaml fusable class for ``name``: False, True (elementwise),
+    "reduce", or "epilogue" (contraction)."""
     ok = _YAML_OK.get(name)
     if ok is None:
         try:
             from ..ops.op_registry import OP_TABLE
             info = OP_TABLE.get(name)
-            ok = bool(info and info.get("fusable") and
-                      info.get("has_vjp", True))
+            ok = False
+            if info and info.get("has_vjp", True):
+                f = info.get("fusable")
+                if f in (True, "reduce", "epilogue"):
+                    ok = f
         except Exception:
             ok = False
         _YAML_OK[name] = ok
     return ok
+
+
+# op name -> flush-reason label for apply_op's non-fusable-consumer
+# branch: a pending chain flushed by a reduction/contraction consumer
+# that DIDN'T defer (granular flag off, impl unregistered, odd call
+# shape) is labeled reduce_boundary/matmul_boundary so stats() shows
+# exactly the flushes the fusion flags would have avoided.
+_BOUNDARY_REASON: Dict[str, str] = {}
+
+
+def boundary_reason(name: str) -> str:
+    r = _BOUNDARY_REASON.get(name)
+    if r is None:
+        cls = _yaml_class(name)
+        r = ("reduce_boundary" if cls == "reduce" else
+             "matmul_boundary" if cls == "epilogue" else "op_boundary")
+        _BOUNDARY_REASON[name] = r
+    return r
 
 
 # ---------------------------------------------------------------------------
@@ -177,9 +258,10 @@ class LazyExpr:
     """
 
     __slots__ = ("op", "args", "bufs", "adiff", "shape", "dtype", "weak",
-                 "rg", "nops", "val", "anchor", "tref")
+                 "rg", "nops", "val", "anchor", "tref", "attrs", "kind")
 
-    def __init__(self, op, args, bufs, adiff, shape, dtype, weak, nops):
+    def __init__(self, op, args, bufs, adiff, shape, dtype, weak, nops,
+                 attrs=None, kind="e"):
         self.op = op
         self.args = args
         # per-arg buffer captured AT DISPATCH for Tensor leaves (None for
@@ -194,6 +276,13 @@ class LazyExpr:
         self.weak = weak
         self.rg = any(adiff)
         self.nops = nops
+        # parametric node state: attrs is the hashable (key, value) tuple
+        # folded into the structural cache key (axis/keepdim/dtype for
+        # reductions, transpose flags for contractions); None marks a
+        # plain elementwise node. kind: "e" elementwise / "r" reduction
+        # terminator / "c" contraction (epilogue host).
+        self.attrs = attrs
+        self.kind = kind
         self.val = None      # set at flush for live outputs
         self.anchor = None   # strong Tensor ref after flush (grad chaining)
         self.tref = None     # weakref to the owning Tensor
@@ -204,13 +293,17 @@ class LazyExpr:
 _aval_cache: Dict[tuple, tuple] = {}
 
 
-def _infer_aval(name, fn, descs, entries):
-    key = (name,) + descs
+def _infer_aval(name, fn, descs, entries, attrs=None):
+    key = ((name, attrs) if attrs is not None else (name,)) + descs
     hit = _aval_cache.get(key)
     if hit is not None:
         return hit
     if len(_aval_cache) > 8192:  # bound it like the other fusion caches
         _aval_cache.clear()
+    if attrs is not None:
+        # infer through the registered parametric impl + attrs — exactly
+        # what codegen will run — not through the per-call eager closure
+        fn = _param_fn(name, attrs)
     try:
         eval_args = []
         for d, e in zip(descs, entries):
@@ -233,6 +326,21 @@ def _infer_aval(name, fn, descs, entries):
     return aval
 
 
+def _param_fn(op, attrs):
+    """Evaluation callable for a parametric node: the registered impl
+    with the node's attrs baked in (identity for attr-less nodes, e.g.
+    bias-less linear or squared_l2_norm)."""
+    base = _PIMPLS[op]
+    if not attrs:
+        return base
+    kw = dict(attrs)
+
+    def call(*vals):
+        return base(*vals, **kw)
+
+    return call
+
+
 def _new_lazy_tensor(expr: LazyExpr):
     t = _Tensor.__new__(_Tensor)
     t._buf = None
@@ -251,14 +359,47 @@ def _new_lazy_tensor(expr: LazyExpr):
     return t
 
 
-def try_fuse(name: str, fn, args, kwargs):
+def try_fuse(name: str, fn, args, kwargs, attrs=None):
     """Defer one fusable dispatch; returns the handle Tensor, or None to
     take the normal eager path. Hot path: isinstance dispatch is ordered
     Tensor -> exact scalar types -> arrays, and input descriptors are
-    built inline so nothing is touched twice."""
+    built inline so nothing is touched twice.
+
+    ``attrs`` is None for plain elementwise ops (fn identity gates the
+    fuse) and a hashable (key, value) tuple for parametric dispatches
+    (reductions / contractions) — then the op's ops.yaml class plus its
+    registered parametric impl gate instead, and kwargs (which the eager
+    ``fn`` may still need, e.g. matmul's transpose flags) are trusted to
+    be exactly re-expressed by ``attrs`` (the in-tree wrapper contract,
+    see _PIMPLS)."""
     global _Tensor, _ArrayImpl
-    if kwargs or _IMPLS.get(name) is not fn or not _yaml_fusable(name):
-        return None
+    if attrs is None:
+        if kwargs or _IMPLS.get(name) is not fn or \
+                _yaml_class(name) is not True:
+            return None
+        kind = "e"
+    else:
+        cls = _yaml_class(name)
+        if cls == "reduce":
+            if not _reduce_flag.value:
+                return None
+            kind = "r"
+        elif cls == "epilogue":
+            if not _epilogue_flag.value:
+                return None
+            kind = "c"
+        elif cls is True:
+            # parametric elementwise (gelu's approximate, cast's dtype):
+            # attrs ride the structural key like any other node attrs
+            kind = "e"
+        else:
+            return None
+        if name not in _PIMPLS:
+            return None
+        try:
+            hash(attrs)  # attrs enter the structural cache key
+        except TypeError:
+            return None
     if _Tensor is None:
         from .tensor import Tensor as _T
         _Tensor = _T
@@ -330,11 +471,11 @@ def try_fuse(name: str, fn, args, kwargs):
                 descs.append(("a", (), s.dtype, bool(s.weak_type)))
             else:
                 return None
-    aval = _infer_aval(name, fn, tuple(descs), entries)
+    aval = _infer_aval(name, fn, tuple(descs), entries, attrs)
     if aval is None:
         return None
     expr = LazyExpr(name, tuple(entries), tuple(bufs), tuple(adiff),
-                    aval[0], aval[1], aval[2], nops)
+                    aval[0], aval[1], aval[2], nops, attrs, kind)
     t = _new_lazy_tensor(expr)
     if _M_flag.value:
         _M_deferred._v += 1  # inline fast cell: per-deferral hot path
@@ -353,15 +494,17 @@ _cache_lock = threading.Lock()
 
 def _build_pure(sig):
     """Decode a structural signature into the pure fused function. It is
-    rebuilt from the signature alone — the impl registry maps op names
-    back to their canonical jnp callables — so one program serves every
-    flush with the same structure."""
+    rebuilt from the signature alone — the impl registries map op names
+    (+ node attrs for reduction/contraction nodes) back to their
+    canonical jnp callables — so one program serves every flush with the
+    same structure."""
     nodes, leaf_descs, out_idx, diff_idx = sig
-    impls = tuple(_IMPLS[op] for op, _ in nodes)
+    impls = tuple(_IMPLS[op] if attrs is None else _param_fn(op, attrs)
+                  for op, _, attrs in nodes)
 
     def fused(*leaf_vals):
         env: List[Any] = []
-        for (op, children), impl in zip(nodes, impls):
+        for (op, children, _attrs), impl in zip(nodes, impls):
             vals = []
             for kind, j, ad in children:
                 v = env[j] if kind == "n" else leaf_vals[j]
@@ -398,10 +541,32 @@ def _build_program(sig):
 _SEEN = object()  # first-sighting marker: structure noted, not compiled
 
 
-def _timed_first_call(jf):
+def _trace_compile_span(pkind: str, dt: float) -> None:
+    """Land the first-call (trace+compile) window in the host tracer as
+    a ``fusion_compile[kind]`` span when the native tracer is live, so
+    ``export_chrome_tracing`` step traces attribute the first-call spike
+    to fusion compilation instead of an anonymous gap. Lazy module
+    lookup only — never triggers the native build."""
+    import sys
+    mod = sys.modules.get("paddle_tpu._native")
+    lib = getattr(mod, "lib", None)
+    if lib is None:
+        return
+    try:
+        if lib.tracer_enabled():
+            now = lib.tracer_now()
+            lib.tracer_record(f"fusion_compile[{pkind}]",
+                              now - dt * 1e6, now)
+    except Exception:
+        pass
+
+
+def _timed_first_call(jf, pkind):
     """Wrap a freshly built jitted forward so its FIRST execution (the
-    one that traces+compiles) lands in fusion.compile_seconds; later
-    calls pay one flag check."""
+    one that traces+compiles) lands in fusion.compile_seconds — labeled
+    by program kind (elementwise/reduce/epilogue) — and, when the host
+    tracer is recording, as a chrome-trace span; later calls pay one
+    flag check."""
     done = [False]
 
     def wrapper(*a):
@@ -410,13 +575,15 @@ def _timed_first_call(jf):
         t0 = _time.perf_counter()
         out = jf(*a)
         done[0] = True
-        _M_compile_s.observe(_time.perf_counter() - t0)
+        dt = _time.perf_counter() - t0
+        _M_compile_s.observe(dt, kind=pkind)
+        _trace_compile_span(pkind, dt)
         return out
 
     return wrapper
 
 
-def _get_program(sig):
+def _get_program(sig, pkind):
     """Compile policy mirrors autograd's pair cache: a chain structure
     only compiles on its SECOND sighting. One-off chains (test suites,
     cold paths) run un-jitted — op-by-op jnp cost, no XLA compile — and
@@ -431,7 +598,7 @@ def _get_program(sig):
     if entry is _SEEN:
         _M_misses.inc()
         built = _build_program(sig)
-        built = (built[0], _timed_first_call(built[1]), built[2])
+        built = (built[0], _timed_first_call(built[1], pkind), built[2])
         with _cache_lock:
             _cache[sig] = built
             cap = max(int(_cache_cap.value or 256), 8)
@@ -520,7 +687,7 @@ def _flush(root: LazyExpr, reason: str) -> None:
                     children.append(("l", leaf_slot(a, buf), ad))
             node_index[id(e)] = len(order)
             order.append(e)
-            sig_nodes.append((e.op, tuple(children)))
+            sig_nodes.append((e.op, tuple(children), e.attrs))
 
     # -- outputs: every node whose Tensor handle is still alive ----------
     out_idx = []
@@ -558,14 +725,24 @@ def _flush(root: LazyExpr, reason: str) -> None:
         out_tensors = [None]
 
     diff_set = set()
-    for op, children in sig_nodes:
+    for op, children, _attrs in sig_nodes:
         for kind, j, ad in children:
             if kind == "l" and ad:
                 diff_set.add(j)
     diff_idx = tuple(sorted(diff_set))
 
+    # program kind for compile-seconds attribution: a contraction makes
+    # it an epilogue program, else a terminator makes it a reduce one
+    pkind = "elementwise"
+    for e in order:
+        if e.kind == "c":
+            pkind = "epilogue"
+            break
+        if e.kind == "r":
+            pkind = "reduce"
+
     sig = (tuple(sig_nodes), tuple(leaf_descs), tuple(out_idx), diff_idx)
-    fused, jfwd, jbwd = _get_program(sig)
+    fused, jfwd, jbwd = _get_program(sig, pkind)
 
     if jfwd is None:  # first sighting of this structure: run un-jitted
         outs = fused(*leaf_vals)
@@ -632,6 +809,21 @@ def _flush(root: LazyExpr, reason: str) -> None:
     _M_ops_fused.inc(len(order))
     _M_flushes.inc(reason=reason)
     _M_chain_len.inc(**{"len": len(order)})
+    if pkind != "elementwise":
+        # a reduction "fused" when its input chain flushed WITH it (the
+        # input edge is an interior node); a contraction's epilogue fused
+        # when some node in this program consumes the dot's output
+        consumed = set()
+        for _op, children, _attrs in sig_nodes:
+            for k, j, _ad in children:
+                if k == "n":
+                    consumed.add(j)
+        for i, e in enumerate(order):
+            if e.kind == "r":
+                if any(k == "n" for k, _j, _ad in sig_nodes[i][1]):
+                    _M_reduce_fused.inc()
+            elif e.kind == "c" and i in consumed:
+                _M_epi_fused.inc()
 
 
 # ---------------------------------------------------------------------------
@@ -655,6 +847,8 @@ def stats() -> Dict[str, Any]:
         "cache_misses": _M_misses.value(),
         "uncompiled_runs": _M_uncompiled.value(),
         "jit_fallbacks": _M_fallbacks.value(),
+        "reductions_fused": _M_reduce_fused.value(),
+        "epilogues_fused": _M_epi_fused.value(),
         # labeled registry cells back to the legacy dict shapes (label
         # values keep their Python type, so chain lengths come back int)
         "flush_reasons": {k[0][1]: v
@@ -670,12 +864,13 @@ def stats() -> Dict[str, Any]:
 
 def reset_stats() -> None:
     for m in (_M_deferred, _M_chains, _M_ops_fused, _M_hits, _M_misses,
-              _M_uncompiled, _M_fallbacks, _M_flushes, _M_chain_len):
+              _M_uncompiled, _M_fallbacks, _M_flushes, _M_chain_len,
+              _M_reduce_fused, _M_epi_fused):
         m.reset()
 
 
 def clear_cache() -> None:
     with _cache_lock:
         _cache.clear()
+        _scalar_cache.clear()
     _aval_cache.clear()
-    _scalar_cache.clear()

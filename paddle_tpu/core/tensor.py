@@ -18,7 +18,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtype as dtype_mod
+from . import fusion as fusion_mod
 from .autograd import apply_op, backward as _backward, is_grad_enabled
+
+
+def _cast_impl(a, dtype=None):
+    return a.astype(dtype)
+
+
+# `fusable: true` + parametric (target dtype rides the program key): the
+# trailing cast of a bf16 epilogue — act(x @ w + b).astype(...) — fuses
+# into the same executable instead of a full-tensor second pass
+fusion_mod.register_param_impl("cast", _cast_impl)
 
 
 # SOT (dy2static) hooks: the graph-break tracer installs these to observe
@@ -247,7 +258,8 @@ class Tensor:
     # -- conversion ---------------------------------------------------------
     def astype(self, dtype):
         d = dtype_mod.convert_dtype(dtype)
-        return apply_op(lambda x: x.astype(d), self, op_name="cast")
+        return apply_op(lambda x: _cast_impl(x, dtype=d), self,
+                        op_name="cast", fuse_attrs=(("dtype", d),))
 
     def cast(self, dtype):
         return self.astype(dtype)

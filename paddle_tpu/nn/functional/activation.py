@@ -4,7 +4,22 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...core import fusion as _fusion
 from ...core.autograd import apply_op
+
+# the canonical epilogue activations are chain-fusable (`fusable: true`
+# in ops.yaml): relu/relu6/silu gate on their stable jax.nn identity;
+# gelu is parametric (its `approximate` flag rides the program key)
+_fusion.register_impl("relu", jax.nn.relu)
+_fusion.register_impl("relu6", jax.nn.relu6)
+_fusion.register_impl("silu", jax.nn.silu)
+
+
+def _gelu_impl(a, approximate=False):
+    return jax.nn.gelu(a, approximate=approximate)
+
+
+_fusion.register_param_impl("gelu", _gelu_impl)
 
 
 def relu(x, name=None):
@@ -46,8 +61,9 @@ def celu(x, alpha=1.0, name=None):
 
 
 def gelu(x, approximate=False, name=None):
-    return apply_op(lambda a: jax.nn.gelu(a, approximate=approximate), x,
-                    op_name="gelu")
+    ap = bool(approximate)
+    return apply_op(lambda a: _gelu_impl(a, approximate=ap), x,
+                    op_name="gelu", fuse_attrs=(("approximate", ap),))
 
 
 def silu(x, name=None):

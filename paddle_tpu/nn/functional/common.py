@@ -6,6 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...core import fusion as _fusion
 from ...core import random as random_mod
 from ...core.autograd import apply_op, is_grad_enabled
 from ...core.dtype import convert_dtype
@@ -21,13 +22,26 @@ def _rng_key_tensor() -> Tensor:
     return t
 
 
+def _linear_impl(a, w, b=None):
+    # module-level (stable identity): the eager fast path caches one
+    # jitted pair per arity, and fusion (`fusable: epilogue`) re-captures
+    # the contraction so a following activation/cast runs as the dot's
+    # XLA epilogue
+    r = a @ w
+    return r if b is None else r + b
+
+
+_fusion.register_param_impl("linear", _linear_impl)
+
+
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with paddle's [in, out] weight layout
     (ref: python/paddle/nn/functional/common.py linear)."""
     if bias is None:
-        return apply_op(lambda a, w: a @ w, x, weight, op_name="linear")
-    return apply_op(lambda a, w, b: a @ w + b, x, weight, bias,
-                    op_name="linear")
+        return apply_op(_linear_impl, x, weight, op_name="linear",
+                        fuse_attrs=())
+    return apply_op(_linear_impl, x, weight, bias, op_name="linear",
+                    fuse_attrs=())
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

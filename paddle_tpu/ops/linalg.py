@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import fusion as _fusion
 from ..core.autograd import apply_op
 from ..core.tensor import Tensor
 
@@ -23,8 +24,17 @@ def _matmul_impl(a, b, transpose_x=False, transpose_y=False):
     return jnp.matmul(a, b)
 
 
+# contraction/epilogue host (`fusable: epilogue` in ops.yaml): with
+# FLAGS_eager_fusion_epilogue on, matmul defers into the fusion DAG so a
+# following bias-add/activation chain compiles as an XLA epilogue of the
+# dot (one pass) instead of re-reading the product from HBM
+_fusion.register_param_impl("matmul", _matmul_impl)
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     return apply_op(_matmul_impl, x, y, op_name="matmul",
+                    fuse_attrs=(("transpose_x", bool(transpose_x)),
+                                ("transpose_y", bool(transpose_y))),
                     transpose_x=transpose_x, transpose_y=transpose_y)
 
 

@@ -142,49 +142,123 @@ def multiply_(x, y):
 def _norm_axis(axis):
     if isinstance(axis, Tensor):
         axis = tuple(int(a) for a in np.asarray(axis._data))
+    if isinstance(axis, np.ndarray):
+        axis = tuple(int(a) for a in axis.reshape(-1))
     if isinstance(axis, list):
         axis = tuple(axis)
+    if isinstance(axis, np.integer):
+        axis = int(axis)
     return axis
+
+
+# Reduction terminators: each op has a module-level parametric impl
+# ``fn(a, **attrs)`` registered for fusion codegen (`fusable: reduce` in
+# ops.yaml), and its wrapper passes the SAME attrs — normalized hashable
+# (axis/dtype/keepdim) — to apply_op as fuse_attrs so the dispatch can
+# join a pending chain as a terminator node instead of flushing it. The
+# per-call lambda stays the eager/fallback body; impl and lambda compute
+# identically by construction (the lambda closes over the impl).
+
+def _sum_impl(a, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(a, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def _mean_impl(a, axis=None, keepdim=False):
+    return jnp.mean(a, axis=axis, keepdims=keepdim)
+
+
+def _prod_impl(a, axis=None, dtype=None, keepdim=False):
+    return jnp.prod(a, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def _max_impl(a, axis=None, keepdim=False):
+    return jnp.max(a, axis=axis, keepdims=keepdim)
+
+
+def _min_impl(a, axis=None, keepdim=False):
+    return jnp.min(a, axis=axis, keepdims=keepdim)
+
+
+def _logsumexp_impl(a, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim)
+
+
+def _squared_l2_norm_impl(a):
+    return jnp.sum(jnp.square(a))
+
+
+for _n, _f in (("sum", _sum_impl), ("mean", _mean_impl),
+               ("prod", _prod_impl), ("max", _max_impl),
+               ("min", _min_impl), ("amax", _max_impl),
+               ("amin", _min_impl), ("logsumexp", _logsumexp_impl),
+               ("squared_l2_norm", _squared_l2_norm_impl)):
+    _fusion.register_param_impl(_n, _f)
 
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     d = convert_dtype(dtype)
+    ax, kd = _norm_axis(axis), bool(keepdim)
     return apply_op(
-        lambda a: jnp.sum(a, axis=_norm_axis(axis), dtype=d, keepdims=keepdim),
-        _t(x), op_name="sum")
+        lambda a: _sum_impl(a, axis=ax, dtype=d, keepdim=kd),
+        _t(x), op_name="sum",
+        fuse_attrs=(("axis", ax), ("dtype", d), ("keepdim", kd)))
 
 
 def mean(x, axis=None, keepdim=False, name=None):
+    ax, kd = _norm_axis(axis), bool(keepdim)
     return apply_op(
-        lambda a: jnp.mean(a, axis=_norm_axis(axis), keepdims=keepdim),
-        _t(x), op_name="mean")
+        lambda a: _mean_impl(a, axis=ax, keepdim=kd),
+        _t(x), op_name="mean",
+        fuse_attrs=(("axis", ax), ("keepdim", kd)))
 
 
 def prod(x, axis=None, keepdim=False, dtype=None, name=None):
     d = convert_dtype(dtype)
+    ax, kd = _norm_axis(axis), bool(keepdim)
     return apply_op(
-        lambda a: jnp.prod(a, axis=_norm_axis(axis), dtype=d, keepdims=keepdim),
-        _t(x), op_name="prod")
+        lambda a: _prod_impl(a, axis=ax, dtype=d, keepdim=kd),
+        _t(x), op_name="prod",
+        fuse_attrs=(("axis", ax), ("dtype", d), ("keepdim", kd)))
 
 
 def max(x, axis=None, keepdim=False, name=None):
+    ax, kd = _norm_axis(axis), bool(keepdim)
     return apply_op(
-        lambda a: jnp.max(a, axis=_norm_axis(axis), keepdims=keepdim),
-        _t(x), op_name="max")
+        lambda a: _max_impl(a, axis=ax, keepdim=kd),
+        _t(x), op_name="max",
+        fuse_attrs=(("axis", ax), ("keepdim", kd)))
 
 
 def min(x, axis=None, keepdim=False, name=None):
+    ax, kd = _norm_axis(axis), bool(keepdim)
     return apply_op(
-        lambda a: jnp.min(a, axis=_norm_axis(axis), keepdims=keepdim),
-        _t(x), op_name="min")
+        lambda a: _min_impl(a, axis=ax, keepdim=kd),
+        _t(x), op_name="min",
+        fuse_attrs=(("axis", ax), ("keepdim", kd)))
 
 
 def amax(x, axis=None, keepdim=False, name=None):
-    return max(x, axis, keepdim)
+    ax, kd = _norm_axis(axis), bool(keepdim)
+    return apply_op(
+        lambda a: _max_impl(a, axis=ax, keepdim=kd),
+        _t(x), op_name="amax",
+        fuse_attrs=(("axis", ax), ("keepdim", kd)))
 
 
 def amin(x, axis=None, keepdim=False, name=None):
-    return min(x, axis, keepdim)
+    ax, kd = _norm_axis(axis), bool(keepdim)
+    return apply_op(
+        lambda a: _min_impl(a, axis=ax, keepdim=kd),
+        _t(x), op_name="amin",
+        fuse_attrs=(("axis", ax), ("keepdim", kd)))
+
+
+def squared_l2_norm(x, name=None):
+    """sum(x**2) as one fused full reduction — the global-grad-norm
+    building block (ref: paddle._C_ops.squared_l2_norm, used by
+    ClipGradByGlobalNorm)."""
+    return apply_op(lambda a: _squared_l2_norm_impl(a), _t(x),
+                    op_name="squared_l2_norm", fuse_attrs=())
 
 
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
@@ -208,10 +282,11 @@ def median(x, axis=None, keepdim=False, name=None):
 
 
 def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax, kd = _norm_axis(axis), bool(keepdim)
     return apply_op(
-        lambda a: jax.scipy.special.logsumexp(
-            a, axis=_norm_axis(axis), keepdims=keepdim),
-        _t(x), op_name="logsumexp")
+        lambda a: _logsumexp_impl(a, axis=ax, keepdim=kd),
+        _t(x), op_name="logsumexp",
+        fuse_attrs=(("axis", ax), ("keepdim", kd)))
 
 
 def cumsum(x, axis=None, dtype=None, name=None):

@@ -103,6 +103,22 @@ def _load_yaml() -> list:
         return _parse_yaml_fallback(text)
 
 
+_FUSABLE_CLASSES = (False, True, "reduce", "epilogue")
+
+
+def _norm_fusable(name: str, v):
+    """Validate the ops.yaml `fusable` marker class at load time so a
+    typo ('fusable: reduction') can't silently disable fusion for an op
+    the tests then assert fuses."""
+    if v is None:
+        return False
+    if v not in _FUSABLE_CLASSES:
+        raise ValueError(
+            f"ops.yaml: op {name!r} declares unknown fusable class "
+            f"{v!r}; expected one of {_FUSABLE_CLASSES}")
+    return v
+
+
 def _register_all():
     from .._native import lib
     for entry in _load_yaml():
@@ -116,10 +132,12 @@ def _register_all():
             # variadic ops (concat/stack/einsum/...) dispatch one
             # positional per tensor: the arity gate skips the cap
             "variadic": bool(entry.get("variadic", False)),
-            # elementwise ops eligible for lazy-eager chain fusion
-            # (core/fusion.py); Python-mirror-only — the native
-            # descriptor layout predates the field
-            "fusable": bool(entry.get("fusable", False)),
+            # lazy-eager fusion class (core/fusion.py): False (not
+            # fusable), True (elementwise chain member), "reduce"
+            # (reduction terminator), "epilogue" (contraction/epilogue
+            # host). Python-mirror-only — the native descriptor layout
+            # predates the field
+            "fusable": _norm_fusable(name, entry.get("fusable", False)),
         }
         OP_TABLE[name] = info
         if lib is not None:

@@ -1,4 +1,5 @@
-"""Lazy-eager elementwise fusion: equivalence, flush triggers, caching.
+"""Lazy-eager fusion: equivalence, flush triggers, caching, reduction
+terminators, matmul epilogues.
 
 The fusion runtime (core/fusion.py) defers ops flagged ``fusable`` in
 ops/ops.yaml into per-chain jitted executables. These tests pin the
@@ -11,13 +12,21 @@ contract:
 * flush-trigger correctness — host read, non-fusable op boundary,
   in-place mutation, ``backward()``, chain-length cap;
 * steady-state caching — a 12-op chain compiles at most once after
-  warmup (≤1 new compile, the rest cache hits).
+  warmup (≤1 new compile, the rest cache hits);
+* reduction terminators (``fusable: reduce``) — fwd+grad equivalence
+  for every marked op across f32/bf16 and axis/keepdim variants, flush
+  reason taxonomy (``reduce_boundary``), steady-state ≤1-compile for a
+  reduction-terminated chain;
+* matmul/linear epilogues (``fusable: epilogue``) — the contraction is
+  re-captured into the chain's program; held requires-grad handles stay
+  real tape edges.
 """
 import numpy as np
 import pytest
 import yaml
 
 import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
 from paddle_tpu.core import fusion
 from paddle_tpu.core.flags import get_flags, set_flags
 
@@ -26,17 +35,22 @@ RNG = np.random.default_rng(42)
 
 @pytest.fixture(autouse=True)
 def _restore_fusion_flags():
-    prev = get_flags(["FLAGS_eager_fusion", "FLAGS_eager_fusion_max_chain"])
+    prev = get_flags(["FLAGS_eager_fusion", "FLAGS_eager_fusion_max_chain",
+                      "FLAGS_eager_fusion_reduce",
+                      "FLAGS_eager_fusion_epilogue"])
     yield
     set_flags(prev)
 
 
-def _fusable_names():
+def _names_by_class(cls):
     d = yaml.safe_load(open("paddle_tpu/ops/ops.yaml"))["ops"]
-    return sorted({o["name"] for o in d if o.get("fusable")})
+    return sorted({o["name"] for o in d if o.get("fusable") == cls})
 
 
-FUSABLE = _fusable_names()
+FUSABLE = [n for n in _names_by_class(True)  # elementwise chain members
+           if n != "cast"]                   # (cast: dedicated test below)
+REDUCE_OPS = _names_by_class("reduce")       # terminator ops
+EPILOGUE_OPS = _names_by_class("epilogue")
 
 # input domains: (generator per positional tensor arg)
 _POS = {"log", "log10", "log1p", "log2", "sqrt", "rsqrt", "lgamma",
@@ -67,7 +81,7 @@ def _run_chain(name, arrs, fused):
     """op under test embedded in a small fusable chain; returns
     (output ndarray, [input grad ndarrays])."""
     set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
-    fn = getattr(paddle, name)
+    fn = getattr(paddle, name, None) or getattr(F, name)
     ts = [paddle.to_tensor(a, stop_gradient=False) for a in arrs]
     z = fn(*ts)
     w = paddle.add(paddle.multiply(z, 0.5), 0.25)  # extend the chain
@@ -121,9 +135,22 @@ class TestFlushTriggers:
         z = self._chain(x, x)
         assert z._lazy is not None
         before = fusion.stats()["flush_reasons"].get("op_boundary", 0)
-        s = paddle.sum(z)  # reduction: not fusable
+        s = paddle.cumsum(z)  # scan: not fusable in any class
         assert z._lazy is None
         assert fusion.stats()["flush_reasons"]["op_boundary"] == before + 1
+        np.testing.assert_allclose(
+            s.numpy(), np.cumsum(z.numpy().reshape(-1)), rtol=1e-6)
+
+    def test_reduction_is_not_a_boundary(self):
+        """Since Fusion II a `fusable: reduce` op joins the DAG as a
+        terminator instead of flushing its input chain at dispatch."""
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1})
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        z = self._chain(x, x)
+        assert z._lazy is not None
+        s = paddle.sum(z)
+        assert z._lazy is not None  # chain still pending
+        assert s._lazy is not None  # terminator joined it
         assert s.numpy() == pytest.approx(float(np.sum(z.numpy())))
 
     def test_inplace_mutation_flushes(self):
@@ -388,9 +415,470 @@ class TestGradSemantics:
         assert not z.stop_gradient
 
 
+# ---------------------------------------------------------------------------
+# reduction terminators (fusable: reduce)
+# ---------------------------------------------------------------------------
+
+# axis/keepdim variants per reduce op; squared_l2_norm is a fixed full
+# reduction (no axis surface by contract)
+_REDUCE_VARIANTS = [
+    {}, {"axis": 0}, {"axis": 1}, {"axis": 1, "keepdim": True},
+    {"axis": [0, 1]},
+]
+
+
+def _reduce_cases():
+    for name in REDUCE_OPS:
+        variants = [{}] if name == "squared_l2_norm" else _REDUCE_VARIANTS
+        for v in variants:
+            for dt in ("float32", "bfloat16"):
+                yield name, v, dt
+
+
+def _run_reduce_chain(name, kw, dtype, arr, fused):
+    """op under test terminating a fusable chain; returns
+    (output ndarray f32, input grad ndarray f32)."""
+    set_flags({"FLAGS_eager_fusion": 1 if fused else 0,
+               "FLAGS_eager_fusion_reduce": 1})
+    # leaf constructed IN dtype (a cast op would make x a non-leaf and
+    # backward() would not populate x.grad)
+    x = paddle.to_tensor(arr, dtype=dtype, stop_gradient=False)
+    z = paddle.add(paddle.multiply(x, 0.5), 0.25)  # producer chain
+    r = getattr(paddle, name)(z, **kw)
+    if fused:
+        assert r._lazy is not None, f"{name}{kw}: did not defer"
+    else:
+        assert r._lazy is None, f"{name}{kw}: kill switch did not disable"
+    loss = paddle.sum(r)
+    loss.backward()
+    return (r.astype("float32").numpy(),
+            x.grad.astype("float32").numpy())
+
+
+@pytest.mark.parametrize("name,kw,dtype",
+                         list(_reduce_cases()),
+                         ids=lambda v: str(v).replace(" ", ""))
+def test_reduce_terminator_matches_eager(name, kw, dtype):
+    # spread > 0.3 avoids ties (max/min subgradient routing) and keeps
+    # prod away from 0; bf16 compares at its ~2^-8 resolution
+    arr = (RNG.random((3, 4)) * 1.5 + 0.3).astype(np.float32)
+    out_f, g_f = _run_reduce_chain(name, kw, dtype, arr.copy(), fused=True)
+    out_e, g_e = _run_reduce_chain(name, kw, dtype, arr.copy(), fused=False)
+    tol = dict(rtol=1e-5, atol=1e-6) if dtype == "float32" else \
+        dict(rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(out_f, out_e, err_msg=f"{name}{kw} fwd",
+                               **tol)
+    np.testing.assert_allclose(g_f, g_e, err_msg=f"{name}{kw} grad", **tol)
+
+
+class TestReductionTerminators:
+    def test_one_program_no_intermediate(self):
+        """mean((x*y+z)**2) runs as ONE fused executable: a single chain
+        flush covering all 4 ops, counted as a fused reduction."""
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1})
+        x = paddle.to_tensor(RNG.standard_normal((4, 5)).astype(np.float32))
+        y = paddle.to_tensor(RNG.standard_normal((4, 5)).astype(np.float32))
+        z = paddle.to_tensor(RNG.standard_normal((4, 5)).astype(np.float32))
+        s0 = fusion.stats()
+        r = paddle.mean(paddle.square(
+            paddle.add(paddle.multiply(x, y), z)))
+        assert r._lazy is not None
+        got = float(r.numpy())
+        s1 = fusion.stats()
+        assert s1["chains_flushed"] - s0["chains_flushed"] == 1
+        assert s1["ops_fused"] - s0["ops_fused"] == 4
+        assert s1["reductions_fused"] - s0["reductions_fused"] == 1
+        ref = float(np.mean((x.numpy() * y.numpy() + z.numpy()) ** 2))
+        assert got == pytest.approx(ref, rel=1e-5)
+
+    def test_chain_continues_past_terminator(self):
+        """Fusable consumers keep chaining past a reduce node — the
+        softmax pattern fuses into one program (the held non-rg
+        intermediate `e` just becomes a second output of it)."""
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1})
+        xn = RNG.standard_normal((4, 6)).astype(np.float32)
+        x = paddle.to_tensor(xn)
+        e = paddle.exp(paddle.subtract(
+            x, paddle.max(x, axis=1, keepdim=True)))
+        sm = paddle.divide(e, paddle.sum(e, axis=1, keepdim=True))
+        assert sm._lazy is not None
+        s0 = fusion.stats()
+        out = sm.numpy()
+        s1 = fusion.stats()
+        assert s1["chains_flushed"] - s0["chains_flushed"] == 1
+        ref = np.exp(xn - xn.max(1, keepdims=True))
+        ref /= ref.sum(1, keepdims=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_softmax_grad_matches_eager(self):
+        """Grad-mode softmax: a HELD requires-grad intermediate cuts the
+        chain into tape-edge programs, and grads still match eager."""
+        def run(fused):
+            set_flags({"FLAGS_eager_fusion": 1 if fused else 0,
+                       "FLAGS_eager_fusion_reduce": 1})
+            xn = np.float32([[0.3, -1.2, 0.8], [2.0, 0.1, -0.4]])
+            x = paddle.to_tensor(xn, stop_gradient=False)
+            e = paddle.exp(paddle.subtract(
+                x, paddle.max(x, axis=1, keepdim=True)))
+            sm = paddle.divide(e, paddle.sum(e, axis=1, keepdim=True))
+            paddle.sum(paddle.multiply(sm, sm)).backward()
+            return sm.numpy(), x.grad.numpy()
+        of, gf = run(True)
+        oe, ge = run(False)
+        np.testing.assert_allclose(of, oe, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(gf, ge, rtol=1e-5, atol=1e-7)
+
+    def test_axis_and_keepdim_key_the_cache(self):
+        """Two flushes differing only in reduce attrs must be distinct
+        programs (the attrs are folded into the structural key)."""
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1})
+        arr = RNG.standard_normal((3, 5)).astype(np.float32)
+        x = paddle.to_tensor(arr)
+        a = paddle.sum(paddle.multiply(x, 2.0), axis=0)
+        b = paddle.sum(paddle.multiply(x, 2.0), axis=1)
+        np.testing.assert_allclose(a.numpy(), (arr * 2).sum(0), rtol=1e-5)
+        np.testing.assert_allclose(b.numpy(), (arr * 2).sum(1), rtol=1e-5)
+        k = paddle.sum(paddle.multiply(x, 2.0), axis=1, keepdim=True)
+        assert k.numpy().shape == (3, 1)
+
+    def test_reduction_terminated_chain_single_compile(self):
+        """Steady state: an 8-op chain + mean terminator is ONE cached
+        executable — ≤1 compile after warmup, 100% hits."""
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1})
+        x = paddle.to_tensor(
+            RNG.standard_normal((8, 8)).astype(np.float32),
+            stop_gradient=False)
+        b = paddle.to_tensor(
+            RNG.standard_normal((8, 8)).astype(np.float32))
+
+        def loss(t):
+            for _ in range(4):
+                t = paddle.multiply(t, b)
+                t = paddle.add(t, 0.125)
+            return paddle.mean(paddle.square(t))
+
+        for _ in range(3):  # warmup (sighting + compile)
+            float(loss(x).numpy())
+        s0 = fusion.stats()
+        for _ in range(10):
+            float(loss(x).numpy())
+        s1 = fusion.stats()
+        assert s1["chains_flushed"] - s0["chains_flushed"] == 10
+        assert s1["cache_misses"] - s0["cache_misses"] <= 1, \
+            "steady-state reduction-terminated chain must hit the cache"
+        assert s1["cache_hits"] - s0["cache_hits"] >= 9
+        assert s1["reductions_fused"] - s0["reductions_fused"] == 10
+        assert s1["chain_length_hist"].get(10, 0) >= \
+            s0["chain_length_hist"].get(10, 0) + 9
+
+    def test_reduce_flag_off_restores_boundary(self):
+        """FLAGS_eager_fusion_reduce=0: the reduction flushes its input
+        chain at dispatch again, labeled reduce_boundary."""
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 0})
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        z = paddle.add(paddle.multiply(x, 2.0), 1.0)
+        assert z._lazy is not None
+        before = fusion.stats()["flush_reasons"].get("reduce_boundary", 0)
+        s = paddle.sum(z)
+        assert z._lazy is None and s._lazy is None
+        assert fusion.stats()["flush_reasons"]["reduce_boundary"] == \
+            before + 1
+        assert float(s.numpy()) == pytest.approx(18.0)
+
+    def test_functional_grad_through_terminator(self):
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1})
+        x = paddle.to_tensor(np.float32([1.0, 2.0, 3.0]),
+                             stop_gradient=False)
+        loss = paddle.mean(paddle.square(x))
+        (g,) = paddle.grad(loss, [x])
+        np.testing.assert_allclose(g.numpy(), 2 * x.numpy() / 3, rtol=1e-6)
+
+    def test_sum_dtype_attr(self):
+        """The dtype attr participates in the program key and output."""
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1})
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        r = paddle.sum(paddle.multiply(x, 1.0), dtype="float32")
+        assert r._lazy is not None
+        assert r.dtype == np.float32
+        assert float(r.numpy()) == pytest.approx(6.0)
+
+    def test_squared_l2_norm(self):
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1})
+        arr = RNG.standard_normal((4, 4)).astype(np.float32)
+        x = paddle.to_tensor(arr, stop_gradient=False)
+        n = paddle.squared_l2_norm(paddle.multiply(x, 0.5))
+        assert n._lazy is not None
+        n.backward()
+        assert float(n.numpy()) == pytest.approx(
+            float(np.sum((arr * 0.5) ** 2)), rel=1e-5)
+        # d/dx sum((0.5 x)^2) = 2 * 0.5x * 0.5 = 0.5 x
+        np.testing.assert_allclose(x.grad.numpy(), arr * 0.5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# matmul / linear epilogues (fusable: epilogue)
+# ---------------------------------------------------------------------------
+
+def _run_epilogue(build, fused, *arrs):
+    set_flags({"FLAGS_eager_fusion": 1 if fused else 0,
+               "FLAGS_eager_fusion_epilogue": 1})
+    ts = [paddle.to_tensor(a, stop_gradient=False) for a in arrs]
+    out = build(*ts)
+    if fused:
+        assert out._lazy is not None, "epilogue chain did not defer"
+    paddle.sum(out).backward()
+    return (out.astype("float32").numpy(),
+            [t.grad.astype("float32").numpy() for t in ts])
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_matmul_bias_act_epilogue_matches_eager(dtype):
+    x = RNG.standard_normal((4, 6)).astype(np.float32)
+    w = RNG.standard_normal((6, 3)).astype(np.float32)
+    b = RNG.standard_normal((3,)).astype(np.float32)
+
+    def build(xt, wt, bt):
+        mm = paddle.matmul(xt.astype(dtype), wt.astype(dtype))
+        return paddle.tanh(paddle.add(mm, bt.astype(dtype)))
+
+    out_f, g_f = _run_epilogue(build, True, x, w, b)
+    out_e, g_e = _run_epilogue(build, False, x, w, b)
+    tol = dict(rtol=1e-5, atol=1e-6) if dtype == "float32" else \
+        dict(rtol=3e-2, atol=3e-2)
+    np.testing.assert_allclose(out_f, out_e, **tol)
+    for gf, ge in zip(g_f, g_e):
+        np.testing.assert_allclose(gf, ge, **tol)
+
+
+@pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_matmul_transpose_attrs(tx, ty):
+    """Transpose flags ride as node attrs in the fused program key."""
+    a = RNG.standard_normal((5, 4)).astype(np.float32)
+    b = RNG.standard_normal((4, 3)).astype(np.float32)
+    a_in = np.ascontiguousarray(a.T) if tx else a
+    b_in = np.ascontiguousarray(b.T) if ty else b
+
+    def build(at, bt):
+        return paddle.add(
+            paddle.matmul(at, bt, transpose_x=tx, transpose_y=ty), 0.5)
+
+    out_f, g_f = _run_epilogue(build, True, a_in, b_in)
+    out_e, g_e = _run_epilogue(build, False, a_in, b_in)
+    np.testing.assert_allclose(out_f, a @ b + 0.5, rtol=1e-5)
+    np.testing.assert_allclose(out_f, out_e, rtol=1e-5, atol=1e-6)
+    for gf, ge in zip(g_f, g_e):
+        np.testing.assert_allclose(gf, ge, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("with_bias", [True, False])
+def test_linear_epilogue_matches_eager(with_bias):
+    x = RNG.standard_normal((2, 6)).astype(np.float32)
+    w = RNG.standard_normal((6, 4)).astype(np.float32)
+    b = RNG.standard_normal((4,)).astype(np.float32)
+
+    if with_bias:
+        def build(xt, wt, bt):
+            return F.relu(F.linear(xt, wt, bt))
+        args = (x, w, b)
+    else:
+        def build(xt, wt):
+            return F.relu(F.linear(xt, wt))
+        args = (x, w)
+
+    out_f, g_f = _run_epilogue(build, True, *args)
+    out_e, g_e = _run_epilogue(build, False, *args)
+    np.testing.assert_allclose(out_f, out_e, rtol=1e-5, atol=1e-6)
+    for gf, ge in zip(g_f, g_e):
+        np.testing.assert_allclose(gf, ge, rtol=1e-5, atol=1e-6)
+
+
+def test_cast_fuses_into_epilogue_chain():
+    """cast (parametric elementwise: target dtype in the program key)
+    rides the chain — act(x@w+b).astype(bf16) is still ONE program."""
+    set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_epilogue": 1})
+    x = paddle.to_tensor(RNG.standard_normal((4, 6)).astype(np.float32))
+    w = paddle.to_tensor(RNG.standard_normal((6, 3)).astype(np.float32))
+    b = paddle.to_tensor(RNG.standard_normal((3,)).astype(np.float32))
+    s0 = fusion.stats()
+    out = paddle.tanh(paddle.add(paddle.matmul(x, w), b)).astype("bfloat16")
+    assert out._lazy is not None
+    assert out.dtype == np.dtype("bfloat16")  # aval answers lazily
+    got = out.astype("float32").numpy()
+    s1 = fusion.stats()
+    # matmul + add + tanh + cast + the read-back cast: one flush
+    assert s1["chains_flushed"] - s0["chains_flushed"] == 1
+    assert s1["ops_fused"] - s0["ops_fused"] == 5
+    ref = np.tanh(x.numpy() @ w.numpy() + b.numpy())
+    np.testing.assert_allclose(got, ref, rtol=3e-2, atol=3e-2)
+
+
+def test_cast_grad_matches_eager():
+    def run(fused):
+        set_flags({"FLAGS_eager_fusion": 1 if fused else 0})
+        x = paddle.to_tensor(np.float32([1.5, -2.0]), stop_gradient=False)
+        y = paddle.multiply(x, 2.0).astype("float16")
+        assert (y._lazy is not None) == bool(fused)
+        paddle.sum(y).backward()
+        return y.astype("float32").numpy(), x.grad.numpy()
+    of, gf = run(True)
+    oe, ge = run(False)
+    np.testing.assert_allclose(of, oe, rtol=1e-6)
+    np.testing.assert_allclose(gf, ge, rtol=1e-6)
+
+
+@pytest.mark.parametrize("approximate", [False, True])
+def test_gelu_epilogue(approximate):
+    """gelu's `approximate` flag is a node attr — both variants fuse as
+    distinct programs and match their eager results."""
+    x = RNG.standard_normal((3, 5)).astype(np.float32)
+    w = RNG.standard_normal((5, 2)).astype(np.float32)
+
+    def build(xt, wt):
+        return F.gelu(paddle.matmul(xt, wt), approximate=approximate)
+
+    out_f, g_f = _run_epilogue(build, True, x, w)
+    out_e, g_e = _run_epilogue(build, False, x, w)
+    np.testing.assert_allclose(out_f, out_e, rtol=1e-5, atol=1e-6)
+    for gf, ge in zip(g_f, g_e):
+        np.testing.assert_allclose(gf, ge, rtol=1e-5, atol=1e-6)
+
+
+class TestMatmulEpilogue:
+    def test_epilogue_counter_and_single_program(self):
+        set_flags({"FLAGS_eager_fusion": 1,
+                   "FLAGS_eager_fusion_epilogue": 1})
+        x = paddle.to_tensor(RNG.standard_normal((4, 6)).astype(np.float32))
+        w = paddle.to_tensor(RNG.standard_normal((6, 3)).astype(np.float32))
+        b = paddle.to_tensor(RNG.standard_normal((3,)).astype(np.float32))
+        s0 = fusion.stats()
+        out = paddle.tanh(paddle.add(paddle.matmul(x, w), b))
+        out.numpy()
+        s1 = fusion.stats()
+        assert s1["chains_flushed"] - s0["chains_flushed"] == 1
+        assert s1["epilogues_fused"] - s0["epilogues_fused"] == 1
+        assert s1["ops_fused"] - s0["ops_fused"] == 3
+
+    def test_lone_matmul_not_counted_as_epilogue(self):
+        set_flags({"FLAGS_eager_fusion": 1,
+                   "FLAGS_eager_fusion_epilogue": 1})
+        x = paddle.to_tensor(RNG.standard_normal((4, 6)).astype(np.float32))
+        w = paddle.to_tensor(RNG.standard_normal((6, 3)).astype(np.float32))
+        s0 = fusion.stats()
+        r = paddle.matmul(x, w)
+        assert r._lazy is not None
+        got = r.numpy()
+        s1 = fusion.stats()
+        assert s1["epilogues_fused"] - s0["epilogues_fused"] == 0
+        np.testing.assert_allclose(got, x.numpy() @ w.numpy(), rtol=1e-5)
+
+    def test_epilogue_flag_off_keeps_matmul_eager(self):
+        set_flags({"FLAGS_eager_fusion": 1,
+                   "FLAGS_eager_fusion_epilogue": 0})
+        x = paddle.to_tensor(RNG.standard_normal((4, 6)).astype(np.float32))
+        w = paddle.to_tensor(RNG.standard_normal((6, 3)).astype(np.float32))
+        r = paddle.matmul(x, w)
+        assert r._lazy is None  # dispatched eagerly, pre-Fusion-II path
+
+    def test_matmul_boundary_reason(self):
+        """With the epilogue flag off, a contraction consuming a pending
+        chain flushes it labeled matmul_boundary."""
+        set_flags({"FLAGS_eager_fusion": 1,
+                   "FLAGS_eager_fusion_epilogue": 0})
+        x = paddle.to_tensor(np.ones((4, 6), np.float32))
+        w = paddle.to_tensor(np.ones((6, 3), np.float32))
+        z = paddle.add(paddle.multiply(x, 2.0), 1.0)
+        assert z._lazy is not None
+        before = fusion.stats()["flush_reasons"].get("matmul_boundary", 0)
+        r = paddle.matmul(z, w)
+        assert z._lazy is None
+        assert fusion.stats()["flush_reasons"]["matmul_boundary"] == \
+            before + 1
+        np.testing.assert_allclose(r.numpy(), np.full((4, 3), 18.0),
+                                   rtol=1e-6)
+
+    def test_held_requires_grad_matmul_stays_tape_edge(self):
+        """A live requires-grad matmul handle cuts the chain (its own
+        GradNode) — the epilogue never swallows a contraction another
+        consumer may inspect. Matches eager exactly."""
+        def run(fused):
+            set_flags({"FLAGS_eager_fusion": 1 if fused else 0,
+                       "FLAGS_eager_fusion_epilogue": 1})
+            x = paddle.to_tensor(np.float32([[1.0, 2.0]]),
+                                 stop_gradient=False)
+            w = paddle.to_tensor(np.float32([[3.0], [4.0]]),
+                                 stop_gradient=False)
+            t = paddle.matmul(x, w)        # held handle
+            y = paddle.multiply(t, 2.0)
+            loss = paddle.sum(y)
+            (gt,) = paddle.grad(loss, [t], retain_graph=True)
+            return t.item(), gt.item()
+        assert run(True) == run(False) == (11.0, 2.0)
+
+    def test_epilogue_steady_state_single_compile(self):
+        set_flags({"FLAGS_eager_fusion": 1,
+                   "FLAGS_eager_fusion_epilogue": 1})
+        x = paddle.to_tensor(RNG.standard_normal((16, 16))
+                             .astype(np.float32), stop_gradient=False)
+        w = paddle.to_tensor(RNG.standard_normal((16, 16))
+                             .astype(np.float32))
+        b = paddle.to_tensor(RNG.standard_normal((16,)).astype(np.float32))
+
+        def step():
+            return paddle.tanh(paddle.add(paddle.matmul(x, w), b)).numpy()
+
+        for _ in range(3):
+            step()
+        s0 = fusion.stats()
+        for _ in range(10):
+            step()
+        s1 = fusion.stats()
+        assert s1["cache_misses"] - s0["cache_misses"] <= 1
+        assert s1["cache_hits"] - s0["cache_hits"] >= 9
+        assert s1["epilogues_fused"] - s0["epilogues_fused"] == 10
+
+    def test_matmul_reduction_whole_loss_fuses(self):
+        """act(x @ w + b) -> mean loss: contraction, epilogue AND
+        terminator in ONE program."""
+        set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1,
+                   "FLAGS_eager_fusion_epilogue": 1})
+        xn = RNG.standard_normal((4, 6)).astype(np.float32)
+        wn = RNG.standard_normal((6, 3)).astype(np.float32)
+        bn = RNG.standard_normal((3,)).astype(np.float32)
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        w = paddle.to_tensor(wn, stop_gradient=False)
+        b = paddle.to_tensor(bn, stop_gradient=False)
+        s0 = fusion.stats()
+        loss = paddle.mean(paddle.square(
+            paddle.tanh(paddle.add(paddle.matmul(x, w), b))))
+        loss.backward()
+        s1 = fusion.stats()
+        assert s1["chains_flushed"] - s0["chains_flushed"] == 1
+        assert s1["epilogues_fused"] - s0["epilogues_fused"] == 1
+        assert s1["reductions_fused"] - s0["reductions_fused"] == 1
+        ref = np.mean(np.tanh(xn @ wn + bn) ** 2)
+        assert float(loss.numpy()) == pytest.approx(ref, rel=1e-5)
+        # grads flow to all three leaves through the one fused VJP
+        assert all(t.grad is not None for t in (x, w, b))
+
+
+def test_compile_seconds_labeled_by_program_kind():
+    """Reduce/epilogue programs land their first-call compile time in
+    fusion.compile_seconds under a kind label (the chrome-trace /
+    snapshot attribution the profiler satellite wires through)."""
+    set_flags({"FLAGS_eager_fusion": 1, "FLAGS_eager_fusion_reduce": 1})
+    x = paddle.to_tensor(RNG.standard_normal((3, 7)).astype(np.float32))
+    for _ in range(3):  # sighting -> compile -> steady
+        float(paddle.mean(paddle.sinh(paddle.multiply(x, 0.5))).numpy())
+    kinds = {dict(k).get("kind")
+             for k in fusion._M_compile_s.series()}
+    assert "reduce" in kinds
+
+
 def test_stats_surface_shape():
     s = fusion.stats()
     for key in ("ops_deferred", "chains_flushed", "ops_fused",
                 "cache_hits", "cache_misses", "flush_reasons",
-                "chain_length_hist", "cache_size", "avg_ops_per_chain"):
+                "chain_length_hist", "cache_size", "avg_ops_per_chain",
+                "reductions_fused", "epilogues_fused"):
         assert key in s

@@ -149,6 +149,10 @@ spec("sum", lambda: [_std(3, 4)], lambda x, axis=1: x.sum(1),
      attrs={"axis": 1})
 spec("mean", lambda: [_std(3, 4)], lambda x, axis=1: x.mean(1),
      attrs={"axis": 1})
+spec("squared_l2_norm", lambda: [_std(3, 4)], lambda x: np.sum(x * x))
+spec("cast", lambda: [_std(3, 4)],
+     lambda x, dtype=None: x.astype(np.float32),
+     attrs={"dtype": "float32"})
 spec("prod", lambda: [_pos(3, 4)], lambda x, axis=1: x.prod(1),
      attrs={"axis": 1})
 spec("max", lambda: [_std(3, 4)], lambda x, axis=1: x.max(1),
@@ -686,6 +690,7 @@ def test_fallback_parser_agrees_with_pyyaml():
         "    plain: a_string",
         "    vjp: false",
         "    fusable: true",
+        "    fclass: reduce",     # marker classes stay plain strings
         # YAML 1.1 resolution corners where naive parsing diverges:
         "    notafloat: 1e5",      # no dot -> str in YAML 1.1
         "    wordbool: on",        # yes/no/on/off words are bools
@@ -701,34 +706,74 @@ def test_fallback_parser_agrees_with_pyyaml():
 
 
 def test_fusable_field_validation():
-    """`fusable` may only be declared on elementwise-arity ops, and every
-    fusable op must have a registered VJP (grads flow through the fused
-    program's jax.vjp) plus a registered fusion impl."""
+    """`fusable` is a CLASS marker — true (elementwise), `reduce`
+    (reduction terminator), `epilogue` (contraction) — with per-class
+    structural constraints, a registered VJP (grads flow through the
+    fused program's jax.vjp), and a registered fusion impl, so the YAML
+    can't drift from the runtime."""
+    import inspect
+
     from paddle_tpu.core import fusion
     from paddle_tpu.ops.op_registry import get_op_info
 
     d = yaml.safe_load(open("paddle_tpu/ops/ops.yaml"))["ops"]
     fusable = [o for o in d if o.get("fusable")]
-    assert len(fusable) >= 40  # the elementwise families are opted in
+    by_class = {}
+    for o in fusable:
+        assert o.get("fusable") in (True, "reduce", "epilogue"), \
+            f"op {o['name']}: unknown fusable class {o.get('fusable')!r}"
+        by_class.setdefault(o["fusable"], []).append(o)
+    assert len(by_class.get(True, [])) >= 40   # elementwise families
+    assert len(by_class.get("reduce", [])) >= 8
+    assert len(by_class.get("epilogue", [])) >= 2
     for o in fusable:
         name = o["name"]
         assert o.get("vjp", True) is True, \
             f"fusable op {name} lacks a VJP (vjp: false)"
         assert not o.get("variadic", False), \
-            f"fusable op {name} is variadic — not an elementwise arity"
-        assert 1 <= int(o["nin"]) <= 2, \
-            f"fusable op {name} has non-elementwise nin={o['nin']}"
-        assert int(o["nargs"]) <= 3, \
-            f"fusable op {name} has non-elementwise nargs={o['nargs']}"
+            f"fusable op {name} is variadic — not a fusable arity"
         info = get_op_info(name)
         assert info is not None and info.get("has_vjp"), name
+    for o in by_class.get(True, []):
+        assert 1 <= int(o["nin"]) <= 2, \
+            f"elementwise-fusable {o['name']} has nin={o['nin']}"
+        assert int(o["nargs"]) <= 3, \
+            f"elementwise-fusable {o['name']} has nargs={o['nargs']}"
+    # reductions: single-tensor ops whose Python wrapper exposes the
+    # axis/keepdim reduction signature (squared_l2_norm is a fixed full
+    # reduction by contract) and whose parametric impl is registered
+    _FIXED_REDUCTIONS = {"squared_l2_norm"}
+    import paddle_tpu.nn.functional as F
+    for o in by_class.get("reduce", []):
+        name = o["name"]
+        assert int(o["nin"]) == 1, \
+            f"reduce-fusable {name} must take one tensor (nin=1)"
+        assert name in fusion._PIMPLS, \
+            f"reduce-fusable {name} has no parametric impl registered"
+        if name not in _FIXED_REDUCTIONS:
+            fn = getattr(paddle, name, None) or getattr(F, name)
+            params = inspect.signature(fn).parameters
+            assert "axis" in params and "keepdim" in params, \
+                f"reduce-fusable {name} lacks the axis/keepdim surface"
+    # contractions: two-or-more tensor ops with a registered parametric
+    # impl (matmul's transpose flags / linear's optional bias)
+    for o in by_class.get("epilogue", []):
+        name = o["name"]
+        assert int(o["nin"]) == 2, \
+            f"epilogue-fusable {name} must be a binary contraction"
+        assert name in fusion._PIMPLS, \
+            f"epilogue-fusable {name} has no parametric impl registered"
     # every fusable name that wins its OP_TABLE slot has a registered
     # canonical impl so the fused program can be rebuilt from its name
     from paddle_tpu.ops.op_registry import OP_TABLE
-    for name in {o["name"] for o in fusable}:
+    for name in {o["name"] for o in by_class.get(True, [])}:
         if OP_TABLE[name].get("fusable"):
-            assert name in fusion._IMPLS, \
+            assert name in fusion._IMPLS or name in fusion._PIMPLS, \
                 f"fusable op {name} has no fusion impl registered"
+    # the registry normalizes/validates the class marker at load time
+    from paddle_tpu.ops.op_registry import _norm_fusable
+    with pytest.raises(ValueError):
+        _norm_fusable("demo", "reduction")  # typo'd class must not load
 
 
 def test_yaml_fully_covered():

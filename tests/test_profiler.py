@@ -23,6 +23,36 @@ def _span_names(path):
     return [e["name"] for e in data["traceEvents"]]
 
 
+class TestFusionCompileSpans:
+    def test_fused_compile_lands_as_span(self, tmp_path):
+        """A fused program's first (trace+compile) execution inside a
+        profiling window emits a fusion_compile[kind] span, so step
+        traces attribute the first-call spike (Fusion II satellite)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.core import fusion
+        from paddle_tpu.core.flags import get_flags, set_flags
+
+        prev = get_flags(["FLAGS_eager_fusion", "FLAGS_eager_fusion_reduce"])
+        try:
+            set_flags({"FLAGS_eager_fusion": 1,
+                       "FLAGS_eager_fusion_reduce": 1})
+            fusion.clear_cache()  # force a fresh sighting + compile
+            x = paddle.to_tensor(
+                np.random.default_rng(3).standard_normal((5, 3))
+                .astype(np.float32))
+            with Profiler():
+                for _ in range(3):  # sight -> compile -> steady
+                    float(paddle.mean(
+                        paddle.cosh(paddle.multiply(x, 0.5))).numpy())
+                out = str(tmp_path / "fc.json")
+                profiler.export_chrome_tracing(out)
+        finally:
+            set_flags(prev)
+        assert "fusion_compile[reduce]" in _span_names(out)
+
+
 class TestRecordEvent:
     def test_context_manager_records_span(self, tmp_path):
         with Profiler():
