@@ -898,6 +898,101 @@ def bench_reduction_fusion():
               "backend": jax.default_backend()})
 
 
+def bench_fused_optimizer_step():
+    """fused_optimizer_step_us: direct per-param cost of one optimizer
+    step for a 64-param model — AdamW + global-norm clip + a changing
+    (cosine) LR schedule — with the step fused into ONE buffer-donated
+    executable (FLAGS_fused_optimizer=1) vs the per-param eager update
+    loop (=0, ~10 tiny dispatches per param plus a full clip pass).
+    Graded on the directly measured step cost per the ±15µs host-noise
+    rule (an e2e train-loop A/B can't resolve the delta on this host);
+    bar: >= 3x lower per-param cost fused, with 100% steady-state cache
+    hits and <= 1 compile across the whole changing-LR schedule."""
+    import gc
+    import time as _t
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import metrics as om
+    from paddle_tpu.optimizer import fused_step
+
+    gc.collect()
+    n_params, shape, steps = 64, (64, 64), 20
+    rng = np.random.default_rng(0)
+    grads = [rng.normal(size=shape).astype(np.float32) * 1e-3
+             for _ in range(n_params)]
+
+    def build():
+        ps = [paddle.Parameter(
+            np.random.default_rng(i).standard_normal(shape)
+            .astype(np.float32)) for i in range(n_params)]
+        sched = paddle.optimizer.lr.CosineAnnealingDecay(
+            learning_rate=1e-3, T_max=200)
+        opt = paddle.optimizer.AdamW(
+            learning_rate=sched, parameters=ps,
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+        # grads persist across steps: the plain fused path donates only
+        # params + state, so the same grad buffers are reusable
+        for p, g in zip(ps, grads):
+            p.grad = paddle.to_tensor(g)
+        return ps, sched, opt
+
+    def measure(reps=3):
+        ps, sched, opt = build()
+        for _ in range(3):  # first sighting + compile + one hit
+            opt.step()
+            sched.step()
+        jax.block_until_ready(ps[0]._data)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = _t.perf_counter()
+            for _ in range(steps):
+                opt.step()
+                sched.step()
+            jax.block_until_ready(ps[0]._data)
+            best = min(best, (_t.perf_counter() - t0) / steps)
+        return best * 1e6  # µs per whole step
+
+    prev = paddle.get_flags("FLAGS_fused_optimizer")
+    try:
+        paddle.set_flags({"FLAGS_fused_optimizer": 1})
+        fused_step.clear_cache()
+        before = dict(om.snapshot().get("optimizer", {}))
+        fused_us = measure()
+        after = dict(om.snapshot().get("optimizer", {}))
+        paddle.set_flags({"FLAGS_fused_optimizer": 0})
+        eager_us = measure()
+    finally:
+        paddle.set_flags(prev)
+
+    def delta(k):
+        return int(after.get(k, 0) - before.get(k, 0))
+
+    compiles = delta("fused_compiles_total")
+    hits = delta("cache_hits_total")
+    fused_steps = delta("fused_steps_total")
+    fused_pp = fused_us / n_params
+    eager_pp = eager_us / n_params
+    speedup = eager_pp / max(fused_pp, 1e-9)
+    # steady state = every step after the first sighting + the compile
+    hit_rate = hits / max(fused_steps - 2, 1) * 100.0
+    _emit("fused_optimizer_step_us", fused_pp, "us/param", speedup / 3.0, {
+        "fused_us_per_param": round(fused_pp, 3),
+        "unfused_us_per_param": round(eager_pp, 3),
+        "speedup": round(speedup, 1),
+        "fused_step_us": round(fused_us, 1),
+        "unfused_step_us": round(eager_us, 1),
+        "n_params": n_params,
+        "compiles_across_changing_lr_schedule": compiles,
+        "steady_state_cache_hit_pct": round(hit_rate, 1),
+        "donated_bytes_per_step": delta("donated_bytes") // max(
+            hits + compiles, 1),
+        "optimizer": "AdamW + ClipGradByGlobalNorm + CosineAnnealingDecay",
+        "bar": ">=3x lower direct per-param cost, 100% steady-state "
+               "hits, <=1 compile across the LR schedule",
+        "backend": jax.default_backend()})
+
+
 def bench_checkpoint_roundtrip():
     """checkpoint_roundtrip: durable (sync) vs async save wall time +
     verified restore time for a small model state_dict through
@@ -963,13 +1058,15 @@ def bench_checkpoint_roundtrip():
               "bar": "async submission <= 2/3 sync persist"})
 
 
-def _ensure_backend_or_cpu():
+def _probe_backend(apply_in_process):
     """Probe backend initialization in a throwaway subprocess with a
     capped wait. BENCH_r05 died rc=124: the requested backend (axon)
     hung during init and the driver timeout killed the WHOLE run with an
-    empty artifact. A hung/broken backend now degrades to per-workload
-    CPU lines instead. Runs before this process ever imports jax, so
-    forcing JAX_PLATFORMS=cpu still takes effect."""
+    empty artifact. A hung/broken backend degrades to CPU lines instead.
+    Runs before this process ever imports jax. With
+    ``apply_in_process=False`` (the suite parent, which never imports
+    jax itself) the fallback is recorded in os.environ only, for the
+    per-metric worker subprocesses to inherit."""
     import subprocess
     import sys
     wait = float(os.environ.get("PADDLE_TPU_BENCH_INIT_TIMEOUT", "120"))
@@ -985,13 +1082,9 @@ def _ensure_backend_or_cpu():
     except Exception as e:  # noqa: BLE001
         err = f"{type(e).__name__}: {e}"[:300]
     os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        import jax
-        # the image's plugin force-prepends the TPU platform regardless
-        # of JAX_PLATFORMS; override before any backend resolves
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:  # noqa: BLE001
-        pass
+    os.environ["PADDLE_TPU_BENCH_FORCE_CPU"] = "1"
+    if apply_in_process:
+        _force_cpu_in_process()
     _emit("backend_init_fallback", None, "error", 0.0, {
         "error": err,
         "action": "forcing JAX_PLATFORMS=cpu; workloads emit CPU lines",
@@ -999,19 +1092,121 @@ def _ensure_backend_or_cpu():
     return False
 
 
+def _force_cpu_in_process():
+    try:
+        import jax
+        # the image's plugin force-prepends the TPU platform regardless
+        # of JAX_PLATFORMS; override before any backend resolves
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _ensure_backend_or_cpu():
+    return _probe_backend(apply_in_process=True)
+
+
+# The full suite, in emission order. Micro benches first: they need a
+# quiet process for µs fidelity (and with per-metric workers, a fresh
+# one). Each row: (error-line label, bench fn name).
+_SUITE = [
+    ("eager_dispatch_overhead_us", "bench_dispatch_overhead"),
+    ("metrics_overhead", "bench_metrics_overhead"),
+    ("eager_fusion_speedup", "bench_eager_fusion"),
+    ("reduction_fusion_speedup", "bench_reduction_fusion"),
+    ("fused_optimizer_step_us", "bench_fused_optimizer_step"),
+    ("bench_llama", "bench_llama"),
+    ("bench_llama7b_geometry", "bench_llama7b_geometry"),
+    ("bench_resnet50", "bench_resnet50"),
+    ("bench_bert_base", "bench_bert_base"),
+    ("bench_gpt13b_geometry", "bench_gpt13b_geometry"),
+    ("bench_moe_dispatch", "bench_moe_dispatch"),
+    ("bench_llama_decode", "bench_llama_decode"),
+    ("bench_checkpoint_roundtrip", "bench_checkpoint_roundtrip"),
+]
+
+
+def _run_one(fn_name):
+    """Worker mode (``--one <fn>``): run a single metric in this
+    process. Handled failures emit an error line and still exit 0 —
+    only a hard crash (segfault, OOM kill) surfaces as rc != 0, which
+    the parent converts into the error line."""
+    label = next((lbl for lbl, fn in _SUITE if fn == fn_name), fn_name)
+    if os.environ.get("PADDLE_TPU_BENCH_FORCE_CPU"):
+        _force_cpu_in_process()
+    elif not os.environ.get("PADDLE_TPU_BENCH_NO_PROBE"):
+        _ensure_backend_or_cpu()
+    try:
+        globals()[fn_name]()
+    except Exception as e:  # noqa: BLE001 — record, exit clean
+        _emit(label, None, "error", 0.0,
+              {"error": f"{type(e).__name__}: {e}"[:300]})
+
+
+def _run_suite():
+    """Suite mode: each metric runs in its OWN capped subprocess, so a
+    hung backend/workload yields an error line for that metric and the
+    suite still exits 0 — never an rc=124 kill with a truncated
+    artifact (BENCH_r05). The parent stays jax-free; workers inherit
+    the probe verdict through the environment. An overall budget
+    (PADDLE_TPU_BENCH_BUDGET seconds, 0 disables) skips remaining
+    metrics with explicit lines once exhausted."""
+    import subprocess
+    import sys
+    _reset_artifact()
+    force_cpu = not _probe_backend(apply_in_process=False)
+    per_cap = float(os.environ.get(
+        "PADDLE_TPU_BENCH_METRIC_TIMEOUT", "420"))
+    budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET", "1740"))
+    deadline = (time.time() + budget) if budget > 0 else None
+    env = dict(os.environ, PADDLE_TPU_BENCH_NO_PROBE="1")
+    if force_cpu:
+        env["PADDLE_TPU_BENCH_FORCE_CPU"] = "1"
+    me = os.path.abspath(__file__)
+    for label, fn_name in _SUITE:
+        cap = per_cap
+        if deadline is not None:
+            remaining = deadline - time.time()
+            if remaining <= 10.0:
+                _emit(label, None, "error", 0.0, {
+                    "error": "suite budget exhausted; metric skipped",
+                    "budget_s": budget})
+                continue
+            cap = min(cap, remaining)
+        try:
+            # stdout inherited: the worker's metric lines stream to the
+            # driver and append to the shared artifact as they land
+            r = subprocess.run([sys.executable, me, "--one", fn_name],
+                               env=env, timeout=cap)
+            if r.returncode != 0:
+                _emit(label, None, "error", 0.0, {
+                    "error": f"worker crashed rc={r.returncode}"})
+        except subprocess.TimeoutExpired:
+            _emit(label, None, "error", 0.0, {
+                "error": f"metric exceeded its {cap:.0f}s cap; worker "
+                         f"killed, suite continues"})
+        except Exception as e:  # noqa: BLE001
+            _emit(label, None, "error", 0.0,
+                  {"error": f"{type(e).__name__}: {e}"[:300]})
+
+
 def main(argv=None):
     import sys
     argv = sys.argv[1:] if argv is None else argv
+    if "--one" in argv:
+        _run_one(argv[argv.index("--one") + 1])
+        return
     if "--headline-only" in argv:
         _ensure_backend_or_cpu()
         bench_llama()
         return
     if "--dispatch-only" in argv:
-        # quick-iteration smoke path: just the two dispatch/fusion
-        # microbenches (seconds, not minutes)
+        # quick-iteration smoke path: just the dispatch/fusion/optimizer
+        # microbenches, in-process (seconds, not minutes)
         _ensure_backend_or_cpu()
         for fn in (bench_dispatch_overhead, bench_metrics_overhead,
-                   bench_eager_fusion, bench_reduction_fusion):
+                   bench_eager_fusion, bench_reduction_fusion,
+                   bench_fused_optimizer_step):
             try:
                 fn()
             except Exception as e:  # noqa: BLE001
@@ -1019,42 +1214,8 @@ def main(argv=None):
                       {"error": f"{type(e).__name__}: {e}"[:300]})
         return
     # default (the driver run) = the FULL suite, one JSON line per
-    # BASELINE workload, headline (Llama) first. A non-headline failure
-    # emits an error line instead of killing the artifact.
-    # dispatch µs-bench runs FIRST: after the big workloads the process
-    # carries enough jit-cache/GC/tunnel state to triple even the raw
-    # jnp dispatch floor (measured 32 -> 72 µs), drowning the number
-    _reset_artifact()
-    _ensure_backend_or_cpu()
-    try:
-        bench_dispatch_overhead()
-    except Exception as e:  # noqa: BLE001
-        _emit("eager_dispatch_overhead_us", None, "error", 0.0,
-              {"error": f"{type(e).__name__}: {e}"[:300]})
-    try:
-        bench_metrics_overhead()
-    except Exception as e:  # noqa: BLE001
-        _emit("metrics_overhead", None, "error", 0.0,
-              {"error": f"{type(e).__name__}: {e}"[:300]})
-    try:
-        bench_eager_fusion()
-    except Exception as e:  # noqa: BLE001
-        _emit("eager_fusion_speedup", None, "error", 0.0,
-              {"error": f"{type(e).__name__}: {e}"[:300]})
-    try:
-        bench_reduction_fusion()
-    except Exception as e:  # noqa: BLE001
-        _emit("reduction_fusion_speedup", None, "error", 0.0,
-              {"error": f"{type(e).__name__}: {e}"[:300]})
-    bench_llama()
-    for fn in (bench_llama7b_geometry, bench_resnet50, bench_bert_base,
-               bench_gpt13b_geometry, bench_moe_dispatch,
-               bench_llama_decode, bench_checkpoint_roundtrip):
-        try:
-            fn()
-        except Exception as e:  # noqa: BLE001 - record, keep going
-            _emit(fn.__name__, None, "error", 0.0,
-                  {"error": f"{type(e).__name__}: {e}"[:300]})
+    # BASELINE workload, each metric in its own capped subprocess
+    _run_suite()
 
 
 if __name__ == "__main__":

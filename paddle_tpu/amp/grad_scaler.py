@@ -4,12 +4,43 @@ ref: python/paddle/amp/grad_scaler.py:187-446 (check_finite_and_unscale +
 update_loss_scaling). On TPU with bfloat16 (same exponent range as fp32)
 scaling is unnecessary — enable defaults accordingly — but the fp16 path is
 fully implemented for parity.
+
+The whole scaling loop is device-resident: the loss scale and the
+good/bad step counters live as 0-d device arrays, ``unscale_`` runs one
+jitted executable over every grad (fp32 unscale + global finite check,
+``optimizer.fused_step.unscale_and_check``), and the skip decision is a
+0-d device bool that masks the optimizer update via ``where(found_inf,
+old, new)`` — ``step()``/``update()`` never sync to host, fused or not.
+When FLAGS_fused_optimizer is on, ``step()`` routes through
+``fused_step.try_step_scaled`` so unscale, the finite check, clipping,
+every param update AND the conditional skip run as ONE buffer-donated
+executable. Host transfers happen only at explicit host boundaries
+(``state_dict()``, a user reading ``get_loss_scaling()``).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+
+
+def _scale_update(found, scale, good, bad, incr_ratio, decr_ratio,
+                  incr_every, decr_every):
+    """Pure dynamic-loss-scaling bookkeeping (the reference's
+    update_loss_scaling), branch-free so it runs on device."""
+    bad2 = jnp.where(found, bad + 1, 0)
+    good2 = jnp.where(found, 0, good + 1)
+    dec = bad2 >= decr_every
+    inc = good2 >= incr_every
+    new_scale = jnp.where(
+        found,
+        jnp.where(dec, jnp.maximum(scale * decr_ratio, 1.0), scale),
+        jnp.where(inc, scale * incr_ratio, scale))
+    return new_scale, jnp.where(inc, 0, good2), jnp.where(dec, 0, bad2)
+
+
+_update_jit = None
 
 
 class GradScaler:
@@ -17,44 +48,104 @@ class GradScaler:
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
                  decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
         self._enable = enable
-        self._scale = float(init_loss_scaling)
-        self._incr_ratio = incr_ratio
-        self._decr_ratio = decr_ratio
-        self._incr_every = incr_every_n_steps
-        self._decr_every = decr_every_n_nan_or_inf
+        self._scale = jnp.float32(init_loss_scaling)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every = int(incr_every_n_steps)
+        self._decr_every = int(decr_every_n_nan_or_inf)
         self._dynamic = use_dynamic_loss_scaling
-        self._good_steps = 0
-        self._bad_steps = 0
+        self._good_steps = jnp.int32(0)
+        self._bad_steps = jnp.int32(0)
+        # python False until an unscale runs, then a 0-d device bool;
+        # both satisfy truthiness for host consumers (distributed AMP
+        # allreduces it), neither forces a sync on the step path
         self._found_inf = False
         self._unscaled_opts = set()
 
     def scale(self, var):
         if not self._enable:
             return var
-        return var * self._scale
+        # the first scale() of a new iteration (no unscale pending) is
+        # the iteration boundary: clear the OR-accumulated found flag
+        # even when the user skipped update() — static-scaling loops
+        # legitimately do — so one bad batch can't latch the accumulator
+        # and mask every future step
+        if not self._unscaled_opts:
+            self._found_inf = False
+        # cast the scale into var's dtype so an fp16/bf16 loss keeps its
+        # dtype (a strong f32 0-d array would promote where the old
+        # weak Python float did not)
+        return var * Tensor(self._scale.astype(var.dtype))
+
+    def _accumulate_found(self, found):
+        if self._found_inf is False:
+            self._found_inf = found
+        else:
+            self._found_inf = jnp.logical_or(
+                jnp.asarray(self._found_inf, bool), found)
 
     def unscale_(self, optimizer):
         if not self._enable or id(optimizer) in self._unscaled_opts:
             return
         self._unscaled_opts.add(id(optimizer))
-        inv = 1.0 / self._scale
-        found = False
-        for p in optimizer._parameter_list:
-            if p.grad is None:
-                continue
-            g = p.grad._data * inv
-            finite = bool(jnp.all(jnp.isfinite(g)))
-            found = found or not finite
+        from ..optimizer import fused_step
+        params = [p for p in optimizer._parameter_list
+                  if p.grad is not None]
+        if not params:
+            return
+        inv = jnp.float32(1.0) / self._scale
+        new_grads, found = fused_step.unscale_and_check(
+            [p.grad._data for p in params], inv)
+        for p, g in zip(params, new_grads):
             p.grad._data = g
-        self._found_inf = found
+        self._accumulate_found(found)
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
+        from ..optimizer.optimizer import Optimizer
+        cls = type(optimizer)
+        # getattr, not cls.step: a delegating wrapper (shard_optimizer's
+        # _ShardOptimizer routes through instance __getattr__) has no
+        # class attr at all — treat it like an override and take the
+        # legacy path that simply calls its step()
+        if (getattr(cls, "step", None) is not Optimizer.step
+                or getattr(cls, "_step_masked", None)
+                is not Optimizer._step_masked
+                or "step" in optimizer.__dict__):
+            # a custom step() (LBFGS's closure loop, a user subclass
+            # layering behavior on top of step) must run as written —
+            # legacy host-decision path: unscale, read the flag, call
+            # the override. The one AMP path that syncs to host.
+            self.unscale_(optimizer)
+            if not bool(jnp.asarray(self._found_inf, bool)):
+                optimizer.step()
+            self._unscaled_opts.discard(id(optimizer))
+            return
+        retry_fused = True
+        # a patched/overridden unscale_ (shard_scaler's found-inf
+        # allreduce, a subclass hook) must actually run — only the
+        # fallback path below calls it, so skip the fused fast path
+        plain_unscale = ("unscale_" not in self.__dict__
+                         and type(self).unscale_ is GradScaler.unscale_)
+        if plain_unscale and id(optimizer) not in self._unscaled_opts:
+            # fused fast path: unscale + finite check + clip + update +
+            # skip as ONE donated executable
+            from ..optimizer import fused_step
+            found = fused_step.try_step_scaled(
+                optimizer, self._scale, prior_found=self._found_inf)
+            if found is not None:
+                self._accumulate_found(found)
+                return
+            # the fused gate just rejected this config — don't run the
+            # same prepare scan (and its fallback counter) again below
+            retry_fused = not fused_step.enabled()
+        # fallback: batched unscale (one executable), then the masked
+        # step — the decision stays on device here too
         self.unscale_(optimizer)
-        if not self._found_inf:
-            optimizer.step()
+        optimizer._step_masked(jnp.asarray(self._found_inf, bool),
+                               try_fused=retry_fused)
         self._unscaled_opts.discard(id(optimizer))
 
     def minimize(self, optimizer, scaled_loss):
@@ -63,21 +154,26 @@ class GradScaler:
         self.update()
 
     def update(self):
+        # the found flag is per-iteration regardless of dynamic scaling:
+        # without this reset a single non-finite step would latch the OR
+        # accumulator True and mask every future update
+        found, self._found_inf = self._found_inf, False
+        # update() ends the iteration for unscale marks too: an
+        # unscale_-without-step iteration (grad inspection) must not
+        # leave its id latched — a stale entry makes the next
+        # iteration's unscale_ early-return and step() would then apply
+        # still-scaled grads
+        self._unscaled_opts.clear()
         if not (self._enable and self._dynamic):
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every:
-                self._scale *= self._incr_ratio
-                self._good_steps = 0
-        self._found_inf = False
+        global _update_jit
+        if _update_jit is None:
+            _update_jit = jax.jit(_scale_update)
+        self._scale, self._good_steps, self._bad_steps = _update_jit(
+            jnp.asarray(found, bool), self._scale,
+            self._good_steps, self._bad_steps,
+            jnp.float32(self._incr_ratio), jnp.float32(self._decr_ratio),
+            jnp.int32(self._incr_every), jnp.int32(self._decr_every))
 
     def is_enable(self):
         return self._enable
@@ -89,15 +185,15 @@ class GradScaler:
         return Tensor(jnp.asarray(self._scale))
 
     def set_init_loss_scaling(self, v):
-        self._scale = float(v)
+        self._scale = jnp.float32(v)
 
     def state_dict(self):
-        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+        return {"scale": float(self._scale), "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio,
-                "good_steps": self._good_steps,
-                "bad_steps": self._bad_steps}
+                "good_steps": int(self._good_steps),
+                "bad_steps": int(self._bad_steps)}
 
     def load_state_dict(self, state):
-        self._scale = state.get("scale", self._scale)
-        self._good_steps = state.get("good_steps", 0)
-        self._bad_steps = state.get("bad_steps", 0)
+        self._scale = jnp.float32(state.get("scale", float(self._scale)))
+        self._good_steps = jnp.int32(state.get("good_steps", 0))
+        self._bad_steps = jnp.int32(state.get("bad_steps", 0))

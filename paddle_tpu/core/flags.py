@@ -130,6 +130,20 @@ define_flag("eager_fusion_max_chain", 32,
 define_flag("eager_fusion_cache", 256,
             "LRU capacity of the fusion program cache (entries keyed by "
             "DAG structure + input shapes/dtypes)")
+define_flag("fused_optimizer", True,
+            "One-executable optimizer step: flatten the whole parameter "
+            "tree (params/grads/moments) and run grad unscale + finite "
+            "check, global-norm clip and every per-param update as ONE "
+            "jitted, buffer-donated executable (params and optimizer "
+            "state update in place in HBM instead of allocating a second "
+            "model copy). Per-step dynamic scalars (lr, loss scale) ride "
+            "as 0-d device-array arguments so a changing LR schedule "
+            "never recompiles. Kill switch: FLAGS_fused_optimizer=0 "
+            "restores the per-param eager update loop")
+define_flag("fused_optimizer_cache", 32,
+            "LRU capacity of the fused optimizer-step program cache "
+            "(entries keyed by optimizer type + parameter-tree structure "
+            "+ dtypes/shapes + hyperparameter-static config)")
 define_flag("metrics", True,
             "Process-wide telemetry registry (paddle_tpu.observability): "
             "counters/gauges/histograms woven through dispatch, fusion, "

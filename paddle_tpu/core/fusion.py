@@ -79,6 +79,14 @@ _INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31
 # promotion semantics match the eager `jnp.add(x, 0.25)` exactly.
 _scalar_cache: Dict[tuple, Any] = {}
 
+# Live handles of pending (unflushed) chains. Buffer DONATION sites
+# (the fused optimizer step, the AMP batched unscale) must flush these
+# first: a pending chain captured its input buffers at dispatch time,
+# and donating one to XLA deletes it under the chain's feet. Keyed by
+# id() — a WeakSet would route bucket collisions through Tensor's
+# elementwise __eq__ and die on bool(array).
+_pending_tensors = weakref.WeakValueDictionary()
+
 # -- telemetry: the registry IS the storage; fusion.stats() below is a
 # view reconstructing the legacy dict shape from these instruments
 _M_flag = _om.flag_info()
@@ -356,6 +364,7 @@ def _new_lazy_tensor(expr: LazyExpr):
     t.trainable = False
     t._dist_attr = None
     expr.tref = weakref.ref(t)
+    _pending_tensors[id(t)] = t
     return t
 
 
@@ -617,6 +626,21 @@ def _get_program(sig, pkind):
 # ---------------------------------------------------------------------------
 # flush
 # ---------------------------------------------------------------------------
+
+def has_pending() -> bool:
+    """Any live unflushed chains? Cheap gate for donation sites."""
+    return len(_pending_tensors) > 0
+
+
+def flush_pending(reason: str = "donation") -> None:
+    """Flush EVERY pending chain. Called by buffer-donation sites
+    (fused optimizer step, AMP batched unscale) so no deferred program
+    can later read a buffer XLA just invalidated."""
+    for t in list(_pending_tensors.values()):
+        _pending_tensors.pop(id(t), None)
+        if t._lazy is not None:
+            materialize_tensor(t, reason)
+
 
 def materialize_tensor(t, reason: str = "host_read") -> None:
     """Flush the chain the lazy tensor ``t`` heads (no-op if concrete)."""

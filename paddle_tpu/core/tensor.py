@@ -38,6 +38,45 @@ _materialize_hook = None
 _mutation_hook = None
 
 
+# Tensors sharing a device buffer with another live handle (today:
+# ``detach()``). Buffer-DONATION sites (the fused optimizer step, the
+# AMP batched unscale) consult this and copy such a leaf instead of
+# donating it — XLA deletes donated buffers, and the eager loop's
+# replace-don't-mutate semantics promise a detached snapshot stays
+# readable, frozen at its point-in-time value. Outer key: id(array);
+# inner: id(alias Tensor) -> Tensor weakly, so entries vanish with the
+# last alias (a live alias pins the array, so its id can't be reused).
+_buffer_aliases: dict = {}
+
+
+def _register_alias(arr, t) -> None:
+    import weakref
+    if len(_buffer_aliases) > 64:
+        # amortized sweep: inner dicts empty themselves when the last
+        # alias dies, but the outer entry would otherwise persist —
+        # without this a detach-per-step loop leaks one entry per call
+        for k in [k for k, d in _buffer_aliases.items() if not len(d)]:
+            del _buffer_aliases[k]
+    d = _buffer_aliases.get(id(arr))
+    if d is None:
+        d = _buffer_aliases[id(arr)] = weakref.WeakValueDictionary()
+    d[id(t)] = t
+
+
+def buffer_has_alias(arr) -> bool:
+    """True when another live Tensor handle shares ``arr`` — the caller
+    must not donate it. ~Free when no aliases exist anywhere."""
+    if not _buffer_aliases:
+        return False
+    d = _buffer_aliases.get(id(arr))
+    if d is None:
+        return False
+    if not len(d):
+        del _buffer_aliases[id(arr)]  # last alias died: prune
+        return False
+    return True
+
+
 class Tensor:
     __slots__ = ("_buf", "_lazy", "stop_gradient", "grad", "_node",
                  "_out_index", "_retain_grads", "_hooks", "_hook_counter",
@@ -215,6 +254,7 @@ class Tensor:
 
     def detach(self):
         t = Tensor(self._data, stop_gradient=True)
+        _register_alias(self._data, t)
         return t
 
     def detach_(self):

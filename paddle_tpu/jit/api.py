@@ -286,25 +286,13 @@ class TrainStep:
         clip = self.optimizer._grad_clip
         if clip is None:
             return grads
-        from ..utils.clip_grad import (ClipGradByGlobalNorm, ClipGradByNorm,
-                                       ClipGradByValue)
-        if isinstance(clip, ClipGradByValue):
-            return {k: jnp.clip(g, clip.min, clip.max)
-                    for k, g in grads.items()}
-        if isinstance(clip, ClipGradByNorm):
-            out = {}
-            for k, g in grads.items():
-                n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
-                s = jnp.minimum(clip.clip_norm / jnp.maximum(n, 1e-12), 1.0)
-                out[k] = (g * s).astype(g.dtype)
-            return out
-        if isinstance(clip, ClipGradByGlobalNorm):
-            gn = jnp.sqrt(sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in grads.values()))
-            s = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
-            return {k: (g * s).astype(g.dtype) for k, g in grads.items()}
-        return grads
+        from ..utils.clip_grad import clip_by_spec, clip_spec
+        spec = clip_spec(clip, exact=False)
+        if not spec:  # unknown clip object: un-clipped inside the trace
+            return grads
+        keys = list(grads)
+        clipped = clip_by_spec(spec, [grads[k] for k in keys])
+        return dict(zip(keys, clipped))
 
     def _build(self):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer

@@ -4,4 +4,5 @@ from .optimizer import (  # noqa: F401
     Adadelta,
 )
 from .extra import ASGD, LBFGS, NAdam, RAdam, Rprop  # noqa: F401
+from . import fused_step  # noqa: F401
 from . import lr  # noqa: F401

@@ -208,6 +208,10 @@ class LBFGS(Optimizer):
     parameter vector; history (s, y, rho) kept host-side.
     """
 
+    # closure-driven multi-evaluation step with host-side convergence
+    # tests — not expressible as one pure whole-step program
+    _fusable_step = False
+
     def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
                  tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
                  line_search_fn=None, parameters=None, weight_decay=None,

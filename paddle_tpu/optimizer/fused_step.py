@@ -1,0 +1,540 @@
+"""One-executable optimizer step: fused multi-tensor updates with buffer
+donation.
+
+The forward path compiles as few, fat executables (core/fusion.py, the
+jit train steps), but an eager training step still ended in a dispatch
+storm: Adam/AdamW/Momentum issue ~5-10 tiny ops *per parameter* (moment
+updates, bias correction, write-back), plus a full per-parameter pass
+for global-norm clipping and another for the AMP grad-scaler's finite
+check. This module flattens the whole parameter tree — grads, params,
+moments — into one pytree and compiles **ONE** jitted, buffer-donated
+executable per (optimizer type, tree structure, dtypes/shapes,
+hyperparameter-static config) key:
+
+* **Donation** — params and optimizer state (and, on the grad-scaler
+  path, grads) are donated to XLA, so the update happens in place in
+  HBM instead of allocating a second copy of the model. The handles'
+  ``._data`` are rebound to the outputs; the old buffers are dead.
+* **Dynamic scalars** — lr (from any ``optimizer.lr`` scheduler) and
+  the AMP loss scale enter as 0-d device-array *arguments*, never as
+  baked constants: a changing LR schedule hits the same executable
+  every step (<= 1 steady-state compile across a whole schedule).
+  Beta-power accumulators are ordinary state leaves, already dynamic.
+* **Folded clip + AMP** — ``ClipGradByGlobalNorm``/``ByNorm``/``ByValue``
+  (utils/clip_grad pure specs) run inside the same program, and
+  ``GradScaler.step`` routes here with the loss scale so grad unscale,
+  the global inf/nan check AND the conditional skip (``where(found_inf,
+  old, new)`` on every param/state leaf) are part of the one executable
+  — the skip decision never touches the host.
+* **Compile policy** — mirrors the fusion plane: a structure compiles on
+  its SECOND sighting (one-off steps run un-jitted, steady loops compile
+  once at step two) and lives in an LRU keyed as above, shared across
+  optimizer instances with identical static config.
+
+Fallbacks are total and cheap: unknown clip/regularizer objects,
+non-static hyperparameters, aliased buffers, tracer leaves or the
+``FLAGS_fused_optimizer=0`` kill switch all return to the existing
+per-param eager loop (``Optimizer._eager_step``), counted by reason in
+``optimizer.fallbacks_total``. ``state_dict()``/``set_state_dict()``
+round-trips are byte-identical: state dicts keep their exact keys and
+leaf arrays, only produced by one program instead of N dispatches.
+
+Observability (PR 3 registry): ``optimizer.fused_steps_total``,
+``fused_step_seconds``, ``donated_bytes``, ``fused_compiles_total``,
+``cache_hits_total``, ``uncompiled_runs_total``, ``fallbacks_total``
+{reason} and a ``fused_optimizer_compile`` host-tracer span on the
+first (trace+compile) execution of each program.
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.flags import _registry as _flag_registry
+from ..core.tensor import Tensor, buffer_has_alias as _has_alias
+from ..observability import metrics as _om
+from ..utils.clip_grad import clip_by_spec, clip_spec
+
+__all__ = ["try_step", "try_step_scaled", "unscale_and_check", "enabled",
+           "clear_cache"]
+
+_flag = _flag_registry["fused_optimizer"]
+_cache_cap = _flag_registry["fused_optimizer_cache"]
+
+_M = _om.scope("optimizer")
+_M_flag = _om.flag_info()
+_M_steps = _M.counter(
+    "fused_steps_total",
+    "Optimizer steps executed as one fused, donated executable")
+_M_step_s = _M.histogram(
+    "fused_step_seconds",
+    "Host wall seconds per fused optimizer step (dispatch-side; the "
+    "device work is async)")
+_M_donated = _M.counter(
+    "donated_bytes",
+    "Bytes of params + optimizer state (+ grads on the scaled path) "
+    "donated to fused step executables — updated in place in HBM")
+_M_compiles = _M.counter(
+    "fused_compiles_total",
+    "Fused optimizer-step programs compiled (trace + XLA build)")
+_M_hits = _M.counter(
+    "cache_hits_total", "Fused steps served by a cached executable")
+_M_uncompiled = _M.counter(
+    "uncompiled_runs_total", "First-sighting steps run un-jitted")
+_M_fallbacks = _M.counter(
+    "fallbacks_total",
+    "Steps that fell back to the per-param eager loop, by reason")
+_M_compile_s = _M.histogram(
+    "compile_seconds",
+    "First execution (trace+compile) of a fused step program")
+
+# optimizer attrs that are NOT numeric hyperparameters: containers,
+# transient per-step scratch, the dynamic lr, and this module's own
+# per-optimizer caches. Everything else must be a hashable scalar/tuple
+# or the optimizer falls back (conservative: unknown state never fuses).
+_HYPER_EXCLUDE = frozenset({
+    "_parameter_list", "_learning_rate", "_grad_clip", "_regularizer",
+    "_states", "_global_step", "_param_names", "_current_pid",
+    "_cur_param", "_exclude_fn", "_apply_decay_param_fun",
+    "_found_inf_arg", "_fused_lr_host", "_fused_lr_dev",
+})
+
+_programs: "OrderedDict[tuple, tuple]" = OrderedDict()
+_lock = threading.Lock()
+_SEEN = object()  # first-sighting marker: structure noted, not compiled
+
+
+def enabled() -> bool:
+    return bool(_flag.value)
+
+
+def clear_cache() -> None:
+    with _lock:
+        _programs.clear()
+
+
+def _fallback(reason: str):
+    _M_fallbacks.inc(reason=reason)
+    return None
+
+
+def _hyper_key(opt) -> Optional[tuple]:
+    """Hashable static-hyperparameter tuple, or None when the optimizer
+    carries attrs this plane can't prove static (user subclass state)."""
+    items = []
+    for k, v in sorted(vars(opt).items()):
+        if k in _HYPER_EXCLUDE:
+            continue
+        if v is None or isinstance(v, (bool, int, float, str)):
+            items.append((k, v))
+        elif isinstance(v, tuple) and all(
+                isinstance(x, (bool, int, float, str)) for x in v):
+            items.append((k, v))
+        else:
+            return None
+    return tuple(items)
+
+
+def _param_statics(opt, params) -> Optional[tuple]:
+    """Per-param trace-time-static decisions that must ride the cache
+    key: the effective weight-decay coefficient (AdamW's
+    apply_decay_param_fun) and Lamb's exclude decision."""
+    has_pid = hasattr(opt, "_current_pid")
+    exf = getattr(opt, "_exclude_fn", None)
+    out = []
+    for p in params:
+        if has_pid:
+            opt._current_pid = id(p)
+        opt._cur_param = p
+        try:
+            wd = float(opt._use_wd(p))
+        except (TypeError, ValueError):
+            return None
+        out.append((wd, bool(exf(p)) if exf is not None else None))
+    if has_pid:
+        opt._current_pid = None
+    return tuple(out)
+
+
+class _TraceCtx:
+    """Mutable cell carrying the live optimizer + Parameter handles into
+    ``step_fn`` ONLY for the duration of a call: ``_execute`` fills it
+    just before invoking the (possibly re-tracing) program and clears it
+    after, so a cached executable never pins a dead model's params or
+    optimizer state between steps. Any trace necessarily happens inside
+    an active call, when the cell is populated — and every numeric
+    constant the trace reads off the instance is part of the cache key,
+    so a structural hit from a different optimizer instance is
+    numerically identical."""
+    __slots__ = ("opt", "params")
+
+    def __init__(self):
+        self.opt = None
+        self.params = None
+
+
+def _make_fn(ctx, mode, cspec, n):
+    """The pure whole-step function. ``mode``:
+
+    - "plain": scalars=(lr,)            -> (new_params, new_states)
+    - "found": scalars=(lr, found_inf)  -> + masked updates
+    - "scaled": scalars=(lr, inv_scale, prior_found) -> unscale + finite
+      check inside; updates masked by ``this_check | prior_found`` (the
+      scaler's OR-accumulated flag from earlier unscale_ calls, so the
+      skip decision matches the unfused fallback exactly); returns
+      (new_params, new_states, unscaled_grads, found_inf_of_this_check)
+    """
+
+    def step_fn(params, grads, states, scalars):
+        opt, param_objs = ctx.opt, ctx.params
+        has_pid = hasattr(opt, "_current_pid")
+        lr = scalars[0]
+        gs = list(grads)
+        found = None
+        if mode == "scaled":
+            gs, found_own = _unscale_fn(gs, scalars[1])
+            unscaled = list(gs)
+            found = jnp.logical_or(found_own, scalars[2])
+        elif mode == "found":
+            found = scalars[1]
+        if cspec:
+            gs = clip_by_spec(cspec, gs)
+        new_ps: List[Any] = []
+        new_ss: List[Dict[str, Any]] = []
+        for i in range(n):
+            if has_pid:
+                opt._current_pid = id(param_objs[i])
+            opt._cur_param = param_objs[i]
+            g = opt._apply_regularizer(params[i], gs[i])
+            new_p, new_s = opt._update(params[i], g, states[i], lr)
+            new_ps.append(new_p)
+            new_ss.append(new_s)
+        if has_pid:
+            opt._current_pid = None
+        if found is not None:
+            # conditional skip ON DEVICE: a non-finite grad signal keeps
+            # every param AND state leaf at its old value
+            new_ps = [jnp.where(found, p, q)
+                      for p, q in zip(params, new_ps)]
+            new_ss = [{k: jnp.where(found, st[k], v)
+                       for k, v in ns.items()}
+                      for st, ns in zip(states, new_ss)]
+        if mode == "scaled":
+            return new_ps, new_ss, unscaled, found_own
+        if mode == "found":
+            return new_ps, new_ss, found
+        return new_ps, new_ss
+
+    return step_fn
+
+
+def _trace_compile_span(dt: float) -> None:
+    """Land the trace+compile window as a ``fused_optimizer_compile``
+    span when the native host tracer is live (same contract as the
+    fusion plane's ``fusion_compile[kind]`` spans). Lazy module lookup
+    only — never triggers the native build."""
+    import sys
+    mod = sys.modules.get("paddle_tpu._native")
+    lib = getattr(mod, "lib", None)
+    if lib is None:
+        return
+    try:
+        if lib.tracer_enabled():
+            now = lib.tracer_now()
+            lib.tracer_record("fused_optimizer_compile",
+                              now - dt * 1e6, now)
+    except Exception:
+        pass
+
+
+def _timed_first_call(jf):
+    done = [False]
+
+    def wrapper(*a):
+        if done[0]:
+            return jf(*a)
+        t0 = _time.perf_counter()
+        out = jf(*a)
+        done[0] = True
+        dt = _time.perf_counter() - t0
+        _M_compiles.inc()
+        _M_compile_s.observe(dt)
+        _trace_compile_span(dt)
+        return out
+
+    return wrapper
+
+
+def _get_program(key, builder, donate):
+    """Second-sighting compile policy (mirrors the fusion plane): the
+    first flush of a structure runs the pure fn un-jitted, the second
+    compiles + donates, later ones hit the cache. Entries are
+    (kind, fn, ctx) — ``ctx`` the program's _TraceCtx cell."""
+    with _lock:
+        entry = _programs.get(key)
+        if entry is not None and entry is not _SEEN:
+            _programs.move_to_end(key)
+            _M_hits.inc()
+            return entry
+
+    def _put(e):
+        with _lock:
+            _programs[key] = e
+            cap = max(int(_cache_cap.value or 32), 4)
+            while len(_programs) > cap:
+                _programs.popitem(last=False)
+
+    ctx = _TraceCtx()
+    if entry is _SEEN:
+        jf = jax.jit(builder(ctx), donate_argnums=donate)
+        entry = ("jit", _timed_first_call(jf), ctx)
+        _put(entry)
+        return entry
+    _M_uncompiled.inc()
+    _put(_SEEN)
+    return ("eager", builder(ctx), ctx)
+
+
+class _Prep:
+    __slots__ = ("params", "p_leaves", "g_leaves", "s_leaves", "key",
+                 "cspec", "nbytes")
+
+
+def _prepare(opt, params_grads, mode) -> Optional[_Prep]:
+    """Gate + flatten. Returns None (fallback, reason counted) or the
+    prepared leaves + structural cache key."""
+    if getattr(opt, "_fusable_step", True) is False:
+        return _fallback("optimizer")
+    cspec = clip_spec(opt._grad_clip)
+    if cspec is None:
+        return _fallback("grad_clip")
+    reg = opt._regularizer
+    if reg is None:
+        rspec = ()
+    else:
+        coeff = getattr(reg, "_coeff", getattr(reg, "coeff", None))
+        if coeff is None:
+            return _fallback("regularizer")
+        rspec = (type(reg).__qualname__, float(coeff))
+    hyper = _hyper_key(opt)
+    if hyper is None:
+        return _fallback("hyper")
+    params = [p for p, _ in params_grads]
+    statics = _param_statics(opt, params)
+    if statics is None:
+        return _fallback("param_static")
+    if len({id(p) for p in params}) != len(params):
+        return _fallback("duplicate_param")
+
+    p_leaves, g_leaves, s_leaves, tree = [], [], [], []
+    donated_ids = set()
+    nbytes = 0
+    for (p, g), stat in zip(params_grads, statics):
+        pd = p._data
+        gd = g._data if isinstance(g, Tensor) else g
+        if isinstance(pd, jax.core.Tracer) or \
+                isinstance(gd, jax.core.Tracer):
+            return _fallback("tracer")
+        st = opt._state_for(p)
+        for v in st.values():
+            if not (hasattr(v, "shape") and hasattr(v, "dtype")):
+                return _fallback("state")
+        if not isinstance(pd, jax.Array):
+            pd = jnp.asarray(pd)
+        if not isinstance(gd, jax.Array):
+            gd = jnp.asarray(gd)
+        st = {k: (v if isinstance(v, jax.Array) else jnp.asarray(v))
+              for k, v in st.items()}
+        # a leaf another live Tensor handle shares (p.detach()) must not
+        # be donated — XLA would delete it under the alias; copy it so
+        # the snapshot stays readable (eager replace-don't-mutate parity)
+        if _has_alias(pd):
+            pd = jnp.copy(pd)
+        if mode == "scaled" and _has_alias(gd):
+            gd = jnp.copy(gd)
+        st = {k: (jnp.copy(v) if _has_alias(v) else v)
+              for k, v in st.items()}
+        # donated leaves must be distinct buffers: donating one buffer
+        # twice (tied weights sharing storage, a state aliasing its
+        # param) is an XLA error — fall back rather than risk it
+        for leaf in [pd, *st.values()] + ([gd] if mode == "scaled"
+                                          else []):
+            if id(leaf) in donated_ids:
+                return _fallback("aliased")
+            donated_ids.add(id(leaf))
+            nbytes += int(getattr(leaf, "nbytes", 0))
+        p_leaves.append(pd)
+        g_leaves.append(gd)
+        s_leaves.append(st)
+        tree.append((tuple(pd.shape), str(pd.dtype),
+                     tuple(gd.shape), str(gd.dtype), stat,
+                     tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                                  for k, v in st.items()))))
+
+    prep = _Prep()
+    prep.params = params
+    prep.p_leaves = p_leaves
+    prep.g_leaves = g_leaves
+    prep.s_leaves = s_leaves
+    prep.cspec = cspec
+    prep.nbytes = nbytes
+    prep.key = (type(opt).__qualname__, mode, hyper, cspec, rspec,
+                tuple(tree))
+    return prep
+
+
+def _lr_device(opt):
+    """Per-step lr as a committed 0-d f32 device array, uploaded only
+    when the host value actually changed (TrainStep's lr cache)."""
+    lr_now = float(opt.get_lr())
+    if getattr(opt, "_fused_lr_host", None) != lr_now:
+        opt._fused_lr_dev = jnp.float32(lr_now)
+        opt._fused_lr_host = lr_now
+    return opt._fused_lr_dev
+
+
+def _flush_pending_chains():
+    """A pending lazy-fusion chain may hold a buffer we are about to
+    DONATE (e.g. ``wn = (p * p).sum()`` deferred past the step) —
+    flush every pending chain before XLA invalidates its inputs."""
+    from ..core import fusion
+    if fusion.has_pending():
+        fusion.flush_pending("donation")
+
+
+def _execute(opt, prep, mode, scalars):
+    n = len(prep.params)
+    kind, fn, ctx = _get_program(
+        prep.key,
+        lambda ctx: _make_fn(ctx, mode, prep.cspec, n),
+        donate=(0, 1, 2) if mode == "scaled" else (0, 2))
+    if kind == "jit":
+        _flush_pending_chains()
+    # populate the trace cell only for the duration of the call: a
+    # (re)trace can only happen inside it, and the cache pins nothing
+    # of this model/optimizer afterwards
+    ctx.opt, ctx.params = opt, prep.params
+    t0 = _time.perf_counter()
+    try:
+        outs = fn(prep.p_leaves, prep.g_leaves, prep.s_leaves, scalars)
+    finally:
+        ctx.opt = ctx.params = None
+    if _M_flag.value:
+        _M_steps._v += 1
+    _M_step_s.observe(_time.perf_counter() - t0)
+    if kind == "jit":
+        _M_donated.inc(prep.nbytes)
+    new_ps, new_ss = outs[0], outs[1]
+    for p, new_p, new_s in zip(prep.params, new_ps, new_ss):
+        p._data = new_p
+        opt._states[id(p)] = new_s
+    if mode == "scaled":
+        for p, ng in zip(prep.params, outs[2]):
+            if isinstance(p.grad, Tensor):
+                p.grad._data = ng
+            else:
+                p.grad = Tensor(ng)
+        return outs[3]
+    if mode == "found":
+        return outs[2]
+    return None
+
+
+def try_step(opt, params_grads, found_inf=None) -> bool:
+    """Run the whole optimizer step as ONE fused executable. Returns
+    False when the caller should run the per-param eager loop instead
+    (kill switch, unsupported config). ``found_inf`` (a 0-d device bool
+    from GradScaler.unscale_) masks every update on device."""
+    if not _flag.value:
+        return False
+    mode = "plain" if found_inf is None else "found"
+    prep = _prepare(opt, params_grads, mode)
+    if prep is None:
+        return False
+    lr = _lr_device(opt)
+    if mode == "found":
+        scalars = (lr, jnp.asarray(found_inf, bool))
+    else:
+        scalars = (lr,)
+    _execute(opt, prep, mode, scalars)
+    return True
+
+
+def try_step_scaled(opt, scale, prior_found=False):
+    """GradScaler.step fast path: grad unscale, global finite check,
+    clip, every param update AND the conditional skip as ONE donated
+    executable. ``prior_found`` (the scaler's OR-accumulated flag from
+    earlier unscale_ calls this iteration) joins the on-device mask so
+    multi-optimizer skip decisions match the unfused fallback. Returns
+    the 0-d device found_inf of THIS check, or None when the caller
+    must fall back (then: batched unscale_ + masked step)."""
+    if not _flag.value:
+        return None
+    params_grads = [(p, p.grad) for p in opt._parameter_list
+                    if not p.stop_gradient and p.grad is not None]
+    if not params_grads:
+        return None
+    if any(p.stop_gradient and p.grad is not None
+           for p in opt._parameter_list):
+        # the fallback unscales + finite-checks EVERY grad, including
+        # frozen params'; this program only sees trainable ones — defer
+        # so the skip decision and post-step p.grad values can't depend
+        # on the flag
+        return _fallback("frozen_param_grads")
+    prep = _prepare(opt, params_grads, "scaled")
+    if prep is None:
+        return None
+    inv = jnp.float32(1.0) / scale
+    found = _execute(opt, prep, "scaled",
+                     (_lr_device(opt), inv,
+                      jnp.asarray(prior_found, bool)))
+    opt._global_step += 1
+    return found
+
+
+# -- batched unscale + finite check (the unfused-path device decision) ----
+
+_unscale_jit = None
+_unscale_jit_donated = None
+
+
+def _unscale_fn(gs, inv):
+    """Unscale in fp32 then restore the grad dtype — one pass; the
+    check runs AFTER the unscale like the reference's
+    check_finite_and_unscale (inf/nan survive the multiply). The ONE
+    numeric definition shared by the fused scaled step (_make_fn) and
+    the batched fallback (unscale_and_check)."""
+    outs = [(g.astype(jnp.float32) * inv).astype(g.dtype)
+            for g in gs]
+    finite = jnp.stack(
+        [jnp.all(jnp.isfinite(g)) for g in outs]).all()
+    return outs, jnp.logical_not(finite)
+
+
+def unscale_and_check(grads, inv_scale):
+    """ONE executable over every grad: unscale (fp32 math, dtype
+    restored) + global finite check. Returns (new_grads, found_inf 0-d
+    device bool) — the skip decision never syncs to host. The caller
+    rebinds every grad to the outputs, so the input buffers are
+    donated (in-place unscale, no transient second grad copy) unless
+    two entries alias one buffer. jax.jit's own cache keys the
+    grad-tree structure, so steady-state loops reuse one program per
+    tree."""
+    global _unscale_jit, _unscale_jit_donated
+    gs = list(grads)
+    if len({id(g) for g in gs}) == len(gs):
+        _flush_pending_chains()
+        # a grad buffer shared by a live detached handle must survive
+        # the donation — copy it, donate the copy
+        gs = [jnp.copy(g) if _has_alias(g) else g for g in gs]
+        if _unscale_jit_donated is None:
+            _unscale_jit_donated = jax.jit(_unscale_fn, donate_argnums=0)
+        return _unscale_jit_donated(gs, inv_scale)
+    if _unscale_jit is None:
+        _unscale_jit = jax.jit(_unscale_fn)
+    return _unscale_jit(gs, inv_scale)

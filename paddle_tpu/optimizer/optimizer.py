@@ -93,17 +93,59 @@ class Optimizer:
         self._global_step += 1
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if not p.stop_gradient and p.grad is not None]
+        if not params_grads:
+            return
+        from . import fused_step
+        if fused_step.try_step(self, params_grads):
+            return
+        self._eager_step(params_grads)
+
+    @no_grad()
+    def _step_masked(self, found_inf, try_fused=True):
+        """AMP path (GradScaler.step): identical to ``step()`` except
+        every param/state write is masked by the 0-d device bool
+        ``found_inf`` — a non-finite grad keeps the old values without
+        the skip decision ever syncing to host. ``try_fused=False`` when
+        the caller already ran (and failed) the fused gate this step,
+        so the O(n-params) prepare scan and its fallback counter don't
+        run twice."""
+        self._global_step += 1
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if not params_grads:
+            return
+        if try_fused:
+            from . import fused_step
+            if fused_step.try_step(self, params_grads,
+                                   found_inf=found_inf):
+                return
+        self._eager_step(params_grads, found_inf=found_inf)
+
+    def _eager_step(self, params_grads, found_inf=None):
+        """The per-param update loop: the FLAGS_fused_optimizer=0 kill
+        switch and the fallback for configs the fused plane can't prove
+        safe (unknown clip/regularizer objects, non-static hyperparams,
+        aliased buffers, tracers)."""
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
+        has_pid = hasattr(self, "_current_pid")
         for p, g in params_grads:
             gd = g._data if isinstance(g, Tensor) else g
             gd = self._apply_regularizer(p._data, gd)
             state = self._state_for(p)
             self._cur_param = p  # lets _update consult Parameter metadata
+            if has_pid:
+                self._current_pid = id(p)
             new_p, new_state = self._update(p._data, gd, state, lr)
+            if found_inf is not None:
+                new_p = jnp.where(found_inf, p._data, new_p)
+                new_state = {k: jnp.where(found_inf, state[k], v)
+                             for k, v in new_state.items()}
             p._data = new_p
             self._states[id(p)] = new_state
+        if has_pid:
+            self._current_pid = None
 
     def clear_grad(self, set_to_zero=False):
         for p in self._parameter_list:
@@ -136,9 +178,13 @@ class Optimizer:
             s = self._states.get(id(p))
             if s:
                 for k, v in s.items():
-                    out[f"param_{i}_{k}"] = (Tensor(v)
-                                             if not isinstance(v, Tensor)
-                                             else v)
+                    # snapshot-copy: the live leaf will be DONATED by
+                    # the next fused step (deleted), and the old eager
+                    # loop's replace-don't-mutate gave the exported dict
+                    # exactly these point-in-time values
+                    if isinstance(v, Tensor):
+                        v = v._data
+                    out[f"param_{i}_{k}"] = Tensor(jnp.copy(v))
         return out
 
     def set_state_dict(self, state_dict):
@@ -153,7 +199,9 @@ class Optimizer:
                 if isinstance(k, str) and k.startswith(prefix):
                     val = v._data if isinstance(v, Tensor) else jnp.asarray(
                         np.asarray(v))
-                    s[k[len(prefix):]] = val
+                    # copy on install: the leaf will be donated by the
+                    # next fused step; the caller's dict must survive
+                    s[k[len(prefix):]] = jnp.copy(val)
             if s:
                 self._states[id(p)] = s
 
@@ -293,24 +341,6 @@ class AdamW(Adam):
             if not self._apply_decay_param_fun(name):
                 return 0.0
         return self._weight_decay
-
-    @no_grad()
-    def step(self):
-        self._global_step += 1
-        params_grads = [(p, p.grad) for p in self._parameter_list
-                        if not p.stop_gradient and p.grad is not None]
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
-        lr = self.get_lr()
-        for p, g in params_grads:
-            self._current_pid = id(p)
-            gd = g._data if isinstance(g, Tensor) else g
-            gd = self._apply_regularizer(p._data, gd)
-            state = self._state_for(p)
-            new_p, new_state = self._update(p._data, gd, state, lr)
-            p._data = new_p
-            self._states[id(p)] = new_state
-        self._current_pid = None
 
 
 class Adamax(Optimizer):

@@ -16,20 +16,83 @@ class ClipGradBase:
         raise NotImplementedError
 
 
+# -- pure functional core ---------------------------------------------------
+# ONE numeric definition of each clip strategy over raw jnp grads, shared
+# by the eager classes below, the compiled train steps (jit.api TrainStep)
+# and the fused optimizer step (optimizer.fused_step): a clip is described
+# by a static, hashable *spec* so it can ride a program cache key.
+
+def clip_spec(clip, exact=True):
+    """Static description of a known clip strategy: ``()`` for None,
+    a hashable tuple for the three in-tree strategies, ``None`` for an
+    unrecognized clip object (callers fall back to calling it).
+
+    ``exact=True`` (the fused optimizer's gate) matches only the exact
+    in-tree classes — a subclass may override ``__call__`` and must go
+    through it. ``exact=False`` (the classes' own ``__call__`` plumbing
+    and TrainStep's in-trace clip) matches subclasses too, preserving
+    the inherited behavior."""
+    if clip is None:
+        return ()
+    match = ((lambda c: type(clip) is c) if exact
+             else (lambda c: isinstance(clip, c)))
+    if match(ClipGradByGlobalNorm):
+        return ("global_norm", float(clip.clip_norm))
+    if match(ClipGradByNorm):
+        return ("norm", float(clip.clip_norm))
+    if match(ClipGradByValue):
+        return ("value", float(clip.min), float(clip.max))
+    return None
+
+
+def global_norm_scale(grads, clip_norm):
+    """Pure: the ClipGradByGlobalNorm scale factor over raw jnp grads."""
+    sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads]
+    global_norm = jnp.sqrt(sum(sq))
+    return clip_norm / jnp.maximum(global_norm, clip_norm)
+
+
+def clip_by_spec(spec, grads):
+    """Apply a ``clip_spec`` to a list of raw jnp grads (pure, jittable)."""
+    if not spec or not grads:
+        return grads
+    kind = spec[0]
+    if kind == "value":
+        _, lo, hi = spec
+        return [jnp.clip(g, lo, hi) for g in grads]
+    if kind == "norm":
+        _, cn = spec
+        out = []
+        for g in grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            s = jnp.minimum(cn / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g * s).astype(g.dtype))
+        return out
+    _, cn = spec  # global_norm
+    s = global_norm_scale(grads, cn)
+    return [(g * s).astype(g.dtype) for g in grads]
+
+
+def _apply_class_clip(clip, params_grads):
+    """Eager class -> pure core plumbing, preserving None-grad slots."""
+    spec = clip_spec(clip, exact=False)
+    idx = [i for i, (_, g) in enumerate(params_grads) if g is not None]
+    grads = [params_grads[i][1] for i in idx]
+    raw = [g._data if isinstance(g, Tensor) else g for g in grads]
+    clipped = clip_by_spec(spec, raw)
+    out = list(params_grads)
+    for i, c in zip(idx, clipped):
+        out[i] = (params_grads[i][0], Tensor(c))
+    return out
+
+
 class ClipGradByValue(ClipGradBase):
     def __init__(self, max, min=None):
         self.max = max
         self.min = -max if min is None else min
 
     def __call__(self, params_grads):
-        out = []
-        for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-                continue
-            gd = g._data if isinstance(g, Tensor) else g
-            out.append((p, Tensor(jnp.clip(gd, self.min, self.max))))
-        return out
+        return _apply_class_clip(self, params_grads)
 
 
 class ClipGradByNorm(ClipGradBase):
@@ -37,17 +100,7 @@ class ClipGradByNorm(ClipGradBase):
         self.clip_norm = clip_norm
 
     def __call__(self, params_grads):
-        out = []
-        for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-                continue
-            gd = g._data if isinstance(g, Tensor) else g
-            norm = jnp.sqrt(jnp.sum(jnp.square(gd.astype(jnp.float32))))
-            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
-                                1.0)
-            out.append((p, Tensor((gd * scale).astype(gd.dtype))))
-        return out
+        return _apply_class_clip(self, params_grads)
 
 
 class ClipGradByGlobalNorm(ClipGradBase):
@@ -56,24 +109,9 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = clip_norm
 
     def __call__(self, params_grads):
-        sq = []
-        for _, g in params_grads:
-            if g is None:
-                continue
-            gd = g._data if isinstance(g, Tensor) else g
-            sq.append(jnp.sum(jnp.square(gd.astype(jnp.float32))))
-        if not sq:
+        if all(g is None for _, g in params_grads):
             return params_grads
-        global_norm = jnp.sqrt(sum(sq))
-        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        out = []
-        for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-                continue
-            gd = g._data if isinstance(g, Tensor) else g
-            out.append((p, Tensor((gd * scale).astype(gd.dtype))))
-        return out
+        return _apply_class_clip(self, params_grads)
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,

@@ -102,3 +102,244 @@ class TestGradScaler:
             scaler.update()
             opt.clear_grad()
         assert scaler.get_loss_scaling() > 8.0
+
+    def test_static_scaling_recovers_after_inf(self):
+        """use_dynamic_loss_scaling=False: one non-finite step must not
+        latch the found flag — the next finite step updates again."""
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       use_dynamic_loss_scaling=False)
+        p = paddle.Parameter(np.ones(2, np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        p.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(p.numpy(), 1.0)  # skipped
+        p.grad = paddle.to_tensor(np.array([8.0, 8.0], np.float32))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), 1.0 - 0.1, rtol=1e-6)
+        assert float(scaler.get_loss_scaling()) == 8.0  # static scale
+
+    def test_scale_preserves_loss_dtype(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss16 = paddle.to_tensor(np.float16(2.0))
+        assert str(scaler.scale(loss16).dtype) == "float16"
+        lossbf = paddle.to_tensor(np.ones((2,), np.float32)).astype(
+            "bfloat16").sum()
+        assert str(scaler.scale(lossbf).dtype) == "bfloat16"
+
+    @pytest.mark.parametrize("fused", [1, 0], ids=["fused", "eager"])
+    def test_step_and_update_never_sync_to_host(self, fused):
+        """The finite check's skip decision stays on device: zero
+        device->host transfers inside scaler.step()+update(), on the
+        fused path AND the FLAGS_fused_optimizer=0 fallback (regression:
+        step() used to call bool(all(isfinite(g))) per step)."""
+        import jax.numpy as jnp
+        prev = paddle.get_flags("FLAGS_fused_optimizer")
+        paddle.set_flags({"FLAGS_fused_optimizer": fused})
+        cls = type(jnp.zeros(()))
+        transfers = [0]
+        hooked = {}
+
+        def hook(name):
+            orig = getattr(cls, name)
+            hooked[name] = orig
+
+            def counted(self, *a, **kw):
+                transfers[0] += 1
+                return orig(self, *a, **kw)
+            return counted
+
+        try:
+            p = paddle.Parameter(np.ones(8, np.float32))
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+            scaler = paddle.amp.GradScaler(init_loss_scaling=64.0)
+            for _ in range(3):
+                scaler.scale((p * p).sum()).backward()
+                for name in ("__bool__", "__float__", "__index__",
+                             "__array__"):
+                    setattr(cls, name, hook(name))
+                try:
+                    scaler.step(opt)
+                    scaler.update()
+                finally:
+                    for name, orig in hooked.items():
+                        setattr(cls, name, orig)
+                opt.clear_grad()
+            assert transfers[0] == 0
+        finally:
+            paddle.set_flags(prev)
+
+    @pytest.mark.parametrize("fused", [1, 0], ids=["fused", "eager"])
+    def test_multi_optimizer_shared_scaler_skip_agrees(self, fused):
+        """One scaler, two optimizers: optA's inf must also skip optB —
+        the fallback masks by the OR-accumulated flag, and the fused
+        fast path must reach the same decision (regression: it used to
+        mask only by its own finite check)."""
+        prev = paddle.get_flags("FLAGS_fused_optimizer")
+        paddle.set_flags({"FLAGS_fused_optimizer": fused})
+        try:
+            scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+            pa = paddle.Parameter(np.ones(2, np.float32))
+            pb = paddle.Parameter(np.ones(2, np.float32))
+            opt_a = paddle.optimizer.SGD(learning_rate=0.1, parameters=[pa])
+            opt_b = paddle.optimizer.SGD(learning_rate=0.1, parameters=[pb])
+            pa.grad = paddle.to_tensor(np.array([np.inf, 4.0], np.float32))
+            pb.grad = paddle.to_tensor(np.array([4.0, 4.0], np.float32))
+            scaler.step(opt_a)
+            scaler.step(opt_b)
+            scaler.update()
+            np.testing.assert_array_equal(pa.numpy(), 1.0)  # skipped
+            np.testing.assert_array_equal(pb.numpy(), 1.0)  # also skipped
+        finally:
+            paddle.set_flags(prev)
+
+    @pytest.mark.parametrize("fused", [1, 0], ids=["fused", "eager"])
+    def test_frozen_param_grad_joins_finite_check(self, fused):
+        """A stop_gradient param still holding a grad: its inf must
+        trigger the skip and its grad must come back unscaled on BOTH
+        flag settings (regression: the fused path neither checked nor
+        unscaled frozen params' grads)."""
+        prev = paddle.get_flags("FLAGS_fused_optimizer")
+        paddle.set_flags({"FLAGS_fused_optimizer": fused})
+        try:
+            scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+            p = paddle.Parameter(np.ones(2, np.float32))
+            frozen = paddle.Parameter(np.ones(2, np.float32))
+            frozen.stop_gradient = True
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=[p, frozen])
+            p.grad = paddle.to_tensor(np.array([4.0, 4.0], np.float32))
+            frozen.grad = paddle.to_tensor(
+                np.array([np.inf, 4.0], np.float32))
+            scaler.step(opt)
+            scaler.update()
+            np.testing.assert_array_equal(p.numpy(), 1.0)  # skipped
+            np.testing.assert_array_equal(
+                frozen.grad.numpy(), np.array([np.inf, 1.0], np.float32))
+        finally:
+            paddle.set_flags(prev)
+
+    @pytest.mark.parametrize("fused", [1, 0], ids=["fused", "eager"])
+    def test_recovers_without_update_call(self, fused):
+        """A loop that never calls update() (static scaling makes it
+        look optional) must still recover after one bad batch — the
+        next iteration's scale() clears the OR-accumulated flag
+        (regression: the accumulator latched True forever)."""
+        prev = paddle.get_flags("FLAGS_fused_optimizer")
+        paddle.set_flags({"FLAGS_fused_optimizer": fused})
+        try:
+            scaler = paddle.amp.GradScaler(
+                init_loss_scaling=4.0, use_dynamic_loss_scaling=False)
+            p = paddle.Parameter(np.ones(2, np.float32))
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+            scaler.scale((p * p).sum()).backward()
+            p.grad = paddle.to_tensor(np.array([np.inf, 4.0], np.float32))
+            scaler.step(opt)          # skipped; no update() follows
+            opt.clear_grad()
+            np.testing.assert_array_equal(p.numpy(), 1.0)
+            scaler.scale((p * p).sum()).backward()  # finite batch
+            scaler.step(opt)
+            opt.clear_grad()
+            # grad of sum(p*p) is 2p -> p = 1 - 0.1*2
+            np.testing.assert_allclose(p.numpy(), 0.8, rtol=1e-6)
+        finally:
+            paddle.set_flags(prev)
+
+    @pytest.mark.parametrize("fused", [1, 0], ids=["fused", "eager"])
+    def test_unscale_without_step_does_not_latch(self, fused):
+        """An iteration that calls unscale_ (grad inspection) but skips
+        step() must not leak its unscale mark past update(): a stale id
+        would early-return the next iteration's unscale_ and step()
+        would apply still-scaled grads (regression: p went to -11.8
+        instead of 0.8 at scale=64)."""
+        prev = paddle.get_flags("FLAGS_fused_optimizer")
+        paddle.set_flags({"FLAGS_fused_optimizer": fused})
+        try:
+            scaler = paddle.amp.GradScaler(init_loss_scaling=64.0)
+            p = paddle.Parameter(np.ones(2, np.float32))
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+            # iter 1: unscale to inspect grads, then skip the step
+            scaler.scale((p * p).sum()).backward()
+            scaler.unscale_(opt)
+            opt.clear_grad()
+            scaler.update()
+            # iter 2: normal step — grads must be unscaled exactly once
+            scaler.scale((p * p).sum()).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            # grad of sum(p*p) is 2p -> p = 1 - 0.1*2
+            np.testing.assert_allclose(p.numpy(), 0.8, rtol=1e-6)
+        finally:
+            paddle.set_flags(prev)
+
+    def test_custom_step_override_runs_under_scaler(self):
+        """An optimizer subclass implementing step() directly (no
+        _update hook — the LBFGS pattern) must have its override run
+        under scaler.step(), and still skip on inf (regression: the
+        device-masked fallback bypassed step() and hit
+        _update's NotImplementedError)."""
+        calls = []
+
+        class StepOnly(paddle.optimizer.Optimizer):
+            def step(self):
+                calls.append(1)
+                for p in self._parameter_list:
+                    if p.grad is not None:
+                        p.set_value(p.numpy() - 0.1 * p.grad.numpy())
+
+        p = paddle.Parameter(np.ones(2, np.float32))
+        opt = StepOnly(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        scaler.scale((p * p).sum()).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        assert calls == [1]
+        np.testing.assert_allclose(p.numpy(), 0.8, rtol=1e-6)
+        p.grad = paddle.to_tensor(np.array([np.inf, 4.0], np.float32))
+        before = p.numpy().copy()
+        scaler.step(opt)
+        scaler.update()
+        assert calls == [1]  # override NOT called on a non-finite step
+        np.testing.assert_array_equal(p.numpy(), before)
+
+    def test_shard_optimizer_wrapper_steps_under_scaler(self):
+        """scaler.step(shard_optimizer(...)): the wrapper is not an
+        Optimizer subclass and has NO class-level step — it delegates
+        through instance __getattr__. Override detection must treat it
+        like a custom step, not crash on a missing class attr
+        (regression: AttributeError on type(_ShardOptimizer).step)."""
+        from paddle_tpu.distributed.auto_parallel.api_ext import (
+            shard_optimizer)
+        p = paddle.Parameter(np.ones(2, np.float32))
+        opt = shard_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1, parameters=[p]))
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        scaler.scale((p * p).sum()).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        np.testing.assert_allclose(p.numpy(), 0.8, rtol=1e-6)
+        p.grad = paddle.to_tensor(np.array([np.inf, 4.0], np.float32))
+        before = p.numpy().copy()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(p.numpy(), before)  # skipped
+
+    def test_patched_unscale_still_runs(self):
+        """An instance-patched unscale_ (distributed shard_scaler wraps
+        it to allreduce found_inf) must run inside step() — the fused
+        fast path would silently bypass it."""
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        p = paddle.Parameter(np.ones(4, np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        calls = []
+        orig = scaler.unscale_
+        scaler.unscale_ = lambda o: (calls.append(id(o)), orig(o))[1]
+        scaler.scale((p * p).sum()).backward()
+        scaler.step(opt)
+        scaler.update()
+        assert calls == [id(opt)]
+        np.testing.assert_allclose(p.numpy(), 1.0 - 0.1 * 2.0, rtol=1e-5)

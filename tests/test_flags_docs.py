@@ -1,0 +1,40 @@
+"""Every runtime flag must be documented in README.md.
+
+PRs 1/3/4/5 each added FLAGS_* switches; the README's flags reference
+is the only place a user can discover them, and it drifts silently.
+This test pins the two together: a flag registered anywhere (core
+definitions in core/flags.py plus late definitions like
+framework/checkpoint.py's checkpoint_fsync) must appear as
+``FLAGS_<name>`` somewhere in README.md.
+"""
+import os
+import re
+
+import paddle_tpu  # noqa: F401 — loads every module that defines flags
+from paddle_tpu.core.flags import _registry
+
+README = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "README.md")
+
+
+def test_every_flag_documented_in_readme():
+    with open(README, encoding="utf-8") as f:
+        readme = f.read()
+    documented = set(re.findall(r"FLAGS_([a-z0-9_]+)", readme))
+    missing = sorted(set(_registry) - documented)
+    assert not missing, (
+        f"flags missing from README.md: "
+        f"{', '.join('FLAGS_' + m for m in missing)} — document each "
+        f"flag (a row in the flags reference table is enough)")
+
+
+def test_no_stale_flags_in_readme():
+    """The reverse direction: README must not document flags that no
+    longer exist (renames leave dead docs behind)."""
+    with open(README, encoding="utf-8") as f:
+        readme = f.read()
+    documented = set(re.findall(r"FLAGS_([a-z0-9_]+)", readme))
+    stale = sorted(documented - set(_registry))
+    assert not stale, (
+        f"README.md documents unknown flags: "
+        f"{', '.join('FLAGS_' + s for s in stale)}")
