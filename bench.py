@@ -993,6 +993,27 @@ def bench_fused_optimizer_step():
         "backend": jax.default_backend()})
 
 
+def bench_analysis_selfcheck():
+    """analysis_selfcheck: the analysis plane's seeded-bug smoke
+    (python -m paddle_tpu.analysis --self-check in-process): one bug
+    per analyzer — a lint violation, a host-sync'd fused chain, a
+    lock-order inversion — each must be detected by its rule id before
+    anyone trusts a clean report. Bar: all three detectors fire."""
+    import time as _t
+    from paddle_tpu.analysis.report import self_check
+    t0 = _t.perf_counter()
+    out = self_check()
+    dt = (_t.perf_counter() - t0) * 1e3
+    _emit("analysis_selfcheck", 1.0 if out["ok"] else 0.0, "pass",
+          1.0 if out["ok"] else 0.0, {
+              "checks": {k: ("ok" if v else "FAIL")
+                         for k, v in out["checks"].items()},
+              "wall_ms": round(dt, 1),
+              "detail": out.get("detail", ""),
+              "bar": "lint + audit + locks detectors all fire on "
+                     "seeded bugs"})
+
+
 def bench_checkpoint_roundtrip():
     """checkpoint_roundtrip: durable (sync) vs async save wall time +
     verified restore time for a small model state_dict through
@@ -1115,6 +1136,7 @@ _SUITE = [
     ("eager_fusion_speedup", "bench_eager_fusion"),
     ("reduction_fusion_speedup", "bench_reduction_fusion"),
     ("fused_optimizer_step_us", "bench_fused_optimizer_step"),
+    ("analysis_selfcheck", "bench_analysis_selfcheck"),
     ("bench_llama", "bench_llama"),
     ("bench_llama7b_geometry", "bench_llama7b_geometry"),
     ("bench_resnet50", "bench_resnet50"),
@@ -1206,7 +1228,7 @@ def main(argv=None):
         _ensure_backend_or_cpu()
         for fn in (bench_dispatch_overhead, bench_metrics_overhead,
                    bench_eager_fusion, bench_reduction_fusion,
-                   bench_fused_optimizer_step):
+                   bench_fused_optimizer_step, bench_analysis_selfcheck):
             try:
                 fn()
             except Exception as e:  # noqa: BLE001

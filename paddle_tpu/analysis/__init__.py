@@ -1,0 +1,60 @@
+"""paddle_tpu.analysis — static analysis & program auditing.
+
+Three coordinated analyzers over one diagnostics currency:
+
+- :mod:`.auditor` — run a callable in recording mode; capture report of
+  flush boundaries (reason + origin), host syncs, donations,
+  use-after-donate and recompile churn (rules PTA00x).
+- :mod:`.lint` — AST source linter with repo-specific rules (PTL00x)
+  and a checked-in, justified allowlist.
+- :mod:`.locks` — instrumented-lock shim: acquisition-order recording,
+  lock-order-cycle and lock-across-device-work detection (PTK00x).
+
+One reporting surface: :func:`report` here, or
+``python -m paddle_tpu.analysis`` on the command line.
+
+This ``__init__`` is lazy by contract: subsystems import
+``paddle_tpu.analysis.locks.make_lock`` at module load, which executes
+this file — nothing heavier than stdlib may be imported here.
+"""
+from __future__ import annotations
+
+__all__ = ["audit", "lint", "report", "AnalysisReport", "RULES"]
+
+# `lint` and `report` (the callables) share names with their defining
+# submodules. Importing a submodule binds it as a package attribute,
+# which would permanently shadow a lazy __getattr__ — so e.g.
+# `import paddle_tpu.analysis.report` followed by `analysis.report(fn)`
+# would call the MODULE. Bind the callables eagerly, AFTER the
+# submodule imports below have set the module attributes: later cached
+# submodule imports never rebind parent attributes, so the callables
+# stay. Both modules are stdlib-only, keeping this __init__
+# import-light (lint's runtime imports live inside _check_ops_yaml).
+from .lint import lint            # noqa: E402,F401
+from .report import report        # noqa: E402,F401
+
+_LAZY = {
+    "audit": ("paddle_tpu.analysis.auditor", "audit"),
+    "Auditor": ("paddle_tpu.analysis.auditor", "Auditor"),
+    "CaptureReport": ("paddle_tpu.analysis.auditor", "CaptureReport"),
+    "RULES": ("paddle_tpu.analysis.diagnostics", "RULES"),
+    "Diagnostic": ("paddle_tpu.analysis.diagnostics", "Diagnostic"),
+    "AnalysisReport": ("paddle_tpu.analysis.report", "AnalysisReport"),
+    "self_check": ("paddle_tpu.analysis.report", "self_check"),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    mod = importlib.import_module(entry[0])
+    val = getattr(mod, entry[1])
+    globals()[name] = val
+    return val
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

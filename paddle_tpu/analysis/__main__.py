@@ -1,0 +1,37 @@
+"""``python -m paddle_tpu.analysis`` — the analysis plane's CLI.
+
+Default: lint the package and print the report (exit 1 on error-severity
+findings — the CI contract tests/test_lint_clean.py mirrors in-process).
+
+Options:
+  --self-check   seed one bug per analyzer, assert each rule fires
+                 (the bench --dispatch-only smoke); exit 1 on failure
+  --rules        print the rule table (ids, analyzers, severities)
+  --json         emit the report as JSON instead of text
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--rules" in argv:
+        from .report import rules_table
+        print(rules_table())
+        return 0
+    if "--self-check" in argv:
+        from .report import self_check
+        return 0 if self_check(verbose=True)["ok"] else 1
+    from .report import report
+    rep = report()
+    if "--json" in argv:
+        print(json.dumps(rep.to_dict(), indent=2, default=str))
+    else:
+        print(rep.render())
+    return 1 if rep.errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,394 @@
+"""Program auditor: run a callable in recording mode and produce a
+*capture report* — the planning input for whole-step program capture.
+
+The roadmap's Fusion III item needs to know, for one train (or decode)
+step, exactly where and why execution breaks out of capture. This
+module answers that by instrumenting the seams the runtime already
+exposes and replaying the step:
+
+- **Flush boundaries** — every fusion-chain flush with its reason
+  (host_read / op_boundary / backward / cap / ...) AND its origin call
+  site (``core.fusion._flush_observer``), aggregated into top-N flush
+  sites.
+- **Host syncs** — every device→host materialization
+  (``.numpy()``/``.item()``/``tolist``/``__array__``) with call-site
+  attribution (``core.tensor._sync_hook``) → **PTA001**.
+- **Donations** — every buffer-donating fused optimizer step
+  (``optimizer.fused_step._donation_observer``), plus a post-run sweep
+  for live Tensor handles whose buffer XLA has deleted
+  (use-after-donate) → **PTA002**.
+- **Recompile churn** — program-cache compiles inside the measured
+  window (``fusion._program_observer``, dispatch pair builds, whole-step
+  ``jit`` rebuilds) and unhashable-static call sites → **PTA003**.
+
+Protocol: ``audit(fn)`` runs ``fn`` ``warmup`` times (default 2 — the
+compile-on-second-sighting policy means a steady-state structure has
+compiled by then), then records ONE measured run. A steady-state step
+should show zero compiles in the measured window; every one that
+remains is churn.
+"""
+from __future__ import annotations
+
+import gc
+from typing import Any, Callable, Dict, List
+
+from .diagnostics import Diagnostic, sort_diagnostics
+from .locks import caller_site
+
+__all__ = ["Auditor", "CaptureReport", "audit"]
+
+_SKIP_SUFFIXES = ("analysis/auditor.py", "analysis/locks.py",
+                  "core/tensor.py", "core/fusion.py", "core/autograd.py")
+
+
+def _origin() -> str:
+    """``pkg/file.py:line`` of the nearest frame outside the recording
+    machinery (fusion keeps its own copy — core must not depend on the
+    analysis package)."""
+    return caller_site(_SKIP_SUFFIXES)
+
+
+def _sig_summary(sig) -> Dict[str, Any]:
+    """Human-readable summary of a fusion program signature: the op
+    chain and the leaf shapes (the part that churns under shape
+    polymorphism)."""
+    nodes, leaf_descs = sig[0], sig[1]
+    return {"ops": [n[0] for n in nodes],
+            "leaf_shapes": [list(d[1]) for d in leaf_descs]}
+
+
+def _is_deleted(buf) -> bool:
+    fn = getattr(buf, "is_deleted", None)
+    if fn is None:
+        return False
+    try:
+        return bool(fn())
+    except Exception:  # noqa: BLE001 — a dead runtime reads as deleted
+        return False
+
+
+class CaptureReport:
+    """Everything one measured run revealed. ``diagnostics`` carry the
+    judgement; the event lists carry the full attribution (the Fusion
+    III planning data)."""
+
+    def __init__(self):
+        self.flushes: List[Dict[str, Any]] = []
+        self.syncs: List[Dict[str, Any]] = []
+        self.donations: List[Dict[str, Any]] = []
+        self.fusion_compiles: List[Dict[str, Any]] = []
+        self.pair_builds: List[str] = []
+        self.step_builds: List[str] = []
+        self.unhashable_statics: Dict[str, int] = {}
+        self.use_after_donate: List[Dict[str, Any]] = []
+        self.diagnostics: List[Diagnostic] = []
+        self.warmup_runs = 0
+        self.result: Any = None
+
+    # -- aggregation -----------------------------------------------------
+    def flush_sites(self, top_n: int = 10) -> List[Dict[str, Any]]:
+        """Top-N (origin, reason) flush sites by count — replaces the
+        reason-only counters as the capture-planning input."""
+        agg: Dict[tuple, int] = {}
+        for ev in self.flushes:
+            key = (ev["origin"], ev["reason"])
+            agg[key] = agg.get(key, 0) + 1
+        rows = sorted(agg.items(), key=lambda kv: -kv[1])[:top_n]
+        return [{"site": k[0], "reason": k[1], "count": v}
+                for k, v in rows]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "flushes": self.flushes,
+            "flush_sites": self.flush_sites(),
+            "syncs": self.syncs,
+            "donations": self.donations,
+            "fusion_compiles": self.fusion_compiles,
+            "pair_builds": self.pair_builds,
+            "step_builds": self.step_builds,
+            "unhashable_statics": dict(self.unhashable_statics),
+            "use_after_donate": self.use_after_donate,
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+        }
+
+    def render(self) -> str:
+        lines = ["capture report",
+                 f"  flush boundaries: {len(self.flushes)}   host syncs: "
+                 f"{len(self.syncs)}   donations: {len(self.donations)}   "
+                 f"measured-window compile/first-run events: "
+                 f"{len(self.fusion_compiles) + len(self.pair_builds) + len(self.step_builds)}"]
+        if self.flushes:
+            lines.append("  top flush sites (site, reason, count):")
+            for row in self.flush_sites():
+                lines.append(f"    {row['site']:<46} {row['reason']:<18} "
+                             f"x{row['count']}")
+        if self.syncs:
+            lines.append("  host syncs:")
+            agg: Dict[tuple, int] = {}
+            for ev in self.syncs:
+                agg[(ev["origin"], ev["kind"])] = \
+                    agg.get((ev["origin"], ev["kind"]), 0) + 1
+            for (site, kind), n in sorted(agg.items(), key=lambda kv: -kv[1]):
+                lines.append(f"    {site:<46} {kind:<12} x{n}")
+        if self.donations:
+            total = sum(d["nbytes"] for d in self.donations)
+            lines.append(f"  donations: {len(self.donations)} fused steps, "
+                         f"{total} bytes donated in place")
+        if self.unhashable_statics:
+            lines.append("  unhashable-static call sites (run un-jitted "
+                         "every call — recompile-risk inventory):")
+            for fn_name, n in sorted(self.unhashable_statics.items(),
+                                     key=lambda kv: -kv[1]):
+                lines.append(f"    {fn_name:<46} x{n}")
+        if self.diagnostics:
+            lines.append("  diagnostics:")
+            for d in self.diagnostics:
+                lines.append(d.render())
+        else:
+            lines.append("  diagnostics: none")
+        return "\n".join(lines)
+
+
+class Auditor:
+    """Context manager that installs the recording hooks (chaining any
+    previously installed observer, e.g. a SOT tracer or an active lock
+    auditor) and collects events into a :class:`CaptureReport`."""
+
+    def __init__(self):
+        self.report = CaptureReport()
+        self._recording = False
+        self._saved: Dict[str, Any] = {}
+
+    # -- event handlers --------------------------------------------------
+    def _on_flush(self, reason, nops, pkind, origin):
+        if self._recording:
+            self.report.flushes.append(
+                {"reason": reason, "ops": nops, "kind": pkind,
+                 "origin": origin})
+
+    def _on_program(self, sig, event):
+        if self._recording and event in ("compile", "first"):
+            entry = _sig_summary(sig)
+            entry["event"] = event
+            self.report.fusion_compiles.append(entry)
+
+    def _on_sync(self, t, kind):
+        if not self._recording:
+            return
+        buf = t._buf
+        site = _origin()
+        self.report.syncs.append({
+            "kind": kind, "origin": site,
+            "shape": list(t.shape), "dtype": str(t.dtype)})
+        if buf is not None and _is_deleted(buf):
+            self.report.use_after_donate.append({
+                "origin": site, "kind": kind, "shape": list(t.shape),
+                "detail": "host read of a donated (deleted) buffer"})
+
+    def _on_dispatch(self, event, fn):
+        if not self._recording:
+            return
+        name = getattr(fn, "__name__", repr(fn))
+        if event == "pair_build":
+            self.report.pair_builds.append(name)
+        elif event == "unhashable_static":
+            self.report.unhashable_statics[name] = \
+                self.report.unhashable_statics.get(name, 0) + 1
+
+    def _on_donation(self, opt, prep, mode):
+        from . import locks as _locks
+        la = _locks.active_auditor()
+        if la is not None:
+            la.note_device_op("fused_optimizer_step")
+        if not self._recording:
+            return
+        labels = [p.name or f"param{i}"
+                  for i, p in enumerate(prep.params)]
+        self.report.donations.append({
+            "mode": mode, "nbytes": prep.nbytes,
+            "params": labels[:8] + (["..."] if len(labels) > 8 else []),
+            "count": len(labels),
+            # the observer fires inside the fused-step plane; skip past
+            # it (and Optimizer.step) to the user's call site
+            "origin": caller_site(_SKIP_SUFFIXES + (
+                "optimizer/fused_step.py", "optimizer/optimizer.py"))})
+
+    def _on_step_build(self, kind):
+        if self._recording:
+            self.report.step_builds.append(kind)
+
+    # -- hook install/remove ---------------------------------------------
+    def __enter__(self):
+        from ..core import fusion, tensor, autograd
+        from ..optimizer import fused_step
+        from ..jit import api as jit_api
+        self._mods = (fusion, tensor, autograd, fused_step, jit_api)
+        saved = self._saved
+        saved["flush"] = fusion._flush_observer
+        saved["program"] = fusion._program_observer
+        saved["sync"] = tensor._sync_hook
+        saved["dispatch"] = autograd._dispatch_observer
+        saved["donation"] = fused_step._donation_observer
+        saved["build"] = jit_api._build_observer
+
+        def chain(mine, prev):
+            if prev is None:
+                return mine
+
+            def both(*a, **kw):
+                mine(*a, **kw)
+                prev(*a, **kw)
+            return both
+
+        fusion._flush_observer = chain(self._on_flush, saved["flush"])
+        fusion._program_observer = chain(self._on_program,
+                                         saved["program"])
+        tensor._sync_hook = chain(self._on_sync, saved["sync"])
+        autograd._dispatch_observer = chain(self._on_dispatch,
+                                            saved["dispatch"])
+        fused_step._donation_observer = chain(self._on_donation,
+                                              saved["donation"])
+        jit_api._build_observer = chain(self._on_step_build,
+                                        saved["build"])
+        return self
+
+    def __exit__(self, *exc):
+        fusion, tensor, autograd, fused_step, jit_api = self._mods
+        fusion._flush_observer = self._saved["flush"]
+        fusion._program_observer = self._saved["program"]
+        tensor._sync_hook = self._saved["sync"]
+        autograd._dispatch_observer = self._saved["dispatch"]
+        fused_step._donation_observer = self._saved["donation"]
+        jit_api._build_observer = self._saved["build"]
+        return False
+
+    # -- analysis ---------------------------------------------------------
+    def scan_use_after_donate(self) -> None:
+        """Post-run sweep: any LIVE Tensor whose device buffer XLA has
+        deleted (a donated input nobody rebound) is a read-waiting-to-
+        crash. Generalizes the fused step's copy-on-donate alias
+        registry from prevention to detection."""
+        from ..core.tensor import Tensor
+        gc.collect()  # dead handles can't be read; scan the live ones
+        for obj in gc.get_objects():
+            if type(obj) is not Tensor and not isinstance(obj, Tensor):
+                continue
+            buf = getattr(obj, "_buf", None)
+            if buf is not None and _is_deleted(buf):
+                self.report.use_after_donate.append({
+                    "origin": f"tensor {obj.name or hex(id(obj))}",
+                    "kind": "live_handle", "shape": list(buf.shape),
+                    "detail": "live Tensor handle wraps a donated "
+                              "(deleted) buffer"})
+
+    def finalize(self) -> CaptureReport:
+        rep = self.report
+        self.scan_use_after_donate()
+        diags: List[Diagnostic] = []
+        # PTA001: one diagnostic per distinct sync site
+        sites: Dict[tuple, int] = {}
+        for ev in rep.syncs:
+            sites[(ev["origin"], ev["kind"])] = \
+                sites.get((ev["origin"], ev["kind"]), 0) + 1
+        for (site, kind), n in sorted(sites.items()):
+            diags.append(Diagnostic(
+                "PTA001", site,
+                f"device->host sync via .{kind} x{n} in the measured "
+                f"step",
+                hint="keep the value on device (device-resident "
+                     "counters / masked updates), or batch the fetch "
+                     "outside the step"))
+        # PTA002: reads of deleted buffers + live handles wrapping them
+        for ev in rep.use_after_donate:
+            diags.append(Diagnostic(
+                "PTA002", ev["origin"],
+                f"use-after-donate: {ev['detail']} "
+                f"(shape {ev.get('shape')})",
+                hint="copy the buffer before donating (the fused "
+                     "step's copy-on-donate), or drop the stale handle "
+                     "before the donating step runs"))
+        # PTA003: compiles inside the measured (steady-state) window
+        if rep.fusion_compiles:
+            by_ops: Dict[tuple, List[Dict[str, Any]]] = {}
+            for c in rep.fusion_compiles:
+                by_ops.setdefault(tuple(c["ops"]), []).append(c)
+            for ops, entries in sorted(by_ops.items()):
+                shapes = {tuple(map(tuple, e["leaf_shapes"]))
+                          for e in entries}
+                poly = (f" across {len(shapes)} distinct leaf-shape "
+                        f"sets (shape-polymorphic call site)"
+                        if len(shapes) > 1 else "")
+                # "first" = first sighting, runs UN-jitted (compile-on-
+                # second-sighting) — a cache miss, not a compile; say so
+                # or the reader hunts for a compile that never happened
+                ncomp = sum(1 for e in entries if e["event"] == "compile")
+                parts = []
+                if ncomp:
+                    parts.append(f"compiled {ncomp}x")
+                if len(entries) - ncomp:
+                    parts.append(f"first-sighted {len(entries) - ncomp}x "
+                                 f"(ran un-jitted)")
+                diags.append(Diagnostic(
+                    "PTA003", "fusion-dag: " + "->".join(ops),
+                    f"fusion program {' + '.join(parts)} in the "
+                    f"measured window{poly}",
+                    hint="steady state should hit the program cache; "
+                         "pad/bucket dynamic shapes or hoist the "
+                         "changing static out of the chain"))
+        if rep.pair_builds:
+            agg: Dict[str, int] = {}
+            for n in rep.pair_builds:
+                agg[n] = agg.get(n, 0) + 1
+            detail = ", ".join(f"{k} x{v}" for k, v in sorted(agg.items()))
+            diags.append(Diagnostic(
+                "PTA003", "dispatch.jit_pair_cache",
+                f"jit pair(s) compiled in the measured window: {detail}",
+                hint="a steady-state step builds no new pairs; check "
+                     "for per-call static values entering the key"))
+        if rep.step_builds:
+            diags.append(Diagnostic(
+                "PTA003", "jit.whole_step",
+                f"whole-step program rebuilt in the measured window: "
+                f"{', '.join(rep.step_builds)}",
+                hint="TrainStep/StaticFunction should build once; a "
+                     "rebuild per step recompiles the full graph"))
+        rep.diagnostics = sort_diagnostics(diags)
+
+        from ..observability import metrics as _om
+        _om.counter("analysis.audits_total",
+                    "Capture audits run by paddle_tpu.analysis").inc()
+        cd = _om.counter(
+            "analysis.diagnostics_total",
+            "Diagnostics emitted by the analysis plane, by rule")
+        for d in rep.diagnostics:
+            cd.inc(rule=d.rule)
+        return rep
+
+
+def audit(fn: Callable, *args, warmup: int = 2,
+          **kwargs) -> CaptureReport:
+    """Run ``fn(*args, **kwargs)`` in recording mode and return its
+    :class:`CaptureReport`.
+
+    ``warmup`` extra runs precede the measured one (default 2: the
+    fusion plane and the eager pair cache both compile on SECOND
+    sighting, so the measured window of a steady-state step is
+    compile-free; set 0 to audit cold-start behavior)."""
+    with Auditor() as a:
+        for _ in range(max(int(warmup), 0)):
+            fn(*args, **kwargs)
+            a.report.warmup_runs += 1
+        a._recording = True
+        try:
+            a.report.result = fn(*args, **kwargs)
+        except BaseException as e:
+            # a real use-after-donate CRASHES the measured run — the
+            # attribution recorded up to that point is exactly what the
+            # audit exists to provide, so finalize and ship it on the
+            # exception instead of discarding it
+            a._recording = False
+            e.capture_report = a.finalize()
+            raise
+        finally:
+            a._recording = False
+        return a.finalize()

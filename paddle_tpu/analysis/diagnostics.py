@@ -1,0 +1,148 @@
+"""Structured diagnostics: the one currency every analyzer trades in.
+
+The program auditor (``analysis/auditor.py``), the source linter
+(``analysis/lint.py``) and the lock-order checker (``analysis/locks.py``)
+all emit :class:`Diagnostic` records — rule id, severity, a location
+(``file.py:line`` for source rules, a DAG/lock description for runtime
+rules), a message and a fix hint — so one reporting surface
+(``analysis.report()`` / ``python -m paddle_tpu.analysis``) can render,
+count and gate on all three. Rule metadata lives in :data:`RULES` and is
+the source of the README rules table (test-pinned, like the flags
+reference).
+
+Severity contract: ``error`` = a defect that will corrupt results or
+deadlock (use-after-donate, lock cycle); ``warning`` = a hazard or perf
+cliff (host sync in a hot path, recompile churn, unguarded registry
+mutation); ``info`` = attribution the capture report enumerates without
+judgement (flush boundaries, donation sites).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Diagnostic", "RuleInfo", "RULES", "severity_rank"]
+
+_SEVERITIES = ("error", "warning", "info")
+
+
+def severity_rank(severity: str) -> int:
+    """error < warning < info (sortable: most severe first)."""
+    try:
+        return _SEVERITIES.index(severity)
+    except ValueError:
+        return len(_SEVERITIES)
+
+
+@dataclass(frozen=True)
+class RuleInfo:
+    id: str
+    analyzer: str       # "audit" | "lint" | "locks"
+    severity: str       # default severity of findings
+    title: str
+    description: str
+
+
+# The closed rule universe. PTA* = program auditor (runtime capture),
+# PTL* = source linter (AST), PTK* = lock-order checker (instrumented
+# locks). tests/test_analysis.py seeds one bug per detection rule and
+# asserts the exact id; README's rules table is generated from this.
+RULES: Dict[str, RuleInfo] = {r.id: r for r in [
+    RuleInfo(
+        "PTA001", "audit", "warning", "implicit host sync",
+        "A device→host materialization (.numpy()/.item()/float()/"
+        "__array__) inside the audited region — each one stalls dispatch "
+        "and, when it lands mid-chain, flushes the fusion DAG "
+        "(flush reason host_read). The capture report attributes every "
+        "sync to its call site."),
+    RuleInfo(
+        "PTA002", "audit", "error", "use-after-donate",
+        "A live Tensor handle still references a buffer that was donated "
+        "to a jitted executable (XLA deleted it): the next read raises "
+        "or returns garbage. Generalizes the fused optimizer's "
+        "copy-on-donate alias registry into a detector."),
+    RuleInfo(
+        "PTA003", "audit", "warning", "recompile churn",
+        "A program cache kept compiling during the measured (post-"
+        "warmup) run: shape-polymorphic call sites, unhashable statics "
+        "or churning cache keys. Steady-state steps should be compile-"
+        "free; every compile here is dispatch-path latency."),
+    RuleInfo(
+        "PTL001", "lint", "warning", "implicit host sync in library code",
+        "A .numpy()/.item()/.tolist() call inside paddle_tpu/ library "
+        "code: a hidden device→host sync on what may be a hot path. "
+        "Deliberate syncs (structural args that must be host-static for "
+        "XLA, user-facing host APIs) belong in the allowlist with a "
+        "justification."),
+    RuleInfo(
+        "PTL002", "lint", "warning", "registered flag never read",
+        "A FLAGS_* registered in core/flags.py (or a late define_flag) "
+        "with no read anywhere in the package: either dead surface or a "
+        "flag that silently does nothing the docs claim it does."),
+    RuleInfo(
+        "PTL003", "lint", "warning", "unguarded global registry mutation",
+        "A structural mutation (del/pop/clear/eviction loop) of a "
+        "module-level registry outside any lock: concurrent dispatch "
+        "threads can corrupt iteration or drop entries mid-sweep. "
+        "Single-assignment memo inserts are GIL-atomic and not flagged."),
+    RuleInfo(
+        "PTL004", "lint", "error", "bare except",
+        "A bare `except:` swallows KeyboardInterrupt/SystemExit AND the "
+        "fault-injection harness's BaseException kill-points — device "
+        "code wrapped in one can absorb the very crash a test injects."),
+    RuleInfo(
+        "PTL005", "lint", "error", "ops.yaml fusable marker inconsistent",
+        "An op marked `fusable:` in ops.yaml with no matching "
+        "register_impl/register_param_impl registration (or a "
+        "registration for an op ops.yaml doesn't mark): the fusion "
+        "plane would silently never fuse it."),
+    RuleInfo(
+        "PTK001", "locks", "error", "lock-order cycle",
+        "Two (or more) instrumented locks acquired in opposite nesting "
+        "orders on different code paths: the classic AB/BA deadlock. "
+        "Reported with both acquisition stacks."),
+    RuleInfo(
+        "PTK002", "locks", "warning", "lock held across device work",
+        "An instrumented lock held while device work ran under it (a "
+        "fusion flush / jitted executable), or held longer than the "
+        "long-hold threshold: every other thread needing that lock "
+        "stalls behind device latency."),
+]}
+
+
+@dataclass
+class Diagnostic:
+    """One finding. ``location`` is ``path:line`` for source rules, a
+    runtime description (``fusion-dag: mean((x*y))``, ``lock:
+    serving.submit``) otherwise."""
+
+    rule: str
+    location: str
+    message: str
+    severity: Optional[str] = None   # default: the rule's severity
+    hint: str = ""
+    data: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.severity is None:
+            info = RULES.get(self.rule)
+            self.severity = info.severity if info else "warning"
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"rule": self.rule, "severity": self.severity,
+             "location": self.location, "message": self.message}
+        if self.hint:
+            d["hint"] = self.hint
+        if self.data:
+            d["data"] = self.data
+        return d
+
+    def render(self) -> str:
+        hint = f"\n      hint: {self.hint}" if self.hint else ""
+        return (f"  [{self.rule}/{self.severity}] {self.location}: "
+                f"{self.message}{hint}")
+
+
+def sort_diagnostics(diags: List[Diagnostic]) -> List[Diagnostic]:
+    return sorted(diags, key=lambda d: (severity_rank(d.severity),
+                                        d.rule, d.location))

@@ -1,0 +1,441 @@
+"""Instrumented-lock shim: acquisition-order recording + cycle detection.
+
+The threaded subsystems (async checkpoint persist, serving drain,
+elastic heartbeat/watch, the metrics registry) each grew their own
+locks; nothing ever checked that they nest consistently. This module
+provides:
+
+- :class:`InstrumentedLock` — a drop-in ``threading.Lock``/``RLock``
+  wrapper that records, per thread, the stack of currently-held locks,
+  every nesting edge (lock B acquired while A is held), hold durations,
+  and device work executed under a lock.
+- :class:`LockAuditor` — owns the recording and turns it into
+  diagnostics: **PTK001** lock-order cycles (AB/BA inversions, with both
+  acquisition stacks) and **PTK002** locks held across device work /
+  past the long-hold threshold.
+- :func:`make_lock` — the factory the in-tree subsystems create their
+  locks through. Normally it returns a plain ``threading.Lock`` (zero
+  overhead); inside :func:`instrument` it returns named instrumented
+  locks, so a test that constructs a ``CheckpointManager`` or
+  ``GenerationServer`` under the context gets deterministic lock names
+  ("checkpoint.manager", "serving.submit") in its report.
+- :func:`instrument` — context manager that arms the factory AND
+  patches ``threading.Lock``/``threading.RLock``, so locks created by
+  code that doesn't know about this module (stdlib ``queue.Queue``
+  included) are captured too.
+
+Import-light by contract: stdlib only, so ``serving``/``checkpoint``/
+``metrics`` can import :func:`make_lock` at module load with no cycle
+(the ``analysis`` package ``__init__`` is lazy for the same reason).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["InstrumentedLock", "LockAuditor", "make_lock", "instrument",
+           "active_auditor", "caller_site"]
+
+# the REAL primitives, captured before instrument() can patch
+# threading.Lock/RLock — the shim's own internals must never route
+# through the patched constructors (infinite recursion otherwise)
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+# armed by instrument(); make_lock() routes here when set
+_active: Optional["LockAuditor"] = None
+_active_lock = _REAL_LOCK()
+
+
+def active_auditor() -> Optional["LockAuditor"]:
+    return _active
+
+
+def make_lock(name: str, rlock: bool = False):
+    """Subsystem lock factory: a plain threading primitive normally, a
+    named instrumented lock under :func:`instrument`. The name is the
+    stable identity lock-order diagnostics report ("serving.submit" →
+    "queue.mutex"), independent of construction site."""
+    aud = _active
+    if aud is not None:
+        return aud.lock(name, rlock=rlock)
+    return _REAL_RLOCK() if rlock else _REAL_LOCK()
+
+
+def caller_site(skip_suffixes) -> str:
+    """``pkg/file.py:line`` of the nearest stack frame whose filename
+    ends with none of ``skip_suffixes`` — the shared attribution helper
+    for the analysis plane (the auditor's sync/donation origins, lock
+    acquisition sites). ``core/fusion.py`` keeps its own minimal copy:
+    core must not depend on the analysis package."""
+    import sys
+    f = sys._getframe(1)
+    skip = tuple(skip_suffixes)
+    while f is not None:
+        fn = f.f_code.co_filename.replace("\\", "/")
+        if not fn.endswith(skip):
+            parts = fn.split("/")
+            short = "/".join(parts[-2:]) if len(parts) > 1 else fn
+            return f"{short}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _site(skip_modules=("analysis/locks.py", "threading.py", "queue.py")):
+    """file.py:line of the nearest caller frame outside this machinery."""
+    return caller_site(skip_modules)
+
+
+class InstrumentedLock:
+    """Wraps a real lock; every successful acquire/release reports to
+    the auditor. API-compatible with the ``threading.Lock`` surface the
+    repo uses (acquire/release/locked/context manager) plus RLock
+    reentrancy when constructed with ``rlock=True``."""
+
+    def __init__(self, auditor: "LockAuditor", name: str,
+                 rlock: bool = False):
+        self._auditor = auditor
+        self.name = name
+        self._rlock = rlock
+        self._inner = _REAL_RLOCK() if rlock else _REAL_LOCK()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        # objects built under instrument() (a server, a manager, a
+        # queue) keep their instrumented locks for life; once the
+        # auditor closes they must degrade to plain-lock cost — no
+        # stack walk, no recording into a dead auditor
+        if self._auditor.closed:
+            return self._inner.acquire(blocking, timeout)
+        t0 = time.monotonic()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._auditor._on_acquire(self, time.monotonic() - t0)
+        return ok
+
+    def release(self):
+        if self._auditor.closed:
+            # mirror of the acquire fast path: a surviving lock must
+            # not walk stacks or contend on the dead auditor's _book
+            self._inner.release()
+            return
+        self._auditor._on_release(self)
+        self._inner.release()
+
+    def locked(self):
+        try:
+            return self._inner.locked()
+        except AttributeError:  # RLock pre-3.12 has no locked()
+            if self._inner._is_owned():
+                return True  # self-held: a trial acquire would succeed
+            if self._inner.acquire(blocking=False):
+                self._inner.release()
+                return False
+            return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # queue.Queue probes these on its mutex
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        return inner.locked()
+
+    # threading.Condition probes these on its lock: without delegation a
+    # Condition built on a patched RLock would fall back to releasing
+    # ONE level in wait(), deadlocking any reentrant holder
+    def _release_save(self):
+        aud = self._auditor
+        if not aud.closed:
+            st = aud._stack()
+            while any(h.lock is self for h in st):
+                aud._on_release(self)
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            return inner._release_save()
+        inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        aud = self._auditor
+        if not aud.closed:
+            # one hold regardless of restored depth: reentrant levels
+            # record no self-edges anyway
+            aud._on_acquire(self, 0.0)
+
+    def __repr__(self):
+        return f"InstrumentedLock({self.name!r})"
+
+
+class _Hold:
+    __slots__ = ("lock", "t0", "site", "device_ops", "owner_stack")
+
+    def __init__(self, lock, site, owner_stack):
+        self.lock = lock
+        self.t0 = time.monotonic()
+        self.site = site
+        self.device_ops: List[str] = []
+        # the acquiring thread's hold stack — kept so a release from a
+        # DIFFERENT thread (legal lock handoff) can evict this hold
+        # instead of leaving a phantom that poisons every later edge
+        self.owner_stack = owner_stack
+
+
+class LockAuditor:
+    """Recording + analysis. One instance per scenario run; thread-safe
+    (its own bookkeeping lock is a raw ``threading.Lock``, invisible to
+    itself)."""
+
+    def __init__(self, long_hold_s: float = 0.2):
+        self.long_hold_s = long_hold_s
+        # set when the owning instrument() exits: surviving
+        # InstrumentedLocks then degrade to plain-lock behavior
+        self.closed = False
+        self._book = _REAL_LOCK()  # guards edges/holds bookkeeping
+        self._tls = threading.local()
+        # (held_name, acquired_name) -> (held_site, acquired_site) sample
+        self.edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self.acquisitions: Dict[str, int] = {}
+        self.long_holds: List[Tuple[str, float, str]] = []
+        self.device_under_lock: List[Tuple[str, str, str]] = []
+        self.contention_s: Dict[str, float] = {}
+        self._names: Dict[str, int] = {}
+        # id(lock) -> live holds across ALL threads (acquisition order):
+        # the cross-thread-release eviction index
+        self._live_holds: Dict[int, List[_Hold]] = {}
+
+    # -- factory ---------------------------------------------------------
+    def _unique(self, name: str) -> str:
+        with self._book:
+            n = self._names.get(name, 0)
+            self._names[name] = n + 1
+        return name if n == 0 else f"{name}#{n + 1}"
+
+    def lock(self, name: Optional[str] = None,
+             rlock: bool = False) -> InstrumentedLock:
+        return InstrumentedLock(self, self._unique(name or _site()), rlock)
+
+    # -- recording -------------------------------------------------------
+    def _stack(self) -> List[_Hold]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _on_acquire(self, lock: InstrumentedLock, waited: float) -> None:
+        st = self._stack()
+        site = _site()
+        hold = _Hold(lock, site, st)
+        with self._book:
+            self.acquisitions[lock.name] = \
+                self.acquisitions.get(lock.name, 0) + 1
+            if waited > 1e-4:
+                self.contention_s[lock.name] = \
+                    self.contention_s.get(lock.name, 0.0) + waited
+            for held in st:
+                if held.lock is lock:  # RLock reentry: no self-edge
+                    break
+            else:
+                for held in st:
+                    key = (held.lock.name, lock.name)
+                    if key not in self.edges and \
+                            held.lock.name != lock.name:
+                        self.edges[key] = (held.site, site)
+            self._live_holds.setdefault(id(lock), []).append(hold)
+        st.append(hold)
+
+    def _on_release(self, lock: InstrumentedLock) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i].lock is lock:
+                hold = st.pop(i)
+                self._record_release(hold)
+                return
+        # released by a thread that didn't acquire it (legal handoff,
+        # e.g. through a patched stdlib component): evict the
+        # acquirer's hold via the global index, or every later
+        # acquisition on that thread records a phantom nesting edge
+        with self._book:
+            holds = self._live_holds.get(id(lock))
+            hold = holds.pop() if holds else None
+            if hold is not None:
+                # evict under _book: _on_acquire iterates the owner's
+                # stack (edge recording) inside _book, so a foreign
+                # remove must serialize with it or an edge can be
+                # skipped mid-iteration
+                try:
+                    hold.owner_stack.remove(hold)
+                except ValueError:
+                    pass
+        if hold is not None:
+            self._record_release(hold, indexed=False)
+
+    def _record_release(self, hold: _Hold, indexed: bool = True) -> None:
+        if self.closed:
+            return  # pre-close hold released after: pop only
+        dt = time.monotonic() - hold.t0
+        name = hold.lock.name
+        with self._book:
+            if indexed:
+                holds = self._live_holds.get(id(hold.lock))
+                if holds and hold in holds:
+                    holds.remove(hold)
+            if dt >= self.long_hold_s:
+                self.long_holds.append((name, dt, hold.site))
+            for op in hold.device_ops:
+                self.device_under_lock.append((name, op, hold.site))
+
+    def note_device_op(self, desc: str) -> None:
+        """Called by the audit hooks when device work (a fusion flush, a
+        donated executable) runs; attributes it to every lock the
+        current thread holds."""
+        for hold in self._stack():
+            if len(hold.device_ops) < 16:
+                hold.device_ops.append(desc)
+
+    def held_now(self) -> List[str]:
+        return [h.lock.name for h in self._stack()]
+
+    # -- analysis --------------------------------------------------------
+    def cycles(self) -> List[List[str]]:
+        """Distinct cycles in the acquired-while-held graph."""
+        graph: Dict[str, List[str]] = {}
+        with self._book:
+            for a, b in self.edges:
+                graph.setdefault(a, []).append(b)
+        seen_cycles = set()
+        out: List[List[str]] = []
+
+        def dfs(node, path, on_path):
+            for nxt in graph.get(node, ()):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(cyc)
+                    continue
+                dfs(nxt, path + [nxt], on_path | {nxt})
+
+        for start in list(graph):
+            dfs(start, [start], {start})
+        return out
+
+    def diagnostics(self) -> List[Any]:
+        from .diagnostics import Diagnostic
+        diags: List[Any] = []
+        for cyc in self.cycles():
+            pairs = list(zip(cyc, cyc[1:]))
+            with self._book:
+                sites = {p: self.edges.get(p) for p in pairs}
+            detail = "; ".join(
+                f"{a}->{b} at {sites[(a, b)][1] if sites.get((a, b)) else '?'}"
+                for a, b in pairs)
+            diags.append(Diagnostic(
+                "PTK001", "lock-cycle: " + " -> ".join(cyc),
+                f"lock-order cycle: {detail}",
+                hint="pick one global order for these locks (acquire "
+                     "the same first lock on every path), or collapse "
+                     "them into one lock"))
+        with self._book:
+            device = list(self.device_under_lock)
+            longs = list(self.long_holds)
+        for name, op, site in device:
+            diags.append(Diagnostic(
+                "PTK002", f"lock:{name} at {site}",
+                f"device work ({op}) executed while holding {name}",
+                hint="move the device call outside the critical "
+                     "section; locks should guard bookkeeping, not "
+                     "XLA execution"))
+        for name, dt, site in longs:
+            diags.append(Diagnostic(
+                "PTK002", f"lock:{name} at {site}",
+                f"{name} held {dt * 1e3:.1f} ms "
+                f"(threshold {self.long_hold_s * 1e3:.0f} ms)",
+                hint="shrink the critical section or snapshot state "
+                     "and process outside the lock"))
+        return diags
+
+    def summary(self) -> Dict[str, Any]:
+        # cycles() takes _book itself — compute before entering it
+        cycles = [" -> ".join(c) for c in self.cycles()]
+        with self._book:
+            return {
+                "locks": dict(self.acquisitions),
+                "edges": {f"{a} -> {b}": list(v)
+                          for (a, b), v in self.edges.items()},
+                "cycles": cycles,
+                "long_holds": [
+                    {"lock": n, "seconds": round(dt, 6), "site": s}
+                    for n, dt, s in self.long_holds],
+                "device_under_lock": [
+                    {"lock": n, "op": o, "site": s}
+                    for n, o, s in self.device_under_lock],
+                "contention_seconds": {
+                    k: round(v, 6) for k, v in self.contention_s.items()},
+            }
+
+
+@contextmanager
+def instrument(long_hold_s: float = 0.2, patch_threading: bool = True):
+    """Arm lock instrumentation for the dynamic extent of the block:
+    :func:`make_lock` returns named instrumented locks, and (by default)
+    ``threading.Lock``/``threading.RLock`` are patched so anonymous
+    locks — including stdlib ``queue.Queue`` internals — are recorded
+    too, named by creation site. Yields the :class:`LockAuditor`.
+
+    Device-op coupling: when ``core.fusion`` is already imported, its
+    flush observer is chained for the duration so a fusion flush under
+    a held lock becomes a PTK002 finding."""
+    global _active
+    aud = LockAuditor(long_hold_s=long_hold_s)
+    with _active_lock:
+        if _active is not None:
+            raise RuntimeError("lock instrumentation is already active "
+                               "(nested instrument() is not supported)")
+        _active = aud
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    if patch_threading:
+        threading.Lock = lambda: aud.lock(rlock=False)   # type: ignore
+        threading.RLock = lambda: aud.lock(rlock=True)   # type: ignore
+    # chain the fusion flush observer (lazy: never import the backend)
+    import sys
+    fusion = sys.modules.get("paddle_tpu.core.fusion")
+    prev_obs = None
+    if fusion is not None:
+        prev_obs = fusion._flush_observer
+
+        def chained(reason, nops, pkind, origin, _prev=prev_obs):
+            aud.note_device_op(f"fusion_flush[{reason}]")
+            if _prev is not None:
+                _prev(reason, nops, pkind, origin)
+
+        # origin is only consumed downstream: don't make fusion pay the
+        # stack walk for pure lock instrumentation
+        chained.needs_origin = (
+            getattr(prev_obs, "needs_origin", True)
+            if prev_obs is not None else False)
+        fusion._flush_observer = chained
+    try:
+        yield aud
+    finally:
+        if patch_threading:
+            threading.Lock, threading.RLock = orig_lock, orig_rlock
+        if fusion is not None:
+            fusion._flush_observer = prev_obs
+        aud.closed = True  # surviving locks degrade to plain-lock cost
+        with _active_lock:
+            _active = None

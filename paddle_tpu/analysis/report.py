@@ -1,0 +1,192 @@
+"""One reporting surface over the three analyzers.
+
+``report()`` composes a capture audit (when given a callable), a full
+source-lint pass and, when a lock auditor is active, its summary into
+one :class:`AnalysisReport` with a single ``diagnostics`` list and a
+text/dict rendering. ``self_check()`` is the smoke contract the bench
+``--dispatch-only`` path runs: one seeded bug per analyzer, each of
+which must be detected by its rule id — proving the analysis plane
+itself works before anyone trusts a clean report.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .diagnostics import Diagnostic, RULES, sort_diagnostics
+
+__all__ = ["AnalysisReport", "report", "self_check", "rules_table"]
+
+
+class AnalysisReport:
+    def __init__(self, capture=None, lint_result=None, locks_summary=None):
+        self.capture = capture
+        self.lint = lint_result
+        self.locks_summary = locks_summary
+
+    @property
+    def diagnostics(self) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        if self.capture is not None:
+            out.extend(self.capture.diagnostics)
+        if self.lint is not None:
+            out.extend(self.lint.diagnostics)
+        return sort_diagnostics(out)
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "diagnostics": [x.to_dict() for x in self.diagnostics]}
+        if self.capture is not None:
+            d["capture"] = self.capture.to_dict()
+        if self.lint is not None:
+            d["lint"] = {
+                "files_scanned": self.lint.files_scanned,
+                "findings": len(self.lint.diagnostics),
+                "allowlisted": len(self.lint.suppressed),
+            }
+        if self.locks_summary is not None:
+            d["locks"] = self.locks_summary
+        return d
+
+    def render(self) -> str:
+        parts = ["paddle_tpu.analysis report",
+                 "=" * 26]
+        if self.capture is not None:
+            parts.append(self.capture.render())
+        if self.lint is not None:
+            parts.append(self.lint.render())
+        if self.locks_summary is not None:
+            cyc = self.locks_summary.get("cycles", [])
+            parts.append(f"locks: {len(self.locks_summary.get('locks', {}))}"
+                         f" instrumented, {len(cyc)} cycle(s)"
+                         + (": " + "; ".join(cyc) if cyc else ""))
+        errs = self.errors
+        parts.append(f"total: {len(self.diagnostics)} diagnostic(s), "
+                     f"{len(errs)} error(s)")
+        return "\n".join(parts)
+
+
+def report(fn: Optional[Callable] = None, *args, lint: bool = True,
+           warmup: int = 2, **kwargs) -> AnalysisReport:
+    """The one-stop entry point. With ``fn``, runs a capture audit of
+    ``fn(*args, **kwargs)`` (see :func:`analysis.audit` — e.g. one
+    ``Model.fit`` step closure); with ``lint=True`` (default) also runs
+    the source linter over ``paddle_tpu/``. When a lock auditor is
+    active (``locks.instrument()``), its summary is attached."""
+    capture = None
+    if fn is not None:
+        from .auditor import audit
+        capture = audit(fn, *args, warmup=warmup, **kwargs)
+    lint_result = None
+    if lint:
+        from .lint import lint as _lint
+        lint_result = _lint()
+    from . import locks as _locks
+    la = _locks.active_auditor()
+    locks_summary = la.summary() if la is not None else None
+    return AnalysisReport(capture, lint_result, locks_summary)
+
+
+def rules_table() -> str:
+    lines = ["rule    analyzer  severity  title",
+             "-" * 64]
+    for rid, info in sorted(RULES.items()):
+        lines.append(f"{rid:<7} {info.analyzer:<9} {info.severity:<9} "
+                     f"{info.title}")
+    return "\n".join(lines)
+
+
+def self_check(verbose: bool = False) -> Dict[str, Any]:
+    """Seed one bug per analyzer and assert its rule fires — the smoke
+    proof that the analysis plane detects what it claims to. Returns
+    {"ok": bool, "checks": {name: bool}, "detail": str}. Cheap enough
+    for the bench ``--dispatch-only`` path (~a second, CPU)."""
+    checks: Dict[str, bool] = {}
+    details: List[str] = []
+
+    # 1) lint engine: bare except + unguarded registry sweep
+    try:
+        from .lint import lint_source
+        diags = lint_source(
+            "REG = {}\n"
+            "def evict():\n"
+            "    REG.clear()\n"
+            "    try:\n"
+            "        pass\n"
+            "    except:\n"
+            "        pass\n")
+        rules = {d.rule for d in diags}
+        checks["lint"] = {"PTL003", "PTL004"} <= rules
+        if not checks["lint"]:
+            details.append(f"lint fired {sorted(rules)}, "
+                           f"wanted PTL003+PTL004")
+    except Exception as e:  # noqa: BLE001 — a crash IS the failure
+        checks["lint"] = False
+        details.append(f"lint self-check crashed: {e!r}")
+
+    # 2) auditor: a fused chain broken by a host sync must be captured
+    #    with its flush reason and a PTA001 sync diagnostic
+    try:
+        import numpy as np
+        from .auditor import audit
+
+        def step():
+            import paddle_tpu as paddle
+            x = paddle.to_tensor(np.ones((4, 4), np.float32))
+            y = paddle.add(paddle.multiply(x, 2.0), 1.0)
+            return float(y.sum().item())  # lint-allow: PTL001 seeded bug
+
+        rep = audit(step, warmup=1)
+        checks["audit"] = (
+            any(d.rule == "PTA001" for d in rep.diagnostics)
+            and len(rep.flushes) > 0
+            and all(f["origin"] != "<unknown>" for f in rep.flushes))
+        if not checks["audit"]:
+            details.append(
+                f"audit: {len(rep.flushes)} flushes, rules "
+                f"{sorted({d.rule for d in rep.diagnostics})}")
+    except Exception as e:  # noqa: BLE001
+        checks["audit"] = False
+        details.append(f"audit self-check crashed: {e!r}")
+
+    # 3) lock shim: an AB/BA inversion must come back as a PTK001 cycle
+    try:
+        from .locks import LockAuditor
+        aud = LockAuditor()
+        a, b = aud.lock("selfcheck.A"), aud.lock("selfcheck.B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        ab()
+        t = threading.Thread(target=ba)
+        t.start()
+        t.join()
+        diags = aud.diagnostics()
+        checks["locks"] = any(d.rule == "PTK001" for d in diags)
+        if not checks["locks"]:
+            details.append(f"locks: edges {list(aud.edges)}, no cycle")
+    except Exception as e:  # noqa: BLE001
+        checks["locks"] = False
+        details.append(f"locks self-check crashed: {e!r}")
+
+    ok = all(checks.values())
+    out = {"ok": ok, "checks": checks, "detail": "; ".join(details)}
+    if verbose:
+        status = "OK" if ok else "FAIL"
+        print(f"analysis self-check: {status} "
+              + " ".join(f"{k}={'ok' if v else 'FAIL'}"
+                         for k, v in checks.items())
+              + (f" ({out['detail']})" if details else ""))
+    return out

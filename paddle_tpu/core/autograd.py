@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import memory as _memory
+from .flags import _registry as _flag_registry
 from ..observability import metrics as _om
 
 __all__ = [
@@ -175,6 +176,21 @@ def flush_nan_checks() -> None:
 
 _nan_flag = None     # resolved Flag objects (registry identity is
 _stride_flag = None  # stable) — avoids per-op registry lookups
+
+# FLAGS_benchmark: block on each op's outputs so wall time measures the
+# device, not dispatch pipelining. Inline .value read per dispatch (the
+# _M_flag idiom) — off costs one attribute load.
+_bench_flag = _flag_registry["benchmark"]
+# FLAGS_retain_grad_for_all_tensor: every differentiable interior
+# tensor accumulates .grad during backward, as if retain_grads() had
+# been called on it (ref: the reference's global retain switch)
+_retain_all_flag = _flag_registry["retain_grad_for_all_tensor"]
+
+
+def _benchmark_sync(outs) -> None:
+    for o in outs:
+        if isinstance(o, jax.Array) and not isinstance(o, jax.core.Tracer):
+            o.block_until_ready()
 
 
 def _maybe_check_nan_inf(name: str, outs) -> None:
@@ -414,6 +430,8 @@ def _fast_pair(fn, kwargs, datas, diff_idx):
         key = (tuple(diff_idx), tuple(static_key),
                () if not kwargs else _freeze(kwargs))
     except TypeError:
+        if _dispatch_observer is not None:
+            _dispatch_observer("unhashable_static", fn)
         return None
     cache = _fn_pair_cache(fn)
     pair = cache.get(key)
@@ -437,6 +455,8 @@ def _fast_pair(fn, kwargs, datas, diff_idx):
         pair = _build_pair(fn, kwargs, datas, set(dyn_idx), tuple(diff_idx))
         cache[key] = pair
         _M_pair_builds.inc()
+        if _dispatch_observer is not None:
+            _dispatch_observer("pair_build", fn)
     return pair, tuple(dyn_idx), cache, key
 
 
@@ -491,6 +511,13 @@ _op_recorder = None
 # ran autograd internally cannot be replayed as pure forward segments).
 _backward_observer = None
 
+# Analysis-auditor hook (paddle_tpu.analysis.auditor): notified of
+# dispatch-cache events that signal recompile risk — ("pair_build", fn)
+# when a jitted pair compiles, ("unhashable_static", fn) when a call's
+# static args can't enter the cache key (the call runs un-jitted every
+# time). None outside an audit: one global read on the miss paths only.
+_dispatch_observer = None
+
 
 # resolved on first dispatch (tensor.py/amp import us — a module-level
 # import would be circular; a per-call import costs ~1.5µs of the
@@ -535,7 +562,9 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None,
     # dispatch_counts see every dispatch; recorders (SOT/static), AMP,
     # and tracers take the plain path untouched.
     if (_op_recorder is None and not _amp_state.enabled
-            and _fusion.enabled()):
+            and not _bench_flag.value and _fusion.enabled()):
+        # FLAGS_benchmark disables deferral: "sync after each op" is
+        # only meaningful when each op actually dispatches
         fused_out = _fusion.try_fuse(name, fn, args, kwargs, fuse_attrs)
         if fused_out is not None:
             _op_gate(name, len(args))
@@ -615,6 +644,8 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None,
             multi = isinstance(out, (tuple, list))
             outs = tuple(out) if multi else (out,)
         _maybe_check_nan_inf(name, outs)
+        if _bench_flag.value:
+            _benchmark_sync(outs)
         for o in outs:
             _memory.track(o)
         wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
@@ -679,6 +710,8 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None,
         outs, vjp_fn = jax.vjp(f, *primals)
         multi = struct["multi"]
     _maybe_check_nan_inf(name, outs)
+    if _bench_flag.value:
+        _benchmark_sync(outs)
     for o in outs:
         _memory.track(o)
 
@@ -807,6 +840,13 @@ def _run_backward(roots, root_grads, accumulate_into_grad: bool,
             _accumulate_leaf(t, g, accumulate_into_grad, results, wanted_ids)
             continue
         seed(t._node, t._out_index, g)
+        # a retained non-leaf ROOT gets its seed as .grad (ref parity:
+        # loss.grad == ones after backward under retain_grads / the
+        # retain-all flag) — the interior loop below can't see roots
+        if t._retain_grads or _retain_all_flag.value \
+                or (wanted_ids and id(t) in wanted_ids):
+            _accumulate_leaf(t, g, accumulate_into_grad, results,
+                             wanted_ids, force=True, add=_add)
         for n in _topo_order(t._node):
             if id(n) not in seen:
                 seen.add(id(n))
@@ -852,7 +892,8 @@ def _run_backward(roots, root_grads, accumulate_into_grad: bool,
                         g = r if isinstance(r, Tensor) else _as_t(r)
             if t._node is not None:
                 seed(t._node, t._out_index, g)
-                if t._retain_grads or (wanted_ids and id(t) in wanted_ids):
+                if t._retain_grads or _retain_all_flag.value \
+                        or (wanted_ids and id(t) in wanted_ids):
                     _accumulate_leaf(t, g, accumulate_into_grad, results,
                                      wanted_ids, force=True, add=_add)
             else:

@@ -144,6 +144,14 @@ define_flag("fused_optimizer_cache", 32,
             "LRU capacity of the fused optimizer-step program cache "
             "(entries keyed by optimizer type + parameter-tree structure "
             "+ dtypes/shapes + hyperparameter-static config)")
+define_flag("fusion_flush_origin", False,
+            "Attribute every fusion chain flush to its origin call "
+            "site: fusion.flush_sites_total{reason, site} counts "
+            "flushes per (reason, file:line), the planning input for "
+            "whole-step capture (which code locations break capture, "
+            "not just why). Off by default — the stack walk costs ~µs "
+            "per flush; paddle_tpu.analysis audits record origins "
+            "regardless of this flag")
 define_flag("metrics", True,
             "Process-wide telemetry registry (paddle_tpu.observability): "
             "counters/gauges/histograms woven through dispatch, fusion, "

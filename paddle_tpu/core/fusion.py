@@ -118,6 +118,12 @@ _M_compile_s = _M.histogram(
     "compile_seconds", "First execution (trace+compile) of a freshly "
     "built fused program, labeled by program kind "
     "(elementwise/reduce/epilogue)")
+_M_flush_sites = _M.counter(
+    "flush_sites_total",
+    "Chain flushes by (reason, origin call site) — the Fusion III "
+    "planning input: which code locations break whole-step capture, "
+    "not just why. Populated when FLAGS_fusion_flush_origin=1 (stack "
+    "attribution costs ~µs/flush) or during an analysis audit")
 _om.default_registry().gauge(
     "fusion.cache_size",
     "Live fused-program cache entries").set_function(
@@ -175,6 +181,44 @@ _epilogue_flag = _flag_registry["eager_fusion_epilogue"]
 _max_chain = _flag_registry["eager_fusion_max_chain"]
 _cache_cap = _flag_registry["eager_fusion_cache"]
 _nan_flag = _flag_registry["check_nan_inf"]
+_origin_flag = _flag_registry["fusion_flush_origin"]
+
+# Analysis-auditor hooks (paddle_tpu.analysis). _flush_observer, when
+# set, receives (reason, nops, pkind, origin) after every chain flush;
+# _program_observer receives (sig, event) with event in
+# "hit"/"compile"/"first" from the program cache. Both are None outside
+# an audit — the hot path pays one global read.
+_flush_observer = None
+_program_observer = None
+
+# frames skipped when attributing a flush to its origin call site: the
+# fusion/dispatch machinery itself can never be the planning-relevant
+# location
+_ORIGIN_SKIP = ("core/fusion.py", "core/tensor.py", "core/autograd.py",
+                "analysis/auditor.py", "analysis/locks.py")
+
+
+def _flush_origin() -> str:
+    """``pkg/file.py:line`` of the nearest stack frame outside the
+    fusion machinery — the call site whose host read / op boundary
+    forced this flush."""
+    import sys
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename.replace("\\", "/")
+        if not fn.endswith(_ORIGIN_SKIP):
+            parts = fn.split("/")
+            short = "/".join(parts[-2:]) if len(parts) > 1 else fn
+            return f"{short}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+# cardinality cap for flush_sites_total's site label: a long-lived
+# process under FLAGS_fusion_flush_origin must not grow one counter
+# cell per distinct call site forever — the long tail collapses into
+# "<other>" (audits are unaffected; they record raw events)
+_MAX_FLUSH_SITES = 128
+_seen_flush_sites: set = set()
 
 _Tensor = None  # resolved on first dispatch (core.tensor imports us)
 
@@ -307,7 +351,9 @@ def _infer_aval(name, fn, descs, entries, attrs=None):
     if hit is not None:
         return hit
     if len(_aval_cache) > 8192:  # bound it like the other fusion caches
-        _aval_cache.clear()
+        # a lock would guard nothing: get/insert run lock-free and a
+        # racing insert lost to the eviction just re-infers
+        _aval_cache.clear()  # lint-allow: PTL003 GIL-atomic memo eviction
     if attrs is not None:
         # infer through the registered parametric impl + attrs — exactly
         # what codegen will run — not through the per-call eager closure
@@ -603,9 +649,13 @@ def _get_program(sig, pkind):
         if entry is not None and entry is not _SEEN:
             _cache.move_to_end(sig)
             _M_hits.inc()
+            if _program_observer is not None:
+                _program_observer(sig, "hit")
             return entry
     if entry is _SEEN:
         _M_misses.inc()
+        if _program_observer is not None:
+            _program_observer(sig, "compile")
         built = _build_program(sig)
         built = (built[0], _timed_first_call(built[1], pkind), built[2])
         with _cache_lock:
@@ -615,6 +665,8 @@ def _get_program(sig, pkind):
                 _cache.popitem(last=False)
         return built
     _M_uncompiled.inc()
+    if _program_observer is not None:
+        _program_observer(sig, "first")
     with _cache_lock:
         _cache[sig] = _SEEN
         cap = max(int(_cache_cap.value or 256), 8)
@@ -833,6 +885,26 @@ def _flush(root: LazyExpr, reason: str) -> None:
     _M_ops_fused.inc(len(order))
     _M_flushes.inc(reason=reason)
     _M_chain_len.inc(**{"len": len(order)})
+    obs = _flush_observer
+    if obs is not None or _origin_flag.value:
+        # stack-origin attribution: WHERE capture broke, not just why —
+        # the fusion-III planning input. Off the hot path unless the
+        # flag or an origin-consuming observer asks for it (the lock
+        # checker's chained observer sets needs_origin=False, so pure
+        # lock instrumentation skips the walk).
+        want = _origin_flag.value or (
+            obs is not None and getattr(obs, "needs_origin", True))
+        origin = _flush_origin() if want else "<unattributed>"
+        if _origin_flag.value:
+            site = origin
+            if site not in _seen_flush_sites:
+                if len(_seen_flush_sites) >= _MAX_FLUSH_SITES:
+                    site = "<other>"
+                else:
+                    _seen_flush_sites.add(site)
+            _M_flush_sites.inc(reason=reason, site=site)
+        if obs is not None:
+            obs(reason, len(order), pkind, origin)
     if pkind != "elementwise":
         # a reduction "fused" when its input chain flushed WITH it (the
         # input edge is an interior node); a contraction's epilogue fused
@@ -897,4 +969,4 @@ def clear_cache() -> None:
     with _cache_lock:
         _cache.clear()
         _scalar_cache.clear()
-    _aval_cache.clear()
+        _aval_cache.clear()

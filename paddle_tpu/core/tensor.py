@@ -11,6 +11,7 @@ against this API runs unchanged in both eager and compiled modes.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Optional
 
 import jax
@@ -37,6 +38,13 @@ fusion_mod.register_param_impl("cast", _cast_impl)
 _materialize_hook = None
 _mutation_hook = None
 
+# Analysis-auditor hook (paddle_tpu.analysis.auditor): notified of every
+# device->host materialization — numpy()/item()/tolist()/__array__ —
+# with (tensor, kind). Separate from _materialize_hook so SOT tracing
+# and a capture audit can observe the same step simultaneously. None
+# outside an audit: one global read per host read.
+_sync_hook = None
+
 
 # Tensors sharing a device buffer with another live handle (today:
 # ``detach()``). Buffer-DONATION sites (the fused optimizer step, the
@@ -46,21 +54,28 @@ _mutation_hook = None
 # readable, frozen at its point-in-time value. Outer key: id(array);
 # inner: id(alias Tensor) -> Tensor weakly, so entries vanish with the
 # last alias (a live alias pins the array, so its id can't be reused).
+# _alias_lock guards the structural sweeps: detach() on one thread
+# while a fused step's donation gate prunes on another would otherwise
+# mutate the dict mid-iteration (found by the PTL003 lint rule).
 _buffer_aliases: dict = {}
+_alias_lock = threading.Lock()
 
 
 def _register_alias(arr, t) -> None:
     import weakref
-    if len(_buffer_aliases) > 64:
-        # amortized sweep: inner dicts empty themselves when the last
-        # alias dies, but the outer entry would otherwise persist —
-        # without this a detach-per-step loop leaks one entry per call
-        for k in [k for k, d in _buffer_aliases.items() if not len(d)]:
-            del _buffer_aliases[k]
-    d = _buffer_aliases.get(id(arr))
-    if d is None:
-        d = _buffer_aliases[id(arr)] = weakref.WeakValueDictionary()
-    d[id(t)] = t
+    with _alias_lock:
+        if len(_buffer_aliases) > 64:
+            # amortized sweep: inner dicts empty themselves when the
+            # last alias dies, but the outer entry would otherwise
+            # persist — without this a detach-per-step loop leaks one
+            # entry per call
+            for k in [k for k, d in _buffer_aliases.items()
+                      if not len(d)]:
+                del _buffer_aliases[k]
+        d = _buffer_aliases.get(id(arr))
+        if d is None:
+            d = _buffer_aliases[id(arr)] = weakref.WeakValueDictionary()
+        d[id(t)] = t
 
 
 def buffer_has_alias(arr) -> bool:
@@ -68,13 +83,14 @@ def buffer_has_alias(arr) -> bool:
     must not donate it. ~Free when no aliases exist anywhere."""
     if not _buffer_aliases:
         return False
-    d = _buffer_aliases.get(id(arr))
-    if d is None:
-        return False
-    if not len(d):
-        del _buffer_aliases[id(arr)]  # last alias died: prune
-        return False
-    return True
+    with _alias_lock:
+        d = _buffer_aliases.get(id(arr))
+        if d is None:
+            return False
+        if not len(d):
+            del _buffer_aliases[id(arr)]  # last alias died: prune
+            return False
+        return True
 
 
 class Tensor:
@@ -170,11 +186,15 @@ class Tensor:
     def numpy(self):
         if _materialize_hook is not None:
             _materialize_hook(self, "numpy")
+        if _sync_hook is not None:
+            _sync_hook(self, "numpy")
         return np.asarray(self._data)
 
     def item(self, *args):
         if _materialize_hook is not None:
             _materialize_hook(self, "item")
+        if _sync_hook is not None:
+            _sync_hook(self, "item")
         if args:
             return np.asarray(self._data).item(*args)
         return np.asarray(self._data).item()
@@ -182,11 +202,15 @@ class Tensor:
     def tolist(self):
         if _materialize_hook is not None:
             _materialize_hook(self, "numpy")
+        if _sync_hook is not None:
+            _sync_hook(self, "tolist")
         return np.asarray(self._data).tolist()
 
     def __array__(self, dtype=None):
         if _materialize_hook is not None:
             _materialize_hook(self, "numpy")
+        if _sync_hook is not None:
+            _sync_hook(self, "__array__")
         a = np.asarray(self._data)
         return a.astype(dtype) if dtype is not None else a
 

@@ -58,6 +58,16 @@ class ElasticManager:
         self._known: Optional[List[str]] = None
         self._pending: Optional[List[str]] = None
         self._pending_ticks = 0
+        # serializes the debounce state (_known/_pending/_pending_ticks)
+        # between the watch thread and user/test-driven _watch_tick
+        # calls — interleaved ticks could double-fire the rewrite
+        # callback or reset a half-counted debounce (lock-checker
+        # hardening, PR 6). The membership callback deliberately runs
+        # UNDER this lock: a second membership change must wait out an
+        # in-flight re-bootstrap, not race it. Reentrant so a callback
+        # that drives its own _watch_tick cannot self-deadlock.
+        from ..analysis.locks import make_lock
+        self._tick_lock = make_lock("elastic.watch_tick", rlock=True)
         # nid -> (last beat value, monotonic time the value last changed)
         self._beat_seen: dict = {}
         self.store_faults_survived = 0
@@ -126,31 +136,36 @@ class ElasticManager:
         before the rewrite callback fires — a node flapping around its
         TTL (slow beat, GC pause) never triggers a restart. Returns the
         new alive list when a stable change was committed, else None."""
-        if alive is None:
-            alive = self.alive_nodes()
-        if alive == self._known:
+        with self._tick_lock:
+            if alive is None:
+                # snapshot INSIDE the lock: a tick that read the store
+                # before a concurrent tick committed would otherwise
+                # debounce (and with stability_ticks=1, fire) on stale
+                # membership
+                alive = self.alive_nodes()
+            if alive == self._known:
+                self._pending = None
+                self._pending_ticks = 0
+                return None
+            if alive == self._pending:
+                self._pending_ticks += 1
+            else:
+                self._pending = alive
+                self._pending_ticks = 1
+            if self._pending_ticks < self.stability_ticks:
+                return None
             self._pending = None
             self._pending_ticks = 0
-            return None
-        if alive == self._pending:
-            self._pending_ticks += 1
-        else:
-            self._pending = alive
-            self._pending_ticks = 1
-        if self._pending_ticks < self.stability_ticks:
-            return None
-        self._pending = None
-        self._pending_ticks = 0
-        # fire BEFORE committing _known: if the rewrite callback raises
-        # (and the resilient wrapper absorbs it), the next scans still
-        # see a changed set, re-debounce, and re-fire — the membership
-        # change cannot be silently lost
-        if self.on_membership_change is not None:
-            my = alive.index(self.node_id) \
-                if self.node_id in alive else -1
-            self.on_membership_change(alive, my)
-        self._known = alive
-        return alive
+            # fire BEFORE committing _known: if the rewrite callback
+            # raises (and the resilient wrapper absorbs it), the next
+            # scans still see a changed set, re-debounce, and re-fire —
+            # the membership change cannot be silently lost
+            if self.on_membership_change is not None:
+                my = alive.index(self.node_id) \
+                    if self.node_id in alive else -1
+                self.on_membership_change(alive, my)
+            self._known = alive
+            return alive
 
     # -- threads -----------------------------------------------------------
     def start(self):

@@ -261,7 +261,8 @@ class CheckpointManager:
         self.async_save = bool(async_save)
         self.prefix = prefix
         os.makedirs(self.root, exist_ok=True)
-        self._lock = threading.Lock()
+        from ..analysis.locks import make_lock
+        self._lock = make_lock("checkpoint.manager")
         self._pending: Optional[threading.Thread] = None
         self._pending_error: Optional[BaseException] = None
         self._stats = {"saves": 0, "async_saves": 0, "bytes_written": 0,

@@ -21,6 +21,19 @@ from ..core.autograd import no_grad
 from ..core.tensor import Parameter, Tensor
 
 
+# Analysis-auditor hook (paddle_tpu.analysis.auditor): notified with
+# (kind,) each time a whole-step program is (re)built — a steady-state
+# training loop should build exactly once, so builds inside an audit's
+# measured window are recompile churn. None outside an audit.
+_build_observer = None
+
+
+def _notify_build(kind: str) -> None:
+    obs = _build_observer
+    if obs is not None:
+        obs(kind)
+
+
 class InputSpec:
     """ref: python/paddle/static/input.py InputSpec"""
 
@@ -120,6 +133,7 @@ class StaticFunction:
         self._jitted = None
 
     def _build(self):
+        _notify_build("static_function")
         if self._layer is not None:
             apply, _, _ = functionalize(self._layer, self._fn)
 
@@ -295,6 +309,7 @@ class TrainStep:
         return dict(zip(keys, clipped))
 
     def _build(self):
+        _notify_build("train_step")
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         swap = self._swap
         trainable = {k for k, p in self._params.items()

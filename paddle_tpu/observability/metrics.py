@@ -89,7 +89,8 @@ class _Instrument:
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        from ..analysis.locks import make_lock
+        self._lock = make_lock(f"metrics.instrument:{name}")
         self._cells: Dict[Tuple, Any] = {}
 
     # -- introspection ---------------------------------------------------
@@ -337,7 +338,8 @@ class Registry:
     """
 
     def __init__(self):
-        self._lock = threading.RLock()
+        from ..analysis.locks import make_lock
+        self._lock = make_lock("metrics.registry", rlock=True)
         self._instruments: "OrderedDict[str, _Instrument]" = OrderedDict()
         self._collectors: "OrderedDict[str, Callable]" = OrderedDict()
 

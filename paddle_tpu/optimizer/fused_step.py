@@ -108,6 +108,13 @@ _programs: "OrderedDict[tuple, tuple]" = OrderedDict()
 _lock = threading.Lock()
 _SEEN = object()  # first-sighting marker: structure noted, not compiled
 
+# Analysis-auditor hook (paddle_tpu.analysis.auditor): notified with
+# (opt, prep, mode) just before a donating (jit-mode) fused step
+# executes, so a capture audit can record every donated buffer and
+# later detect live handles that would read one after XLA deletes it.
+# None outside an audit.
+_donation_observer = None
+
 
 def enabled() -> bool:
     return bool(_flag.value)
@@ -415,6 +422,8 @@ def _execute(opt, prep, mode, scalars):
         donate=(0, 1, 2) if mode == "scaled" else (0, 2))
     if kind == "jit":
         _flush_pending_chains()
+        if _donation_observer is not None:
+            _donation_observer(opt, prep, mode)
     # populate the trace cell only for the duration of the call: a
     # (re)trace can only happen inside it, and the cache pins nothing
     # of this model/optimizer afterwards

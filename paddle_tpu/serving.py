@@ -466,7 +466,8 @@ class GenerationServer:
         # stopping.set(): a request that passed the check is enqueued
         # BEFORE stopping becomes visible, so the drain loop (which
         # only exits on stopping AND empty queue) cannot strand it
-        self._submit_lock = threading.Lock()
+        from .analysis.locks import make_lock
+        self._submit_lock = make_lock("serving.submit")
         self._metrics_server = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
