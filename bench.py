@@ -997,21 +997,28 @@ def bench_analysis_selfcheck():
     """analysis_selfcheck: the analysis plane's seeded-bug smoke
     (python -m paddle_tpu.analysis --self-check in-process): one bug
     per analyzer — a lint violation, a host-sync'd fused chain, a
-    lock-order inversion — each must be detected by its rule id before
-    anyone trusts a clean report. Bar: all three detectors fire."""
+    seeded graph break per PTC rule (the static capture planner), a
+    wrong ops.yaml shape spec, a lock-order inversion — each must be
+    detected by its rule id before anyone trusts a clean report or a
+    capture plan. Bar: all five detector families fire."""
     import time as _t
     from paddle_tpu.analysis.report import self_check
     t0 = _t.perf_counter()
     out = self_check()
     dt = (_t.perf_counter() - t0) * 1e3
-    _emit("analysis_selfcheck", 1.0 if out["ok"] else 0.0, "pass",
-          1.0 if out["ok"] else 0.0, {
+    # the PTC detectors are load-bearing for capture planning: require
+    # them EXPLICITLY, not just via the aggregate ok
+    ptc_fired = bool(out["checks"].get("capture")) and \
+        bool(out["checks"].get("shapes"))
+    ok = out["ok"] and ptc_fired
+    _emit("analysis_selfcheck", 1.0 if ok else 0.0, "pass",
+          1.0 if ok else 0.0, {
               "checks": {k: ("ok" if v else "FAIL")
                          for k, v in out["checks"].items()},
               "wall_ms": round(dt, 1),
               "detail": out.get("detail", ""),
-              "bar": "lint + audit + locks detectors all fire on "
-                     "seeded bugs"})
+              "bar": "lint + audit + capture(PTC) + shapes + locks "
+                     "detectors all fire on seeded bugs"})
 
 
 def bench_checkpoint_roundtrip():

@@ -9,6 +9,11 @@ Three coordinated analyzers over one diagnostics currency:
   and a checked-in, justified allowlist.
 - :mod:`.locks` — instrumented-lock shim: acquisition-order recording,
   lock-order-cycle and lock-across-device-work detection (PTK00x).
+- :mod:`.capture` + :mod:`.shapes` + :mod:`.planner` — the static
+  capture planner: graph-break AST analysis (PTC00x), shape/dtype
+  abstract interpretation over ops.yaml ``shape:`` specs, and
+  :func:`capture_plan` merging both with the dynamic audit into one
+  ranked whole-step-capture plan (ROADMAP Fusion III's input).
 
 One reporting surface: :func:`report` here, or
 ``python -m paddle_tpu.analysis`` on the command line.
@@ -19,7 +24,8 @@ this file — nothing heavier than stdlib may be imported here.
 """
 from __future__ import annotations
 
-__all__ = ["audit", "lint", "report", "AnalysisReport", "RULES"]
+__all__ = ["audit", "lint", "report", "AnalysisReport", "RULES",
+           "capture_plan", "CapturePlan"]
 
 # `lint` and `report` (the callables) share names with their defining
 # submodules. Importing a submodule binds it as a package attribute,
@@ -41,6 +47,13 @@ _LAZY = {
     "Diagnostic": ("paddle_tpu.analysis.diagnostics", "Diagnostic"),
     "AnalysisReport": ("paddle_tpu.analysis.report", "AnalysisReport"),
     "self_check": ("paddle_tpu.analysis.report", "self_check"),
+    "capture_plan": ("paddle_tpu.analysis.planner", "capture_plan"),
+    "CapturePlan": ("paddle_tpu.analysis.planner", "CapturePlan"),
+    "plan_repo_steps": ("paddle_tpu.analysis.planner",
+                        "plan_repo_steps"),
+    "capture_scan": ("paddle_tpu.analysis.capture", "capture_scan"),
+    "scan_repo_steps": ("paddle_tpu.analysis.capture",
+                        "scan_repo_steps"),
 }
 
 

@@ -45,8 +45,10 @@ class RuleInfo:
 
 # The closed rule universe. PTA* = program auditor (runtime capture),
 # PTL* = source linter (AST), PTK* = lock-order checker (instrumented
-# locks). tests/test_analysis.py seeds one bug per detection rule and
-# asserts the exact id; README's rules table is generated from this.
+# locks), PTC* = static capture planner (graph-break analysis +
+# shape/dtype abstract interpretation). tests/test_analysis.py and
+# tests/test_capture_plan.py seed one bug per detection rule and assert
+# the exact id; README's rules table is generated from this.
 RULES: Dict[str, RuleInfo] = {r.id: r for r in [
     RuleInfo(
         "PTA001", "audit", "warning", "implicit host sync",
@@ -96,6 +98,43 @@ RULES: Dict[str, RuleInfo] = {r.id: r for r in [
         "register_impl/register_param_impl registration (or a "
         "registration for an op ops.yaml doesn't mark): the fusion "
         "plane would silently never fuse it."),
+    RuleInfo(
+        "PTC001", "capture", "warning", "data-dependent control flow",
+        "An `if`/`while` whose test reads a tensor VALUE (`if t:`, "
+        "`while t.item()`, a comparison on a tensor feeding the "
+        "branch): every taken branch becomes a guard + graph break in "
+        "whole-step capture — the trace tree grows one compiled path "
+        "per branch outcome. Shape/ndim/dtype reads are static "
+        "metadata and are not flagged."),
+    RuleInfo(
+        "PTC002", "capture", "warning", "capture-poisoning side effect",
+        "A side effect inside the candidate capture region that replay "
+        "cannot reproduce: in-place tensor mutation, RNG consumption "
+        "(dropout and friends), mutation of module/global/self state, "
+        "or host I/O. jit/sot.py marks such recordings non-replayable "
+        "at runtime (the call stays eager forever); this flags them "
+        "before tracing is even attempted."),
+    RuleInfo(
+        "PTC003", "capture", "warning", "host read inside the step",
+        "A device->host fetch (.item()/.numpy()/.tolist()/float()) "
+        "inside the candidate region. When it postdominates all device "
+        "work it is HOISTABLE (fix hint: move it after the step / "
+        "batch the fetch); mid-step reads serialize dispatch and must "
+        "become capture guards or move."),
+    RuleInfo(
+        "PTC004", "capture", "warning", "shape-polymorphic call site",
+        "A call site whose tensor shapes vary run-to-run (boolean-mask "
+        "indexing, nonzero/unique/masked_select, or PTA003 churn rows "
+        "from the dynamic audit): each distinct shape compiles a new "
+        "executable. Needs a BucketPolicy so varlen inputs share a "
+        "bounded set of compiled entries."),
+    RuleInfo(
+        "PTC005", "capture", "error", "ops.yaml shape spec inconsistent",
+        "An op's declared `shape:` spec disagrees with its live fusion "
+        "impl on sample avals (golden-run comparison), or a fusable op "
+        "carries no spec / a spec decorates a non-fusable op — the "
+        "abstract interpreter would plan capture regions from wrong "
+        "shape/dtype arithmetic (the PTL005 pattern, for shapes)."),
     RuleInfo(
         "PTK001", "locks", "error", "lock-order cycle",
         "Two (or more) instrumented locks acquired in opposite nesting "

@@ -279,6 +279,20 @@ class LintResult:
         return "\n".join(lines)
 
 
+def allowlist_reason(d: Diagnostic, entries) -> Optional[str]:
+    """The ONE suppression-matching rule (shared by the linter and the
+    capture pass): an entry ``(rule, pattern, reason)`` suppresses a
+    diagnostic when the rule matches and the fnmatch pattern hits the
+    file path, the full ``path:line`` location, or the message."""
+    path = d.location.partition(":")[0]
+    for rule, pattern, reason in entries:
+        if rule == d.rule and (fnmatch.fnmatch(path, pattern)
+                               or fnmatch.fnmatch(d.location, pattern)
+                               or fnmatch.fnmatch(d.message, pattern)):
+            return reason
+    return None
+
+
 def _pragmas(source: str) -> Dict[int, Set[str]]:
     """line -> {rule ids} from inline `# lint-allow: PTLxxx reason`."""
     out: Dict[int, Set[str]] = {}
@@ -290,7 +304,7 @@ def _pragmas(source: str) -> Dict[int, Set[str]]:
         rules = {tok.strip().rstrip(",")
                  for tok in line[pos + len(marker):].split()
                  if tok.strip().rstrip(",").startswith(("PTL", "PTA",
-                                                        "PTK"))}
+                                                        "PTK", "PTC"))}
         if rules:
             out[i] = rules
     return out
@@ -406,13 +420,7 @@ def lint(paths: Optional[List[str]] = None,
         if use_allowlist and d.rule in rules_here:
             result.suppressed.append((d, "inline pragma"))
             continue
-        why = None
-        for rule, pattern, reason in allow_entries:
-            if rule == d.rule and (fnmatch.fnmatch(path, pattern)
-                                   or fnmatch.fnmatch(d.location, pattern)
-                                   or fnmatch.fnmatch(d.message, pattern)):
-                why = reason
-                break
+        why = allowlist_reason(d, allow_entries)
         if why is not None:
             result.suppressed.append((d, why))
         else:

@@ -102,9 +102,11 @@ def rules_table() -> str:
 
 def self_check(verbose: bool = False) -> Dict[str, Any]:
     """Seed one bug per analyzer and assert its rule fires — the smoke
-    proof that the analysis plane detects what it claims to. Returns
-    {"ok": bool, "checks": {name: bool}, "detail": str}. Cheap enough
-    for the bench ``--dispatch-only`` path (~a second, CPU)."""
+    proof that the analysis plane detects what it claims to: lint,
+    audit, capture (one break per PTC rule), shapes (a wrong spec
+    fails the golden run) and locks. Returns {"ok": bool, "checks":
+    {name: bool}, "detail": str}. Cheap enough for the bench
+    ``--dispatch-only`` path (~a second, CPU)."""
     checks: Dict[str, bool] = {}
     details: List[str] = []
 
@@ -153,7 +155,49 @@ def self_check(verbose: bool = False) -> Dict[str, Any]:
         checks["audit"] = False
         details.append(f"audit self-check crashed: {e!r}")
 
-    # 3) lock shim: an AB/BA inversion must come back as a PTK001 cycle
+    # 3) capture planner, static half: one seeded break per PTC rule —
+    #    a tensor-valued branch, an in-place store, a tail host read and
+    #    a boolean-mask gather — each detected by exact id
+    try:
+        from .capture import scan_source
+        diags = scan_source(
+            "def step(x):\n"
+            "    import paddle_tpu as paddle\n"
+            "    t = paddle.multiply(x, 2.0)\n"
+            "    if t.sum().item() > 0:\n"          # PTC001
+            "        t = paddle.add(t, 1.0)\n"
+            "    t[0] = 0.0\n"                      # PTC002
+            "    mask = t > 0.5\n"
+            "    sel = t[mask]\n"                   # PTC004
+            "    return sel.numpy()\n")             # PTC003
+        rules = {d.rule for d in diags}
+        want = {"PTC001", "PTC002", "PTC003", "PTC004"}
+        checks["capture"] = want <= rules
+        if not checks["capture"]:
+            details.append(f"capture fired {sorted(rules)}, "
+                           f"wanted {sorted(want)}")
+    except Exception as e:  # noqa: BLE001
+        checks["capture"] = False
+        details.append(f"capture self-check crashed: {e!r}")
+
+    # 4) shape specs: a deliberately wrong spec (sum graded as
+    #    elementwise) must fail the golden run as PTC005, and the real
+    #    table must pass it
+    try:
+        from .shapes import validate_op
+        seeded = validate_op("sum", "elementwise")
+        clean = validate_op("sum")
+        checks["shapes"] = (
+            any(d.rule == "PTC005" for d in seeded) and not clean)
+        if not checks["shapes"]:
+            details.append(
+                f"shapes: seeded={[d.rule for d in seeded]}, "
+                f"clean={[d.rule for d in clean]}")
+    except Exception as e:  # noqa: BLE001
+        checks["shapes"] = False
+        details.append(f"shapes self-check crashed: {e!r}")
+
+    # 5) lock shim: an AB/BA inversion must come back as a PTK001 cycle
     try:
         from .locks import LockAuditor
         aud = LockAuditor()

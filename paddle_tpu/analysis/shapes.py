@@ -1,0 +1,435 @@
+"""Shape/dtype abstract interpreter for the static capture planner.
+
+Whole-step capture (ROADMAP Fusion III) must prove, BEFORE tracing,
+that a candidate region is shape-stable: that its recorded fusion-DAG /
+SOT-segment ops, evaluated over abstract ``(shape, dtype)`` values,
+produce one bounded signature set under a given
+:class:`~paddle_tpu.jit.sot.BucketPolicy`. This module is that
+interpreter:
+
+- **Specs** — every op ops.yaml marks ``fusable:`` declares a
+  ``shape:`` spec (one of ``op_registry.SHAPE_SPECS``) describing how
+  its output aval follows from its input avals + node attrs:
+  ``elementwise`` (shape and dtype preserved), ``broadcast`` (numpy
+  broadcasting + dtype promotion), ``reduce`` (axis/keepdim/optional
+  dtype attrs), ``matmul`` / ``linear`` (contraction arithmetic),
+  ``cast`` (dtype from attrs). :func:`abstract_eval` evaluates one op.
+- **Golden-run validation** — :func:`validate_specs` grades every
+  declared spec against the LIVE fusion impl
+  (``core.fusion.infer_output_aval`` — ``jax.eval_shape`` of the
+  registered callable, through the same ``_aval_cache`` memo the flush
+  path uses) on sample avals, both shape and dtype, plus both marker
+  directions (fusable-without-spec, spec-without-fusable — load-time
+  guarded, re-checked here so a hand-built table can't drift).
+  Disagreements are **PTC005** (the PTL005 pattern, for shapes).
+- **Program interpretation** — :func:`interpret_signature` replays a
+  recorded fusion program signature over abstract values; and
+  :func:`bucketed_leaf_signatures` enumerates the distinct compiled
+  signatures a BucketPolicy admits for a dynamic axis — the "bounded
+  set of executables" proof the capture plan cites for PTC004 rows.
+
+Specs are validated on the inexact dtypes training actually runs
+(float32/bfloat16, plus mixed-promotion pairs); integer-promotion
+corners route through the live-impl ground truth rather than the spec.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .diagnostics import Diagnostic
+
+__all__ = ["AVal", "abstract_eval", "validate_specs", "validate_op",
+           "interpret_signature", "bucketed_leaf_signatures"]
+
+
+class AVal(tuple):
+    """Abstract value: ``(shape, dtype)``. A plain tuple subclass so
+    signatures hash/compare structurally."""
+
+    __slots__ = ()
+
+    def __new__(cls, shape, dtype):
+        return tuple.__new__(cls, (tuple(int(d) for d in shape),
+                                   np.dtype(dtype)))
+
+    @property
+    def shape(self):
+        return self[0]
+
+    @property
+    def dtype(self):
+        return self[1]
+
+    def __repr__(self):
+        return f"AVal({list(self.shape)}, {self.dtype})"
+
+
+def _promote(*dtypes) -> np.dtype:
+    """JAX-style dtype promotion (jnp.promote_types over the inputs).
+    Lazy import: the interpreter itself is host-only arithmetic."""
+    import jax.numpy as jnp
+    out = dtypes[0]
+    for d in dtypes[1:]:
+        out = jnp.promote_types(out, d)
+    return np.dtype(out)
+
+
+def _broadcast_shapes(*shapes) -> Optional[Tuple[int, ...]]:
+    try:
+        return tuple(int(d) for d in np.broadcast_shapes(*shapes))
+    except ValueError:
+        return None
+
+
+def _attrs_dict(attrs) -> Dict[str, Any]:
+    return dict(attrs) if attrs else {}
+
+
+# -- per-spec evaluators ------------------------------------------------------
+
+def _ew_eval(avals, attrs):
+    """elementwise: unary, shape AND dtype preserved (the strongest
+    invariant — a planner can propagate it with zero uncertainty)."""
+    if len(avals) != 1:
+        return None
+    return AVal(avals[0].shape, avals[0].dtype)
+
+
+def _bcast_eval(avals, attrs):
+    """broadcast: n-ary elementwise with numpy broadcasting + dtype
+    promotion (add/multiply/maximum/...)."""
+    if not avals:
+        return None
+    shape = _broadcast_shapes(*[a.shape for a in avals])
+    if shape is None:
+        return None
+    return AVal(shape, _promote(*[a.dtype for a in avals]))
+
+
+def _reduce_eval(avals, attrs):
+    """reduce: axis (None | int | tuple) / keepdim / optional dtype
+    attrs — exactly the fuse_attrs the reduction wrappers pass."""
+    if len(avals) != 1:
+        return None
+    a = avals[0]
+    kw = _attrs_dict(attrs)
+    axis = kw.get("axis")
+    keepdim = bool(kw.get("keepdim", False))
+    ndim = len(a.shape)
+    if axis is None:
+        axes = tuple(range(ndim))
+    else:
+        axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+        axes = tuple(ax + ndim if ax < 0 else ax for ax in axes)
+        if any(not 0 <= ax < ndim for ax in axes) and ndim > 0:
+            return None
+    if ndim == 0:
+        shape: Tuple[int, ...] = ()
+    elif keepdim:
+        shape = tuple(1 if i in axes else d
+                      for i, d in enumerate(a.shape))
+    else:
+        shape = tuple(d for i, d in enumerate(a.shape)
+                      if i not in axes)
+    dtype = kw.get("dtype")
+    return AVal(shape, np.dtype(dtype) if dtype is not None else a.dtype)
+
+
+def _matmul_shape(sa, sb):
+    """jnp.matmul shape arithmetic: 1-D operands get a dim prepended/
+    appended (and dropped from the result), batch dims broadcast."""
+    if not sa or not sb:
+        return None  # 0-d operands don't contract
+    a1 = len(sa) == 1
+    b1 = len(sb) == 1
+    if a1:
+        sa = (1,) + sa
+    if b1:
+        sb = sb + (1,)
+    if sa[-1] != sb[-2]:
+        return None
+    batch = _broadcast_shapes(sa[:-2], sb[:-2])
+    if batch is None:
+        return None
+    out = batch + (sa[-2], sb[-1])
+    if b1:
+        out = out[:-1]
+    if a1:
+        out = out[:-1] if b1 else out[:-2] + out[-1:]
+    return out
+
+
+def _matmul_eval(avals, attrs):
+    """matmul: transpose_x/transpose_y attrs swap the last two dims of
+    >1-D operands (the _matmul_impl contract), then jnp.matmul rules."""
+    if len(avals) != 2:
+        return None
+    kw = _attrs_dict(attrs)
+    sa, sb = avals[0].shape, avals[1].shape
+    if kw.get("transpose_x") and len(sa) > 1:
+        sa = sa[:-2] + (sa[-1], sa[-2])
+    if kw.get("transpose_y") and len(sb) > 1:
+        sb = sb[:-2] + (sb[-1], sb[-2])
+    shape = _matmul_shape(sa, sb)
+    if shape is None:
+        return None
+    return AVal(shape, _promote(avals[0].dtype, avals[1].dtype))
+
+
+def _linear_eval(avals, attrs):
+    """linear: x[..., in] @ w[in, out] (+ optional b broadcast over the
+    result) with paddle's [in, out] weight layout."""
+    if len(avals) not in (2, 3):
+        return None
+    x, w = avals[0], avals[1]
+    if len(w.shape) != 2 or not x.shape or x.shape[-1] != w.shape[0]:
+        return None
+    shape = x.shape[:-1] + (w.shape[1],)
+    dts = [x.dtype, w.dtype]
+    if len(avals) == 3:
+        b = avals[2]
+        shape2 = _broadcast_shapes(shape, b.shape)
+        if shape2 is None:
+            return None
+        shape = shape2
+        dts.append(b.dtype)
+    return AVal(shape, _promote(*dts))
+
+
+def _cast_eval(avals, attrs):
+    """cast: shape preserved, dtype from the node's `dtype` attr."""
+    if len(avals) != 1:
+        return None
+    kw = _attrs_dict(attrs)
+    if kw.get("dtype") is None:
+        return None
+    return AVal(avals[0].shape, np.dtype(kw["dtype"]))
+
+
+_EVALUATORS = {
+    "elementwise": _ew_eval,
+    "broadcast": _bcast_eval,
+    "reduce": _reduce_eval,
+    "matmul": _matmul_eval,
+    "linear": _linear_eval,
+    "cast": _cast_eval,
+}
+
+
+def _spec_of(op: str) -> Optional[str]:
+    from ..ops.op_registry import OP_TABLE
+    info = OP_TABLE.get(op)
+    return info.get("shape_spec") if info else None
+
+
+def abstract_eval(op: str, avals: Sequence[AVal],
+                  attrs=None) -> Optional[AVal]:
+    """Evaluate one op over abstract values via its declared ``shape:``
+    spec. Returns None when the op has no spec or the spec rejects the
+    inputs (rank/contraction mismatch) — callers fall back to the live
+    ground truth (``fusion.infer_output_aval``)."""
+    spec = _spec_of(op)
+    if spec is None:
+        return None
+    avals = [a if isinstance(a, AVal) else AVal(a[0], a[1])
+             for a in avals]
+    return _EVALUATORS[spec](avals, attrs)
+
+
+# -- golden-run validation (PTC005) ------------------------------------------
+
+# sample avals per spec id: the inexact training domain plus mixed-
+# promotion pairs; (avals, attrs) cases, each graded abstract-vs-live
+_F32 = np.dtype("float32")
+_BF16 = np.dtype("bfloat16")
+
+
+def _sample_cases(op: str, spec: str) -> List[Tuple[list, Any]]:
+    if spec == "elementwise":
+        return [([((3, 4), _F32)], None), ([((2, 1, 5), _BF16)], None),
+                ([((), _F32)], None)]
+    if spec == "broadcast":
+        return [([((3, 4), _F32), ((4,), _F32)], None),
+                ([((3, 4), _BF16), ((3, 4), _F32)], None),
+                ([((3, 1), _F32), ((1, 5), _BF16)], None)]
+    if spec == "reduce":
+        if op == "squared_l2_norm":   # fixed full reduction, no attrs
+            return [([((3, 4), _F32)], ()), ([((5,), _BF16)], ())]
+        cases = []
+        for axis, keepdim in ((None, False), (1, False), (1, True),
+                              ((0, 2), False), (-1, True)):
+            av = ((2, 3, 4), _F32) if isinstance(axis, tuple) or axis \
+                else ((3, 4), _F32)
+            attrs = (("axis", axis), ("keepdim", keepdim))
+            if op in ("sum", "prod"):   # their wrappers carry a dtype
+                attrs = (("axis", axis), ("dtype", None),
+                         ("keepdim", keepdim))
+            cases.append(([av], attrs))
+        if op in ("sum", "prod"):
+            cases.append(([((3, 4), _BF16)],
+                          (("axis", None), ("dtype", _F32),
+                           ("keepdim", False))))
+        return cases
+    if spec == "matmul":
+        return [
+            ([((3, 4), _F32), ((4, 5), _F32)],
+             (("transpose_x", False), ("transpose_y", False))),
+            ([((4, 3), _F32), ((4, 5), _BF16)],
+             (("transpose_x", True), ("transpose_y", False))),
+            ([((2, 3, 4), _BF16), ((2, 4, 5), _BF16)],
+             (("transpose_x", False), ("transpose_y", False))),
+            ([((4,), _F32), ((4, 5), _F32)],
+             (("transpose_x", False), ("transpose_y", False))),
+            ([((3, 4), _F32), ((4,), _F32)],
+             (("transpose_x", False), ("transpose_y", False))),
+        ]
+    if spec == "linear":
+        return [([((2, 3, 4), _F32), ((4, 5), _F32)], ()),
+                ([((2, 4), _BF16), ((4, 5), _BF16), ((5,), _BF16)], ()),
+                ([((2, 4), _BF16), ((4, 5), _F32), ((5,), _F32)], ())]
+    if spec == "cast":
+        return [([((3, 4), _F32)], (("dtype", _BF16),)),
+                ([((2,), _BF16)], (("dtype", _F32),)),
+                ([((3,), _F32)], (("dtype", np.dtype("int32")),))]
+    return []
+
+
+def validate_op(op: str, spec: Optional[str] = None) -> List[Diagnostic]:
+    """Grade one op's shape spec against its live fusion impl on the
+    sample avals (PTC005 on any disagreement). ``spec`` overrides the
+    declared one — the self-check seeds a deliberately wrong spec this
+    way to prove the detector fires."""
+    from ..core import fusion
+    declared = _spec_of(op)
+    spec = spec or declared
+    if spec is None:
+        return []
+    evaluator = _EVALUATORS.get(spec)
+    if evaluator is None:
+        return [Diagnostic(
+            "PTC005", f"ops/ops.yaml: {op}",
+            f"op `{op}` declares shape spec {spec!r} which "
+            f"analysis/shapes.py implements no evaluator for",
+            hint="pick a spec from op_registry.SHAPE_SPECS")]
+    diags: List[Diagnostic] = []
+    # sample from the DECLARED spec (its cases carry the op's real
+    # attrs, which the live impl needs) and grade with the spec under
+    # test — so a wrong override is judged on the op's true domain
+    for avals, attrs in _sample_cases(op, declared or spec):
+        avals = [AVal(s, d) for s, d in avals]
+        want = fusion.infer_output_aval(op, avals, attrs)
+        if want is None:
+            continue  # impl unregistered/rejecting: PTL005's domain
+        got = evaluator(avals, attrs)
+        want_aval = AVal(want[0], want[1])
+        if got is None or tuple(got) != tuple(want_aval):
+            diags.append(Diagnostic(
+                "PTC005", f"ops/ops.yaml: {op}",
+                f"shape spec `{spec}` predicts "
+                f"{got!r} for inputs {avals} attrs {attrs!r}, but the "
+                f"live impl produces {want_aval!r}",
+                hint="fix the spec (or the impl) — the capture planner "
+                     "plans executables from this arithmetic; the two "
+                     "must agree exactly"))
+            break  # one counterexample per op keeps reports readable
+    return diags
+
+
+def validate_specs() -> List[Diagnostic]:
+    """The PTC005 sweep: both marker directions plus the golden-run
+    agreement check for every declared spec."""
+    from ..ops.op_registry import OP_TABLE
+    diags: List[Diagnostic] = []
+    for name, info in sorted(OP_TABLE.items()):
+        spec = info.get("shape_spec")
+        fusable = info.get("fusable")
+        if fusable and not spec:
+            diags.append(Diagnostic(
+                "PTC005", f"ops/ops.yaml: {name}",
+                f"op `{name}` is marked fusable:{fusable!r} but carries "
+                f"no `shape:` spec — the abstract interpreter cannot "
+                f"plan regions containing it",
+                hint="declare one of op_registry.SHAPE_SPECS"))
+            continue
+        if spec and not fusable:
+            diags.append(Diagnostic(
+                "PTC005", f"ops/ops.yaml: {name}",
+                f"op `{name}` declares shape spec `{spec}` but is not "
+                f"fusable — dead declaration (the interpreter only "
+                f"walks fusable regions)",
+                hint="mark the op fusable, or drop the spec"))
+            continue
+        if spec:
+            diags.extend(validate_op(name, spec))
+    return diags
+
+
+# -- program interpretation ---------------------------------------------------
+
+def interpret_signature(sig) -> Dict[str, Any]:
+    """Replay a recorded fusion program signature ``(nodes, leaf_descs,
+    out_idx, diff_idx)`` (core.fusion's structural cache key) over
+    abstract values. Every node is evaluated through its declared spec
+    AND the live impl; a disagreement is a PTC005 diagnostic (the
+    recorded-program variant of the golden run). Returns
+    ``{"outputs": [AVal...], "node_avals": [...], "diagnostics": [...]}``.
+    """
+    from ..core import fusion
+    nodes, leaf_descs = sig[0], sig[1]
+    out_idx = sig[2] if len(sig) > 2 else ()
+    leaves = [AVal(d[1], d[2]) for d in leaf_descs]
+    env: List[Optional[AVal]] = []
+    diags: List[Diagnostic] = []
+    for op, children, attrs in nodes:
+        child_avals = []
+        ok = True
+        for kind, j, _ad in children:
+            v = env[j] if kind == "n" else leaves[j]
+            if v is None:
+                ok = False
+                break
+            child_avals.append(v)
+        if not ok:
+            env.append(None)
+            continue
+        spec_out = abstract_eval(op, child_avals, attrs)
+        live = fusion.infer_output_aval(op, child_avals, attrs)
+        live_out = AVal(live[0], live[1]) if live is not None else None
+        if spec_out is not None and live_out is not None and \
+                tuple(spec_out) != tuple(live_out):
+            diags.append(Diagnostic(
+                "PTC005", f"fusion-dag: {op}",
+                f"spec predicts {spec_out!r} but the live impl gives "
+                f"{live_out!r} for inputs {child_avals} attrs {attrs!r}",
+                hint="the recorded program and the declared spec "
+                     "disagree — fix ops.yaml before trusting plans "
+                     "over this region"))
+        env.append(spec_out if spec_out is not None else live_out)
+    outs = [env[i] for i in out_idx] if out_idx else list(env[-1:])
+    return {"outputs": outs, "node_avals": env, "diagnostics": diags}
+
+
+def bucketed_leaf_signatures(shape, dynamic_axes: Dict[int, Any],
+                             max_size: int,
+                             dtype="float32") -> List[Tuple]:
+    """The bounded-executables proof for one leaf: enumerate the
+    distinct ``(shape, dtype)`` signatures a bucket policy admits when
+    each axis in ``dynamic_axes`` (axis -> buckets, a sorted list or
+    "pow2" — BucketPolicy's vocabulary) sweeps sizes ``1..max_size``.
+    Without a policy that sweep compiles ``max_size`` distinct
+    executables per axis; with it, ``len(result)`` — the number the
+    capture plan quotes for a PTC004 row."""
+    from ..jit.sot import BucketPolicy
+    policy = BucketPolicy({})
+    per_axis: Dict[int, List[int]] = {}
+    for axis, buckets in dynamic_axes.items():
+        per_axis[axis] = sorted(
+            {policy.bucket_of(s, buckets)
+             for s in range(1, int(max_size) + 1)})
+    sigs = {tuple(shape)}
+    for axis, sizes in per_axis.items():
+        sigs = {s[:axis] + (n,) + s[axis + 1:]
+                for s in sigs for n in sizes}
+    return sorted((s, np.dtype(dtype).name) for s in sigs)

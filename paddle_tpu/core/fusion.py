@@ -66,7 +66,7 @@ from ..observability import metrics as _om
 
 __all__ = ["stats", "reset_stats", "clear_cache", "register_impl",
            "register_param_impl", "enabled", "materialize_tensor",
-           "boundary_reason"]
+           "boundary_reason", "infer_output_aval"]
 
 _INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31
 
@@ -378,6 +378,32 @@ def _infer_aval(name, fn, descs, entries, attrs=None):
         return None
     _aval_cache[key] = aval
     return aval
+
+
+def infer_output_aval(name, avals, attrs=None):
+    """Live-impl ground truth for the analysis plane's shape/dtype
+    abstract interpreter (analysis/shapes.py): the output
+    ``(shape, dtype, weak_type)`` of fusable op ``name`` applied to
+    abstract inputs ``avals`` (an iterable of ``(shape, dtype)`` or
+    ``(shape, dtype, weak)`` tuples), computed by ``jax.eval_shape`` of
+    the REGISTERED fusion impl through the same ``_aval_cache`` memo the
+    flush path uses — so spec validation grades against exactly what
+    codegen will run. ``attrs`` is the hashable attr tuple for
+    parametric ops (reductions/contractions/cast). Returns None when no
+    impl is registered or the impl rejects the avals."""
+    if attrs is None:
+        if _IMPLS.get(name) is None:
+            return None
+    elif name not in _PIMPLS:
+        return None
+    descs = tuple(
+        ("a", tuple(a[0]), np.dtype(a[1]),
+         bool(a[2]) if len(a) > 2 else False)
+        for a in avals)
+    # entries are only consulted for non-"a" descs (python scalars) —
+    # every abstract input is an array desc here
+    return _infer_aval(name, _IMPLS.get(name), descs,
+                       (None,) * len(descs), attrs)
 
 
 def _param_fn(op, attrs):

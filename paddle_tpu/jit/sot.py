@@ -109,14 +109,15 @@ class BucketPolicy:
 # ---------------------------------------------------------------------------
 
 class _Op:
-    __slots__ = ("fn", "arg_refs", "kwargs", "out_ids", "multi")
+    __slots__ = ("fn", "arg_refs", "kwargs", "out_ids", "multi", "name")
 
-    def __init__(self, fn, arg_refs, kwargs, out_ids, multi):
+    def __init__(self, fn, arg_refs, kwargs, out_ids, multi, name=""):
         self.fn = fn            # pure jax fn captured at record time
         self.arg_refs = arg_refs  # list of ("id", sot_id) | ("ext", Tensor) | ("lit", value)
         self.kwargs = kwargs
         self.out_ids = out_ids
         self.multi = multi
+        self.name = name        # dispatch op name (capture-plan metadata)
 
 
 class _Segment:
@@ -230,7 +231,8 @@ class _Recorder:
             out_ids.append(sid)
             self.produced_in_cur.add(sid)
         self.cur.ops.append(
-            _Op(fn, arg_refs, dict(kwargs), out_ids, len(outs) > 1))
+            _Op(fn, arg_refs, dict(kwargs), out_ids, len(outs) > 1,
+                name))
 
     def on_materialize(self, t: Tensor, kind: str):
         sid = self.tensor_ids.get(id(t))
@@ -576,6 +578,9 @@ class SOTFunction:
         # shadows compiled paths of OTHER branches of the same signature
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._warned = set()
+        # why recordings stayed eager, by reason — the capture planner
+        # reads this as dynamic PTC002-class evidence
+        self._fallback_reasons: Dict[str, int] = {}
         # Layers whose .training flag steers the trace (dropout/BN modes):
         # the bound self plus any Layer captured in the fn's closure.
         # Their modes join the cache signature — the analog of the
@@ -651,6 +656,37 @@ class SOTFunction:
     def cache_size(self):
         return len(self._cache)
 
+    def capture_metadata(self):
+        """Segment/guard metadata for the capture planner
+        (``analysis.capture_plan``): per recorded path, the compiled
+        segments (op names, arity) and the guards between them — the
+        ground-truth segmentation whole-step capture starts from — plus
+        the reasons any recording stayed eager (dynamic PTC002-class
+        evidence: RNG, in-place mutation, oversized guards)."""
+        paths = []
+        for key, val in self._cache.items():
+            if val == "eager":
+                paths.append({"kind": "eager"})
+                continue
+            rec = val.rec
+            paths.append({
+                "kind": "compiled",
+                "segments": [
+                    {"n_ops": len(seg.ops),
+                     "ops": [op.name for op in seg.ops],
+                     "inputs": len(seg.input_ids),
+                     "ext_tensors": len(seg.ext_tensors),
+                     "outputs": len(seg.output_ids)}
+                    for seg in rec.segments],
+                "guards": [{"kind": g.kind, "nbytes": len(g.value)}
+                           for g in rec.guards],
+                "ext_guards": len(rec.ext_guards),
+            })
+        return {"name": self._name,
+                "cache_entries": len(self._cache),
+                "paths": paths,
+                "fallback_reasons": dict(self._fallback_reasons)}
+
     @staticmethod
     def _tensor_args(args, kwargs):
         return [a for a in args if isinstance(a, Tensor)] + \
@@ -673,6 +709,14 @@ class SOTFunction:
             # marker key is distinct from every guard-path key, so a
             # non-replayable BRANCH never evicts compiled sibling paths
             self._cache_put((sig, "eager"), "eager")
+            # bounded cardinality: why_not can embed per-call values
+            # (guard byte sizes) — past the cap, collapse to <other>
+            reason = rec.why_not
+            if reason not in self._fallback_reasons and \
+                    len(self._fallback_reasons) >= 16:
+                reason = "<other>"
+            self._fallback_reasons[reason] = \
+                self._fallback_reasons.get(reason, 0) + 1
             if self._name not in self._warned:
                 self._warned.add(self._name)
                 warnings.warn(

@@ -105,6 +105,15 @@ def _load_yaml() -> list:
 
 _FUSABLE_CLASSES = (False, True, "reduce", "epilogue")
 
+# The shape-spec vocabulary for the analysis plane's abstract
+# interpreter (analysis/shapes.py declares one evaluator per id and
+# asserts it covers exactly this tuple): how an op's output
+# (shape, dtype) follows from its inputs + attrs. Declared here —
+# import-light, loaded with the table — so a typo'd spec fails at
+# import, not at the first capture plan.
+SHAPE_SPECS = ("elementwise", "broadcast", "reduce", "matmul", "linear",
+               "cast")
+
 
 def _norm_fusable(name: str, v):
     """Validate the ops.yaml `fusable` marker class at load time so a
@@ -116,6 +125,27 @@ def _norm_fusable(name: str, v):
         raise ValueError(
             f"ops.yaml: op {name!r} declares unknown fusable class "
             f"{v!r}; expected one of {_FUSABLE_CLASSES}")
+    return v
+
+
+def _norm_shape_spec(name: str, v, fusable):
+    """Validate the ops.yaml `shape:` spec id at load time (the
+    _norm_fusable pattern): every fusable op must declare how its
+    output aval follows from its inputs, and the id must name an
+    evaluator analysis/shapes.py actually implements — otherwise the
+    capture planner's abstract interpretation silently loses the op."""
+    if v is None:
+        if fusable:
+            raise ValueError(
+                f"ops.yaml: op {name!r} is marked fusable:{fusable!r} "
+                f"but declares no `shape:` spec — the capture planner "
+                f"cannot abstractly interpret it; pick one of "
+                f"{SHAPE_SPECS}")
+        return None
+    if v not in SHAPE_SPECS:
+        raise ValueError(
+            f"ops.yaml: op {name!r} declares unknown shape spec "
+            f"{v!r}; expected one of {SHAPE_SPECS}")
     return v
 
 
@@ -139,6 +169,10 @@ def _register_all():
             # predates the field
             "fusable": _norm_fusable(name, entry.get("fusable", False)),
         }
+        # analysis-plane shape/dtype spec (see SHAPE_SPECS above):
+        # validated against `fusable` so the two markers can't drift
+        info["shape_spec"] = _norm_shape_spec(
+            name, entry.get("shape"), info["fusable"])
         OP_TABLE[name] = info
         if lib is not None:
             lib.op_register(name, info["nin"], info["nargs"],

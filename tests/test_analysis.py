@@ -219,6 +219,10 @@ class TestAuditorSeededBugs:
     def test_recompile_churn_loop(self):
         """A shape-polymorphic call site keeps compiling in the measured
         window -> PTA003 naming the shape churn."""
+        from paddle_tpu.core import fusion
+        fusion.clear_cache()  # churn needs a cold program cache: other
+        # tests (e.g. test_capture_plan) use the same chain structures
+
         def churn():
             for n in range(3, 9):
                 x = paddle.to_tensor(np.ones((n,), np.float32))
@@ -547,6 +551,39 @@ class TestFlushSiteMetrics:
         y = paddle.add(paddle.multiply(x, 2.0), 1.0)
         y.numpy()
         assert not [k for k in fusion._M_flush_sites.series() if k]
+
+    def test_site_cardinality_cap_collapses_to_other(self):
+        """ISSUE 7 satellite: a long-lived process must not grow one
+        counter cell per distinct call site forever — past the cap new
+        sites land in '<other>', so planner attribution can't blow up
+        metric cardinality. Known sites keep their own label."""
+        from paddle_tpu.core import fusion
+        fusion._M_flush_sites.reset()
+        saved = set(fusion._seen_flush_sites)
+        try:
+            fusion._seen_flush_sites.clear()
+            fusion._seen_flush_sites.update(
+                f"fake/site_{i}.py:1" for i in range(
+                    fusion._MAX_FLUSH_SITES))
+            set_flags({"FLAGS_fusion_flush_origin": 1})
+            try:
+                x = paddle.to_tensor(np.ones((4,), np.float32))
+                y = paddle.add(paddle.multiply(x, 2.0), 1.0)
+                y.numpy()
+            finally:
+                set_flags({"FLAGS_fusion_flush_origin": 0})
+            labels = {dict(k).get("site")
+                      for k in fusion._M_flush_sites.series() if k}
+            assert "<other>" in labels, labels
+            assert not any(l and "test_analysis.py" in l
+                           for l in labels), labels
+            # the set itself must not have grown past the cap
+            assert len(fusion._seen_flush_sites) <= \
+                fusion._MAX_FLUSH_SITES
+        finally:
+            fusion._seen_flush_sites.clear()
+            fusion._seen_flush_sites.update(saved)
+            fusion._M_flush_sites.reset()
 
 
 # ---------------------------------------------------------------------------

@@ -776,6 +776,42 @@ def test_fusable_field_validation():
         _norm_fusable("demo", "reduction")  # typo'd class must not load
 
 
+def test_shape_spec_coverage_and_golden_run():
+    """PTC005 coverage contract (ISSUE 7): every op marked `fusable:`
+    carries a `shape:` spec, no non-fusable op does (both directions,
+    the PTL005 pattern), and every declared spec agrees with the LIVE
+    fusion impl on sample avals — the golden run the capture planner's
+    abstract interpreter stands on."""
+    from paddle_tpu.analysis import shapes
+    from paddle_tpu.ops.op_registry import (OP_TABLE, SHAPE_SPECS,
+                                            _norm_shape_spec)
+
+    d = yaml.safe_load(open("paddle_tpu/ops/ops.yaml"))["ops"]
+    for o in d:
+        if o.get("fusable"):
+            assert o.get("shape") in SHAPE_SPECS, \
+                (f"fusable op {o['name']} lacks a valid `shape:` spec "
+                 f"(got {o.get('shape')!r})")
+        else:
+            assert o.get("shape") is None, \
+                f"non-fusable op {o['name']} declares a shape spec"
+    # the loaded table mirrors the yaml (load-time validation ran)
+    fusable_names = {o["name"] for o in d if o.get("fusable")}
+    for name in fusable_names:
+        assert OP_TABLE[name]["shape_spec"] in SHAPE_SPECS
+    # golden run: abstract spec == live impl on sample avals, all ops
+    diags = shapes.validate_specs()
+    assert diags == [], "\n".join(x.render() for x in diags)
+    # the detector detects: a wrong spec must fail the golden run...
+    assert any(x.rule == "PTC005"
+               for x in shapes.validate_op("mean", "broadcast"))
+    # ...and load-time validation rejects unknown/missing specs
+    with pytest.raises(ValueError):
+        _norm_shape_spec("demo", "reduceish", True)
+    with pytest.raises(ValueError):
+        _norm_shape_spec("demo", None, "reduce")
+
+
 def test_yaml_fully_covered():
     names = set(_load_yaml_names())
     covered = set(SPECS) | EXEMPT

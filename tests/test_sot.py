@@ -144,6 +144,60 @@ class TestFallbacks:
         np.testing.assert_allclose(g1.numpy(), g2.numpy())
 
 
+class TestCaptureMetadata:
+    """ISSUE 7: SOTFunction exposes segment/guard metadata so the
+    capture planner (analysis.capture_plan) can read the recorded
+    segmentation instead of re-deriving it."""
+
+    def test_segments_guards_and_op_names(self):
+        def f(x):
+            y = x * 2
+            if (y.sum() > 0):
+                return y + 1
+            return y - 1
+
+        sf = SOTFunction(f)
+        sf(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        sf(paddle.to_tensor(-np.ones((2, 2), np.float32)))
+        md = sf.capture_metadata()
+        assert md["cache_entries"] == 2
+        paths = [p for p in md["paths"] if p["kind"] == "compiled"]
+        assert len(paths) == 2
+        for p in paths:
+            # one guard (the branch) splitting the segments
+            assert len(p["guards"]) == 1
+            assert p["guards"][0]["kind"] in ("item", "numpy")
+            assert len(p["segments"]) >= 2
+            ops = [o for seg in p["segments"] for o in seg["ops"]]
+            assert "multiply" in ops, ops
+        assert md["fallback_reasons"] == {}
+
+    def test_fallback_reasons_surface(self):
+        def f(x):
+            return paddle.nn.functional.dropout(x, 0.5, training=True)
+
+        sf = SOTFunction(f)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sf(paddle.to_tensor(np.ones((8,), np.float32)))
+        md = sf.capture_metadata()
+        assert any("RNG" in r for r in md["fallback_reasons"]), md
+        assert any(p["kind"] == "eager" for p in md["paths"])
+
+    def test_planner_attaches_sot_metadata(self):
+        from paddle_tpu import analysis
+
+        def f(x):
+            return x * 2 + 1
+
+        sf = SOTFunction(f)
+        sf(paddle.to_tensor(np.ones((4,), np.float32)))
+        plan = analysis.capture_plan(sf, dynamic=False)
+        assert plan.sot is not None
+        assert plan.sot["cache_entries"] == 1
+        assert "sot:" in plan.render()
+
+
 class TestCachePolicy:
     def test_lru_bounded(self):
         paddle.set_flags({"FLAGS_sot_cache_size": 4})
