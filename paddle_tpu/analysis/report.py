@@ -104,8 +104,9 @@ def self_check(verbose: bool = False) -> Dict[str, Any]:
     """Seed one bug per analyzer and assert its rule fires — the smoke
     proof that the analysis plane detects what it claims to: lint,
     audit, capture (one break per PTC rule), shapes (a wrong spec
-    fails the golden run) and locks. Returns {"ok": bool, "checks":
-    {name: bool}, "detail": str}. Cheap enough for the bench
+    fails the golden run), flight (a synthetic crash leaves a dump
+    containing the seeded event) and locks. Returns {"ok": bool,
+    "checks": {name: bool}, "detail": str}. Cheap enough for the bench
     ``--dispatch-only`` path (~a second, CPU)."""
     checks: Dict[str, bool] = {}
     details: List[str] = []
@@ -197,7 +198,79 @@ def self_check(verbose: bool = False) -> Dict[str, Any]:
         checks["shapes"] = False
         details.append(f"shapes self-check crashed: {e!r}")
 
-    # 5) lock shim: an AB/BA inversion must come back as a PTK001 cycle
+    # 5) flight recorder: a synthetic crash (unhandled exception on a
+    #    thread, the serving-loop death mode) must leave a dump whose
+    #    trail contains the event seeded just before the crash. The
+    #    check runs against freshly installed hooks (a production
+    #    install is torn down first and re-installed after — a second
+    #    install_crash_hooks() is an idempotent no-op, so silencing the
+    #    thread hook without this would disarm the live hooks and fail
+    #    spuriously), forces the recorder ON (an operator kill switch
+    #    must not read as a broken analysis plane), and afterwards
+    #    removes its synthetic events from the production ring so a
+    #    later REAL dump doesn't carry a fake prior crash. The one
+    #    honest residue: dumps_total{trigger=exception} counts the
+    #    synthetic dump it really wrote.
+    try:
+        import tempfile
+
+        from ..core.flags import get_flags, set_flags
+        from ..observability import flight
+
+        _SEEDED_MSG = "flight self-check seeded crash"
+        with tempfile.TemporaryDirectory() as d:
+            prev_flags = get_flags(["FLAGS_flight_dump_dir",
+                                    "FLAGS_flight_recorder"])
+            was_installed = flight._hooks_installed
+            # signal numbers bound by a production
+            # install_crash_hooks(signals=...) must be re-bound on
+            # re-install or the operator's live-dump trigger silently
+            # reverts to SIG_DFL
+            prev_signums = tuple(flight._prev_signals)
+            if was_installed:
+                flight.uninstall_crash_hooks()
+            prev_hook = threading.excepthook
+            # silence the default traceback print: the crash is seeded
+            threading.excepthook = lambda args: None
+            set_flags({"FLAGS_flight_dump_dir": d,
+                       "FLAGS_flight_recorder": 1})
+            flight.install_crash_hooks()
+            try:
+                flight.record("selfcheck", "seeded_event", probe=1)
+
+                def boom():
+                    raise RuntimeError(_SEEDED_MSG)
+
+                t = threading.Thread(target=boom)
+                t.start()
+                t.join()
+                dumps = flight.find_dumps(d)
+                ok_flight = False
+                if dumps:
+                    _hdr, evs = flight.load_dump(dumps[0])
+                    ok_flight = any(
+                        e.get("cat") == "selfcheck"
+                        and e.get("name") == "seeded_event"
+                        for e in evs)
+            finally:
+                flight.uninstall_crash_hooks()
+                threading.excepthook = prev_hook
+                set_flags(prev_flags)
+                if was_installed:
+                    flight.install_crash_hooks(signals=prev_signums)
+                flight._discard_events(
+                    lambda ev: ev[1] == "selfcheck" or (
+                        ev[1] == "crash"
+                        and _SEEDED_MSG in str(ev[5] or "")))
+        checks["flight"] = ok_flight
+        if not ok_flight:
+            details.append(
+                f"flight: {len(dumps)} dump(s), seeded event missing")
+    except Exception as e:  # noqa: BLE001
+        checks["flight"] = False
+        details.append(f"flight self-check crashed: {e!r}")
+
+    # 6) lock shim: an AB/BA inversion must come back as a PTK001 cycle
     try:
         from .locks import LockAuditor
         aud = LockAuditor()

@@ -62,6 +62,7 @@ import numpy as np
 from . import autograd as _ag
 from . import memory as _memory
 from .flags import _registry as _flag_registry
+from ..observability import flight as _flight
 from ..observability import metrics as _om
 
 __all__ = ["stats", "reset_stats", "clear_cache", "register_impl",
@@ -680,6 +681,7 @@ def _get_program(sig, pkind):
             return entry
     if entry is _SEEN:
         _M_misses.inc()
+        _flight.record("fusion", "compile", kind=pkind)
         if _program_observer is not None:
             _program_observer(sig, "compile")
         built = _build_program(sig)
@@ -911,6 +913,7 @@ def _flush(root: LazyExpr, reason: str) -> None:
     _M_ops_fused.inc(len(order))
     _M_flushes.inc(reason=reason)
     _M_chain_len.inc(**{"len": len(order)})
+    _flight.record("fusion", "flush", reason=reason, nops=len(order))
     obs = _flush_observer
     if obs is not None or _origin_flag.value:
         # stack-origin attribution: WHERE capture broke, not just why —

@@ -20,6 +20,7 @@ import numpy as np
 
 from . import dtype as dtype_mod
 from . import fusion as fusion_mod
+from ..observability import flight as _flight
 from .autograd import apply_op, backward as _backward, is_grad_enabled
 
 
@@ -188,6 +189,7 @@ class Tensor:
             _materialize_hook(self, "numpy")
         if _sync_hook is not None:
             _sync_hook(self, "numpy")
+        _flight.record("host", "sync", kind="numpy")
         return np.asarray(self._data)
 
     def item(self, *args):
@@ -195,6 +197,7 @@ class Tensor:
             _materialize_hook(self, "item")
         if _sync_hook is not None:
             _sync_hook(self, "item")
+        _flight.record("host", "sync", kind="item")
         if args:
             return np.asarray(self._data).item(*args)
         return np.asarray(self._data).item()
@@ -204,6 +207,7 @@ class Tensor:
             _materialize_hook(self, "numpy")
         if _sync_hook is not None:
             _sync_hook(self, "tolist")
+        _flight.record("host", "sync", kind="tolist")
         return np.asarray(self._data).tolist()
 
     def __array__(self, dtype=None):
@@ -211,6 +215,7 @@ class Tensor:
             _materialize_hook(self, "numpy")
         if _sync_hook is not None:
             _sync_hook(self, "__array__")
+        _flight.record("host", "sync", kind="__array__")
         a = np.asarray(self._data)
         return a.astype(dtype) if dtype is not None else a
 

@@ -735,6 +735,9 @@ def destroy_process_group(group: Optional[Group] = None):
 # counters (the comm_task_manager bytes attribution); span latency lands
 # in watchdog.span_seconds when a watchdog is installed.
 
+import time as _time  # noqa: E402
+
+from ..observability import flight as _flight  # noqa: E402
 from ..observability import metrics as _om  # noqa: E402
 
 _M_coll_calls = _om.counter(
@@ -782,13 +785,27 @@ def _spanned(fn):
         if not isinstance(g, Group):  # group may be passed positionally
             g = next((a for a in args if isinstance(a, Group)), None)
         gid = g.id if isinstance(g, Group) else 0
+        want_flight = _flight.enabled()
+        nbytes = 0
+        if _om.enabled() or want_flight:
+            nbytes = _payload_bytes(opname, args)
         if _om.enabled():
             _M_coll_calls.inc(op=opname)
-            nbytes = _payload_bytes(opname, args)
             if nbytes:
                 _M_coll_bytes.inc(nbytes, op=opname)
+        if not want_flight:
+            with collective_span(f"{opname}(group={gid})"):
+                return fn(*args, **kwargs)
+        # flight trail: op, payload bytes, host-observed duration — the
+        # T3 overlap-efficiency input (ROADMAP item 3). NOTE duration is
+        # dispatch-to-return on the host; device completion may lag.
+        t0 = _time.perf_counter()
         with collective_span(f"{opname}(group={gid})"):
-            return fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
+        _flight.record(
+            "collective", opname, group=gid, bytes=nbytes,
+            dur_us=round((_time.perf_counter() - t0) * 1e6, 1))
+        return out
     return wrapper
 
 

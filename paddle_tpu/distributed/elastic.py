@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..observability import flight as _flight
+
 __all__ = ["ElasticManager", "ELASTIC_RESTART_CODE", "ELASTIC_EXIT_CODE"]
 
 ELASTIC_RESTART_CODE = 101  # ref: elastic/manager.py:33
@@ -160,9 +162,12 @@ class ElasticManager:
             # raises (and the resilient wrapper absorbs it), the next
             # scans still see a changed set, re-debounce, and re-fire —
             # the membership change cannot be silently lost
+            my = alive.index(self.node_id) \
+                if self.node_id in alive else -1
+            _flight.record("elastic", "membership_change",
+                           n_alive=len(alive), my_index=my,
+                           was=len(self._known or ()))
             if self.on_membership_change is not None:
-                my = alive.index(self.node_id) \
-                    if self.node_id in alive else -1
                 self.on_membership_change(alive, my)
             self._known = alive
             return alive
@@ -186,7 +191,12 @@ class ElasticManager:
                 except Exception:  # noqa: BLE001 — bounded tolerance
                     failures += 1
                     self.store_faults_survived += 1
+                    _flight.record("elastic", "store_fault",
+                                   node=self.node_id, streak=failures)
                     if failures >= self.MAX_CONSECUTIVE_FAILURES:
+                        _flight.record("elastic", "thread_gave_up",
+                                       node=self.node_id,
+                                       after=failures)
                         return  # store gone for good: the job is ending
 
         for step in (self._heartbeat_once, self._watch_tick):

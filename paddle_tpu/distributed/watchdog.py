@@ -17,6 +17,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..observability import flight as _flight
 from ..observability import metrics as _om
 
 __all__ = ["Watchdog", "WatchdogTimeout", "WatchdogBusy",
@@ -30,6 +31,17 @@ _M_span_s = _om.histogram(
     "Completed watchdog span durations (collectives, steps) by name")
 _M_timeouts = _om.counter(
     "watchdog.timeouts_total", "Spans/steps that exceeded the timeout")
+
+
+def _flight_dump(note: str):
+    """A hung collective/step must leave forensics behind, not just a
+    counter bump: freeze the flight ring next to the host-trace dump
+    (counted in observability.dumps_total{trigger="watchdog"}).
+    Best-effort — a failing dump must not mask the timeout itself."""
+    try:
+        return _flight.dump(trigger="watchdog", note=note)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 class WatchdogTimeout(RuntimeError):
@@ -128,13 +140,18 @@ class Watchdog:
                             continue
                         entry[2] = True  # flag in place; span stays open
                     _M_timeouts.inc()
+                    _flight.record("watchdog", "timeout", span=name,
+                                   open_s=round(age, 1))
                     dump = self._dump_trace()
+                    fdump = _flight_dump(
+                        f"span {name!r} open {age:.0f}s")
                     self.timed_out_spans.append((name, age, dump))
                     import sys
                     sys.stderr.write(
                         f"[watchdog] operation {name!r} exceeded "
                         f"{self.timeout:.0f}s (open {age:.0f}s)"
                         + (f"; trace dumped to {dump}" if dump else "")
+                        + (f"; flight dump {fdump}" if fdump else "")
                         + "\n")
                     if self.on_timeout is not None:
                         try:
@@ -196,7 +213,11 @@ class Watchdog:
         if not done.wait(self.timeout):
             self._stuck_thread = t
             _M_timeouts.inc()
+            _flight.record("watchdog", "timeout", task=task_id,
+                           timeout_s=self.timeout)
             dump = self._dump_trace()
+            fdump = _flight_dump(f"step {task_id} exceeded "
+                                 f"{self.timeout:.0f}s")
             abort_err = None
             if self.on_timeout is not None:
                 try:
@@ -207,6 +228,7 @@ class Watchdog:
                 f"step {task_id} exceeded {self.timeout:.0f}s "
                 f"(started {time.monotonic() - start:.0f}s ago)"
                 + (f"; host trace dumped to {dump}" if dump else "")
+                + (f"; flight dump {fdump}" if fdump else "")
                 + (f"; on_timeout callback itself failed: {abort_err!r}"
                    if abort_err is not None else "")) from abort_err
         if "error" in result:

@@ -41,6 +41,7 @@ import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.flags import define_flag, flag_value
+from ..observability import flight as _flight
 from ..observability import metrics as _om
 from ..utils import fault_injection as _fi
 from .io import _TensorPayload, _pack, _unpack
@@ -181,7 +182,10 @@ def _persist_packed(packed, path: str, protocol: int = 4) -> int:
         _fsync_dir(d)
     _M_saves.inc()
     _M_bytes.inc(len(blob))
-    _M_save_s.observe(_time.perf_counter() - t0)
+    dt = _time.perf_counter() - t0
+    _M_save_s.observe(dt)
+    _flight.record("checkpoint", "save", path=os.path.basename(path),
+                   bytes=len(blob), dur_ms=round(dt * 1e3, 1))
     return len(blob)
 
 
@@ -215,6 +219,8 @@ def load_checkpoint(path: str, return_numpy: bool = False,
                 f"{path}: {len(bad)} corrupt tensor(s): "
                 + "; ".join(bad[:4]))
     _M_loads.inc()
+    _flight.record("checkpoint", "restore",
+                   path=os.path.basename(path), version=version)
     return _unpack(packed, return_numpy=return_numpy)
 
 
@@ -389,6 +395,8 @@ class CheckpointManager:
             with self._lock:
                 self._stats["corrupt_skipped"] += 1
             _M_corrupt.inc()
+            _flight.record("checkpoint", "corrupt_fallback",
+                           path=os.path.basename(path), where="latest")
         return None
 
     def _step_of(self, path: str) -> int:
@@ -412,6 +420,10 @@ class CheckpointManager:
                 with self._lock:
                     self._stats["corrupt_skipped"] += 1
                 _M_corrupt.inc()
+                _flight.record(
+                    "checkpoint", "corrupt_fallback",
+                    path=os.path.basename(self._path(step)),
+                    where="restore")
                 continue
             return step, obj
         return None

@@ -29,6 +29,8 @@ _build_observer = None
 
 
 def _notify_build(kind: str) -> None:
+    from ..observability import flight as _flight
+    _flight.record("jit", "build", kind=kind)
     obs = _build_observer
     if obs is not None:
         obs(kind)

@@ -2,9 +2,12 @@
 
 One process-wide metrics registry (``Counter`` / ``Gauge`` /
 ``Histogram``, kill-switchable via ``FLAGS_metrics``, default on) that
-every subsystem registers into at import time, plus a step-timeline
+every subsystem registers into at import time, a step-timeline
 plane (``timeline.StepTimer``) whose counter events merge into
-``profiler.export_chrome_tracing``.
+``profiler.export_chrome_tracing``, and an always-on flight recorder
+(``flight``: bounded black-box event journal + crash-forensics dumps,
+``FLAGS_flight_recorder``; see ``python -m paddle_tpu.observability
+--flight``).
 
 Quick tour::
 
@@ -33,12 +36,13 @@ from .metrics import (  # noqa: F401
     register_collector, snapshot, render_prometheus,
 )
 from .timeline import StepTimer  # noqa: F401
+from . import flight  # noqa: F401  (after metrics/timeline: it uses both)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Scope",
     "DEFAULT_BUCKETS", "counter", "gauge", "histogram", "scope",
     "default_registry", "enabled", "register_collector", "snapshot",
-    "render_prometheus", "StepTimer", "metrics", "timeline",
+    "render_prometheus", "StepTimer", "metrics", "timeline", "flight",
     "start_metrics_server",
 ]
 

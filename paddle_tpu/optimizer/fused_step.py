@@ -57,6 +57,7 @@ import jax.numpy as jnp
 
 from ..core.flags import _registry as _flag_registry
 from ..core.tensor import Tensor, buffer_has_alias as _has_alias
+from ..observability import flight as _flight
 from ..observability import metrics as _om
 from ..utils.clip_grad import clip_by_spec, clip_spec
 
@@ -127,6 +128,7 @@ def clear_cache() -> None:
 
 def _fallback(reason: str):
     _M_fallbacks.inc(reason=reason)
+    _flight.record("optimizer", "fallback", reason=reason)
     return None
 
 
@@ -422,6 +424,8 @@ def _execute(opt, prep, mode, scalars):
         donate=(0, 1, 2) if mode == "scaled" else (0, 2))
     if kind == "jit":
         _flush_pending_chains()
+        _flight.record("optimizer", "fused_step", mode=mode,
+                       params=len(prep.params))
         if _donation_observer is not None:
             _donation_observer(opt, prep, mode)
     # populate the trace cell only for the duration of the call: a

@@ -180,24 +180,32 @@ class Profiler:
 def export_chrome_tracing(path: str, worker_name=None):
     """Write the host plane as chrome://tracing JSON
     (ref: chrometracing_logger.cc), merged with the step-timeline
-    plane: every live ``observability.timeline.StepTimer``'s per-step
-    phase counter events (``"ph": "C"``) land in the same file, so one
-    trace carries spans AND metric time series (chrome://tracing /
-    Perfetto render counters as stacked area tracks)."""
+    plane — every live ``observability.timeline.StepTimer``'s per-step
+    phase counter events (``"ph": "C"``) — and the flight recorder's
+    event trail (``observability.flight``, instant events ``"ph": "i"``)
+    so ONE file carries spans, metric time series AND the last-N
+    black-box events (chrome://tracing / Perfetto render counters as
+    stacked area tracks and instants as marks)."""
     if _lib is None:
         raise RuntimeError("native tracer unavailable")
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     dump = _lib.tracer_dump()
+    extra = []
     try:
         from .observability import timeline as _timeline
-        counters = _timeline.chrome_events()
+        extra.extend(_timeline.chrome_events())
     except Exception:
-        counters = []
-    if counters:
+        pass
+    try:
+        from .observability import flight as _flight
+        extra.extend(_flight.chrome_events())
+    except Exception:
+        pass
+    if extra:
         data = json.loads(dump)
-        data.setdefault("traceEvents", []).extend(counters)
+        data.setdefault("traceEvents", []).extend(extra)
         dump = json.dumps(data)
     with open(path, "w") as f:
         f.write(dump)

@@ -28,6 +28,7 @@ slots / (weight_bytes / HBM_BW).
 """
 from __future__ import annotations
 
+import itertools
 import queue as _queue
 import threading
 import time
@@ -37,7 +38,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .observability import flight as _flight
 from .observability import metrics as _om
+from .utils import fault_injection as _fi
 
 __all__ = ["LlamaDecodeEngine", "GenerationServer"]
 
@@ -61,6 +64,19 @@ _M_token_s = _M.histogram(
 _G_queue = _M.gauge("queue_depth",
                     "Requests waiting in the submission queue")
 _G_inflight = _M.gauge("in_flight", "Requests currently holding a slot")
+# queue-vs-decode latency split (the admission/load-shedding evidence:
+# queue_seconds growing while decode_seconds holds means shed load)
+_M_queue_s = _M.histogram(
+    "queue_seconds", "Submit-to-admission wall time per request")
+_M_decode_s = _M.histogram(
+    "decode_seconds",
+    "Admission-to-completion wall time per request (prefill + decode)")
+
+# process-unique request trace ids: every lifecycle event of a request
+# carries one, so a flight dump (or GenerationServer.trace) replays a
+# single request's submit -> queued -> admitted -> decode -> terminal
+# trail even across servers
+_REQ_SEQ = itertools.count(1)
 
 
 def _quantize_w(w_t):
@@ -487,22 +503,34 @@ class GenerationServer:
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                deadline: Optional[float] = None) -> dict:
         """Enqueue a request. ``deadline`` (seconds from now) bounds its
-        total wall time; None = unbounded."""
+        total wall time; None = unbounded. The returned dict carries
+        ``trace_id`` — the key of this request's flight-recorder
+        lifecycle trail (see :meth:`trace`)."""
+        trace_id = f"req-{next(_REQ_SEQ)}"
+        _flight.record("serving", "submit", trace_id=trace_id,
+                       max_new=int(max_new_tokens))
         if self._stopping.is_set():
             self.rejected += 1
             _M_rejected.inc()
+            _flight.record("serving", "rejected", trace_id=trace_id,
+                           reason="shutting_down")
             raise RuntimeError(
                 "GenerationServer is shutting down; new submissions are "
                 "rejected (in-flight requests are draining)")
         if int(max_new_tokens) < 1:
+            _flight.record("serving", "rejected", trace_id=trace_id,
+                           reason="invalid_max_new")
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
                 f"(prefill always produces the first token)")
         if deadline is not None and deadline <= 0:
+            _flight.record("serving", "rejected", trace_id=trace_id,
+                           reason="invalid_deadline")
             raise ValueError(f"deadline must be > 0, got {deadline}")
         req = {"prompt": np.asarray(prompt_ids, np.int32).reshape(-1),
                "max_new": int(max_new_tokens), "out": [],
                "done": threading.Event(), "error": None,
+               "trace_id": trace_id,
                "t0": time.monotonic(),
                "expires": (time.monotonic() + deadline
                            if deadline is not None else None)}
@@ -510,10 +538,14 @@ class GenerationServer:
             if self._stopping.is_set():
                 self.rejected += 1
                 _M_rejected.inc()
+                _flight.record("serving", "rejected", trace_id=trace_id,
+                               reason="shutting_down")
                 raise RuntimeError(
                     "GenerationServer is shutting down; new submissions "
                     "are rejected (in-flight requests are draining)")
             self._q.put(req)
+        _flight.record("serving", "queued", trace_id=trace_id,
+                       prompt_len=int(req["prompt"].shape[0]))
         return req
 
     def generate(self, prompt_ids, max_new_tokens: int = 32,
@@ -534,19 +566,35 @@ class GenerationServer:
         req["error"] = error
         req["done"].set()
         _M_failed.inc()
+        _flight.record(
+            "serving",
+            "expired" if isinstance(error, TimeoutError) else "failed",
+            trace_id=req.get("trace_id"), error=type(error).__name__,
+            tokens=len(req["out"]))
         self._observe_done(req)
 
     @staticmethod
     def _observe_done(req) -> None:
         """Request-completion telemetry: tokens delivered (partial counts
         too — a deadline-failed request keeps its tokens) + wall time +
-        per-token latency."""
+        per-token latency, plus the queue/decode latency split."""
         tokens = len(req["out"])
         if tokens:
             _M_tokens.inc(tokens)
-        dt = time.monotonic() - req["t0"]
+        now = time.monotonic()
+        dt = now - req["t0"]
         _M_req_s.observe(dt)
         _M_token_s.observe(dt / max(tokens, 1))
+        t_admit = req.get("t_admit")
+        if t_admit is not None:
+            _M_decode_s.observe(now - t_admit)
+        else:
+            # never admitted (deadline expired / cancelled while
+            # queued): its whole life WAS queue time. Without this the
+            # histogram only sees survivors — under the very overload
+            # the metric exists to expose, the starved majority would
+            # be censored and queue_seconds would stay low
+            _M_queue_s.observe(dt)
 
     def _admit_one(self, req, slot) -> None:
         eng = self.engine
@@ -558,6 +606,13 @@ class GenerationServer:
             self._fail(req, TimeoutError(
                 "request deadline expired while queued"))
             return
+        # stamp admission BEFORE prefill: queue_seconds is the pure
+        # submit->admission wait and decode_seconds covers prefill +
+        # decode (slow prefill must not masquerade as queueing — the
+        # load-shedding signal would point at admission when the real
+        # cost is the model)
+        req["t_admit"] = time.monotonic()
+        _M_queue_s.observe(req["t_admit"] - req["t0"])
         try:
             first = eng.prefill(slot, req["prompt"])
         except Exception as e:  # noqa: BLE001 — surfaced per request
@@ -567,6 +622,8 @@ class GenerationServer:
         self._slots[slot] = req
         self.admitted += 1
         _M_admitted.inc()
+        _flight.record("serving", "admitted",
+                       trace_id=req.get("trace_id"), slot=slot)
         self._finish_if_done(slot, req)
 
     def _free_slots(self):
@@ -597,6 +654,9 @@ class GenerationServer:
             eng.release(slot)
             del self._slots[slot]
             req["done"].set()
+            _flight.record("serving", "finished",
+                           trace_id=req.get("trace_id"),
+                           tokens=len(req["out"]))
             self._observe_done(req)
         return done
 
@@ -646,12 +706,22 @@ class GenerationServer:
                         continue
                     self._admit_one(req, self._free_slots()[0])
                     continue
+                # fault-injection site: a kill-point armed here
+                # simulates a crash mid-decode — the loop thread dies
+                # (KillPoint is a BaseException) and the flight
+                # recorder's threading.excepthook dump carries every
+                # in-flight request's lifecycle trail
+                _fi.fire("serving.decode")
                 nxt = self.engine.step()
                 self.steps_run += 1
                 _M_steps.inc()
                 for slot in list(self._slots):
                     req = self._slots[slot]
                     req["out"].append(int(nxt[slot]))
+                    _flight.record("serving", "decode",
+                                   trace_id=req.get("trace_id"),
+                                   step=self.steps_run,
+                                   tokens=len(req["out"]))
                     self._finish_if_done(slot, req)
                 self._expire_active()
                 self._expire_queued()
@@ -660,6 +730,8 @@ class GenerationServer:
                 # in-flight
                 self._set_gauges()
             except Exception as e:  # noqa: BLE001 — fail loudly, stay up
+                _flight.record("serving", "loop_error",
+                               error=type(e).__name__)
                 for slot, req in list(self._slots.items()):
                     self._fail(req, e)
                     self.engine.release(slot)
@@ -703,6 +775,17 @@ class GenerationServer:
             finally:
                 self._metrics_server = None
         return drained
+
+    @staticmethod
+    def trace(request_id) -> List[dict]:
+        """The flight-recorder lifecycle trail of ONE request — submit,
+        queued, admitted, per-step decode, finished/expired/failed —
+        live from the in-process ring (a crash dump carries the same
+        events). ``request_id`` is the ``trace_id`` string or the req
+        dict :meth:`submit` returned."""
+        tid = (request_id.get("trace_id")
+               if isinstance(request_id, dict) else request_id)
+        return _flight.events(trace_id=tid)
 
     def stats(self) -> Dict[str, int]:
         with self._q.mutex:  # don't count _STOP sentinels as work

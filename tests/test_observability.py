@@ -271,6 +271,79 @@ class TestPrometheus:
         _parse_exposition(obs.render_prometheus())
 
 
+class TestPrometheusEdgeCases:
+    """Exposition corners the mini-parser didn't pin before ISSUE 8:
+    hostile label values and the histogram +Inf/_count invariant
+    across labeled, multi-label and empty cells."""
+
+    def test_backslash_and_trailing_backslash_label_values(self):
+        r = Registry()
+        c = r.counter("edge.total")
+        c.inc(path="C:\\tmp\\x")       # interior backslashes
+        c.inc(path="trailing\\")       # a trailing backslash must not
+        c.inc(path='quote"inside')     # escape the closing quote
+        c.inc(path="multi\nline\\mix\"")
+        text = r.render_prometheus()
+        samples = _parse_exposition(text)  # every line stays valid
+        assert len(samples["edge_total"]) == 4
+        # escaping is per spec: \ -> \\, newline -> \n, " -> \"
+        assert 'path="C:\\\\tmp\\\\x"' in text
+        assert 'path="trailing\\\\"' in text
+        assert 'path="quote\\"inside"' in text
+        assert 'path="multi\\nline\\\\mix\\""' in text
+        assert "\n\n" not in text  # no raw newline leaked into a line
+
+    def test_label_roundtrip_distinct_cells(self):
+        """Two values that would collide if escaping were sloppy
+        ('a\\' + 'b' vs 'a' + '\\b') must render as distinct series."""
+        r = Registry()
+        c = r.counter("collide.total")
+        c.inc(2, k="a\\", j="b")
+        c.inc(3, k="a", j="\\b")
+        samples = _parse_exposition(r.render_prometheus())
+        vals = sorted(v for _, v in samples["collide_total"])
+        assert vals == [2.0, 3.0]
+        labels = {lbl for lbl, _ in samples["collide_total"]}
+        assert len(labels) == 2
+
+    def test_labeled_histogram_inf_bucket_equals_count(self):
+        """For EVERY cell of a labeled histogram: the cumulative +Inf
+        bucket == its _count, and bucket counts are monotone within
+        that cell (the invariant scrapers rely on for quantiles)."""
+        r = Registry()
+        h = r.histogram("lab.seconds", buckets=[0.01, 1.0])
+        for v, phase in [(0.005, "fwd"), (0.5, "fwd"), (50.0, "fwd"),
+                         (2.0, "bwd")]:
+            h.observe(v, phase=phase)
+        samples = _parse_exposition(r.render_prometheus())
+        counts = {lbl: v for lbl, v in samples["lab_seconds_count"]}
+        for phase, expect in [("fwd", 3.0), ("bwd", 1.0)]:
+            cell = [(lbl, v) for lbl, v in samples["lab_seconds_bucket"]
+                    if f'phase="{phase}"' in lbl]
+            vals = [v for _, v in cell]
+            assert vals == sorted(vals), "per-cell buckets monotone"
+            inf = [v for lbl, v in cell if 'le="+Inf"' in lbl]
+            assert inf == [expect]
+            (count_lbl,) = [lbl for lbl in counts
+                            if f'phase="{phase}"' in lbl]
+            assert counts[count_lbl] == expect
+            # every bucket line carries BOTH the cell label and le
+            assert all('le="' in lbl for lbl, _ in cell)
+
+    def test_empty_histogram_renders_consistent_zero_series(self):
+        """A registered-but-never-observed histogram still exposes a
+        full bucket ladder with +Inf == _count == 0 (scrapers must see
+        the series exist, not a hole)."""
+        r = Registry()
+        r.histogram("never.seconds", buckets=[0.1, 1.0])
+        samples = _parse_exposition(r.render_prometheus())
+        assert samples["never_seconds_count"] == [("", 0.0)]
+        assert samples["never_seconds_sum"] == [("", 0.0)]
+        buckets = samples["never_seconds_bucket"]
+        assert [v for _, v in buckets] == [0.0, 0.0, 0.0]
+        assert any('le="+Inf"' in lbl for lbl, _ in buckets)
+
+
 # ---------------------------------------------------------------------------
 # /metrics HTTP endpoint
 # ---------------------------------------------------------------------------
