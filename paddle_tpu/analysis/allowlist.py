@@ -42,8 +42,10 @@ ALLOWLIST = [
      "LBFGS line search branches on the loss value by contract; the "
      "optimizer opts out of fusion (_fusable_step=False)"),
     ("PTL001", "paddle_tpu/hapi/model.py",
-     "Model.fit/eval log contract returns host floats per batch — one "
-     "deliberate sync per step, attributed by the capture report"),
+     "predict/summary host conversions by contract; the train/eval "
+     "loss fetch is HOISTED to the fit/evaluate log boundary (lazy "
+     "device loss, Fusion III) so the step hot path itself is "
+     "sync-free"),
     ("PTL001", "paddle_tpu/hapi/callbacks.py",
      "VisualDL/metric logging is host-side by nature"),
     ("PTL001", "paddle_tpu/io/sampler.py",
@@ -107,11 +109,10 @@ ALLOWLIST = [
 # CAPTURE-BOUNDARY decision the Fusion III plan reads as
 # "capture-compatible, by design".
 CAPTURE_ALLOWLIST = [
-    ("PTC003", "paddle_tpu/hapi/model.py*",
-     "the known hapi loss fetch: Model.fit/eval's log contract returns "
-     "host floats per batch — already maximally hoisted (train_batch "
-     "fetches after backward+step); whole-step capture absorbs it by "
-     "fetching OUTSIDE the captured region (ROADMAP item 1)"),
+    # (the historical hapi loss-fetch PTC003 entry is GONE: Fusion III
+    # hoisted the fetch out of train_batch/eval_batch — they return a
+    # lazy device loss and fit/evaluate fetch at the log boundary, so
+    # the step functions now scan clean with no exception needed)
     ("PTC002", "paddle_tpu/serving.py*",
      "slot bookkeeping (pos/last_ids) advances BETWEEN captured decode "
      "programs by design: the jitted _decode_impl is the capture "

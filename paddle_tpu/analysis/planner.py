@@ -63,6 +63,10 @@ _ABSORBED = {
            "capture has no per-chain cap",
     "grad_leaf": "absorbed: stop_gradient re-leafing is resolved at "
                  "trace time",
+    "sot_capture": "absorbed: the segment handoff INTO a captured "
+                   "whole-step executable — pending eager chains flush "
+                   "at the capture boundary by design "
+                   "(fusion.capture_handoff)",
 }
 
 

@@ -67,7 +67,7 @@ from ..observability import metrics as _om
 
 __all__ = ["stats", "reset_stats", "clear_cache", "register_impl",
            "register_param_impl", "enabled", "materialize_tensor",
-           "boundary_reason", "infer_output_aval"]
+           "boundary_reason", "infer_output_aval", "capture_handoff"]
 
 _INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31
 
@@ -712,14 +712,32 @@ def has_pending() -> bool:
     return len(_pending_tensors) > 0
 
 
-def flush_pending(reason: str = "donation") -> None:
+def flush_pending(reason: str = "donation") -> int:
     """Flush EVERY pending chain. Called by buffer-donation sites
     (fused optimizer step, AMP batched unscale) so no deferred program
-    can later read a buffer XLA just invalidated."""
+    can later read a buffer XLA just invalidated. Returns the number
+    of chains flushed."""
+    n = 0
     for t in list(_pending_tensors.values()):
         _pending_tensors.pop(id(t), None)
         if t._lazy is not None:
             materialize_tensor(t, reason)
+            n += 1
+    return n
+
+
+def capture_handoff() -> int:
+    """Whole-step capture boundary (jit/sot.py): flush every pending
+    eager chain with reason ``sot_capture`` before a captured
+    executable donates its inputs — a deferred chain may have snapshot
+    buffers the donation is about to invalidate. These flushes are the
+    segment handoff INTO the captured program, so the capture planner
+    classifies the ``sot_capture`` reason capture-compatible (it is the
+    capture boundary, not a break). Returns the number of chains
+    flushed; a steady-state captured step flushes zero."""
+    if not _pending_tensors:
+        return 0
+    return flush_pending("sot_capture")
 
 
 def materialize_tensor(t, reason: str = "host_read") -> None:

@@ -26,6 +26,16 @@ def _to_tensor(x):
     return Tensor(jnp.asarray(np.asarray(x)))
 
 
+def _mean_loss(losses):
+    """Mean of a list of lazy 0-d loss Tensors (or floats) with ONE
+    device->host transfer: the scalars stack on device and fetch as a
+    single array — not one round-trip per step at the epoch boundary."""
+    import jax.numpy as jnp
+    vals = [v._data.astype(jnp.float32) if isinstance(v, Tensor)
+            else jnp.float32(v) for v in losses]
+    return float(np.asarray(jnp.stack(vals)).mean())
+
+
 def _as_batches(data, batch_size, shuffle, drop_last=False):
     """Accepts DataLoader / Dataset / (x, y) arrays; yields (ins, labels)
     pairs."""
@@ -64,6 +74,7 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics: List = []
+        self._captured = None  # SOT whole-step capture engine (lazy)
         self.stop_training = False
 
     # -- configuration -------------------------------------------------------
@@ -73,19 +84,45 @@ class Model:
         self._loss = loss
         ms = metrics or []
         self._metrics = list(ms) if isinstance(ms, (list, tuple)) else [ms]
+        self._captured = None  # new loss/optimizer: stale programs out
         return self
+
+    def _capture_engine(self):
+        """The SOT whole-step engine behind train_batch/eval_batch: one
+        cached, donated executable per signature. Falls back to the
+        eager path (returns None from step/forward) on the
+        FLAGS_sot_capture kill switch or any gate reason."""
+        if self._captured is None:
+            from ..jit.sot import CapturedStep
+            self._captured = CapturedStep(
+                self.network, self._loss, self._optimizer,
+                mean_reduce=True, name="hapi.step",
+                build_kind="captured_step")
+        return self._captured
 
     # -- single-batch ops ----------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
-        """ref: model.py train_batch — one fwd/bwd(/step) on a batch."""
+        """ref: model.py train_batch — one fwd/bwd(/step) on a batch.
+
+        Returns ``[loss]`` where ``loss`` is a LAZY 0-d device Tensor:
+        the hot path never fetches it (the PTC003 hoist the capture
+        plan prescribed) — ``fit`` and the logging callbacks convert at
+        the log boundary via ``float(loss)``. In steady state the whole
+        fwd+bwd+optimizer step runs as ONE captured, buffer-donated
+        executable (``FLAGS_sot_capture=0`` restores per-chain eager
+        fusion)."""
         self.network.train()
         ins = inputs if isinstance(inputs, (tuple, list)) else [inputs]
         ins = [_to_tensor(i) for i in ins]
+        lbl = labels if isinstance(labels, (tuple, list)) else [labels]
+        lbl = [_to_tensor(v) for v in lbl if v is not None]
+        if update and self._optimizer is not None:
+            loss = self._capture_engine().step(ins, lbl)
+            if loss is not None:
+                return [loss]
         out = self.network(*ins)
         loss = out
         if self._loss is not None:
-            lbl = labels if isinstance(labels, (tuple, list)) else [labels]
-            lbl = [_to_tensor(v) for v in lbl if v is not None]
             loss = self._loss(out, *lbl)
         if loss._data.ndim > 0:
             loss = loss.mean()
@@ -93,21 +130,30 @@ class Model:
         if update and self._optimizer is not None:
             self._optimizer.step()
             self._optimizer.clear_grad()
-        return [float(loss.item())]
+        return [loss]
 
     def eval_batch(self, inputs, labels=None):
+        """One eval forward; ``outs['loss']`` is a lazy device Tensor
+        (fetch at the log boundary), the forward+loss runs captured in
+        steady state."""
         self.network.eval()
         ins = inputs if isinstance(inputs, (tuple, list)) else [inputs]
         ins = [_to_tensor(i) for i in ins]
-        out = self.network(*ins)
+        lbl = labels if isinstance(labels, (tuple, list)) else [labels]
+        lbl = [_to_tensor(v) for v in lbl if v is not None]
+        out = loss = None
+        res = self._capture_engine().forward(ins, lbl)
+        if res is not None:
+            out, loss = res
+        else:
+            out = self.network(*ins)
+            if self._loss is not None and labels is not None:
+                loss = self._loss(out, *lbl)
+                if loss._data.ndim > 0:
+                    loss = loss.mean()
         outs = {}
-        if self._loss is not None and labels is not None:
-            lbl = labels if isinstance(labels, (tuple, list)) else [labels]
-            lbl = [_to_tensor(v) for v in lbl if v is not None]
-            loss = self._loss(out, *lbl)
-            if loss._data.ndim > 0:
-                loss = loss.mean()
-            outs["loss"] = float(loss.item())
+        if loss is not None:
+            outs["loss"] = loss
         if labels is not None:
             for m in self._metrics:
                 lbl0 = labels[0] if isinstance(labels, (tuple, list)) \
@@ -144,9 +190,11 @@ class Model:
                                 drop_last)):
                 cbks.on_train_batch_begin(step)
                 loss = self.train_batch(ins, lbl)
-                losses.append(loss[0])
+                losses.append(loss[0])  # lazy device scalars
                 cbks.on_train_batch_end(step, {"loss": loss[0]})
-            logs = {"loss": float(np.mean(losses)) if losses else None}
+            # THE log boundary: one batched fetch per epoch, not one
+            # per step — the captured hot path stays sync-free
+            logs = {"loss": _mean_loss(losses) if losses else None}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_data,
                                           batch_size=batch_size,
@@ -173,11 +221,11 @@ class Model:
             cbks.on_eval_batch_begin(step)
             outs = self.eval_batch(ins, lbl)
             if "loss" in outs:
-                losses.append(outs["loss"])
+                losses.append(outs["loss"])  # lazy device scalars
             cbks.on_eval_batch_end(step, outs)
         logs = {}
-        if losses:
-            logs["loss"] = float(np.mean(losses))
+        if losses:  # the eval log boundary fetches, not the hot loop
+            logs["loss"] = _mean_loss(losses)
         for m in self._metrics:
             nm = m.name()
             logs[nm[0] if isinstance(nm, (list, tuple)) else nm] = \

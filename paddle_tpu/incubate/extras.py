@@ -167,9 +167,14 @@ class LookAhead:
         return self.inner_optimizer._parameter_list
 
     def step(self):
+        # slow weights are HELD snapshots: they must never alias a
+        # param buffer, because the fused/captured optimizer step
+        # DONATES param buffers to XLA (deleted after the update) —
+        # an aliased slow weight would be read-after-free on the next
+        # sync point
         if not self._slow:
             for p in self._params():
-                self._slow[id(p)] = p._data
+                self._slow[id(p)] = jnp.copy(p._data)
         self.inner_optimizer.step()
         self._step_count += 1
         if self._step_count % self.k == 0:
@@ -179,7 +184,7 @@ class LookAhead:
                             (p._data.astype(jnp.float32) -
                              slow.astype(jnp.float32))).astype(p._data.dtype)
                 self._slow[id(p)] = new_slow
-                p._data = new_slow
+                p._data = jnp.copy(new_slow)
 
     def minimize(self, loss, *args, **kwargs):
         loss.backward()
@@ -222,9 +227,12 @@ class ModelAverage:
                      self._num_updates * self.average_window_rate)
         if (self._num_accumulates >= self.min_average_window
                 and self._num_accumulates >= window):
-            # restart the window: keep only the latest value
+            # restart the window: keep only the latest value. jnp.array
+            # (not astype) forces a COPY: astype on an f32 param is the
+            # identity, and an aliased sum would be deleted under us by
+            # the next donating (fused/captured) optimizer step
             for p in self._params:
-                self._sum[id(p)] = p._data.astype(jnp.float32)
+                self._sum[id(p)] = jnp.array(p._data, jnp.float32)
             self._num_accumulates = 1
         else:
             for p in self._params:

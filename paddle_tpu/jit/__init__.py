@@ -11,3 +11,4 @@ from .api import to_static, functionalize, TrainStep, save, load, not_to_static 
 from .api import ignore_module, TranslatedLayer, enable_to_static  # noqa: F401
 from .api import set_code_level, set_verbosity  # noqa: F401
 from .sot import sot_compile, SOTFunction, BucketPolicy  # noqa: F401
+from .sot import capture, CapturedStep, capture_jit  # noqa: F401

@@ -272,150 +272,44 @@ class TrainStep:
         loss = step(x, y)          # tensors or numpy
 
     loss_fn(outputs, *labels) -> scalar Tensor.
+
+    Since Fusion III this is a thin wrapper over the SOT whole-step
+    capture engine (``jit.sot.CapturedStep`` in non-strict mode: an
+    EXPLICIT whole-step API, so it always captures — no eager fallback,
+    no kill switch, unknown clip objects run un-clipped inside the
+    trace as before). ``hapi.Model.train_batch`` rides the same
+    machinery in strict mode (gated, compile-on-second-sighting).
+    Optimizer slot state now lives in ``optimizer._states`` (shared
+    with the eager/fused paths), so ``state_dict()`` round-trips cover
+    compiled training too.
     """
 
     def __init__(self, model, loss_fn, optimizer, donate=True):
+        from .sot import CapturedStep
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
-        self._swap = _Swap(model)
-        self._params = self._swap.params
-        self._opt_state = None
-        self._jitted = None
-        self._donate = donate
-        # device-resident RNG (root key + step counter) and lr cache:
-        # uploading a key or lr scalar every step costs a host->device
-        # transfer per step (measured ~3 ms/step over the test tunnel,
-        # ~6% of a ResNet-50 step)
-        self._rng = None
-        self._rng_epoch = None
-        self._lr_host = None
-        self._lr_dev = None
+        self._step = CapturedStep(
+            model, loss_fn, optimizer, cast_loss_f32=True,
+            donate=donate, strict=False, name="train_step",
+            build_kind="train_step")
 
-    def _init_opt_state(self):
-        state = {}
-        for k, p in self._params.items():
-            state[k] = self.optimizer._init_state(p)
-        return state
-
-    def _pure_clip(self, grads: Dict[str, Any]):
-        clip = self.optimizer._grad_clip
-        if clip is None:
-            return grads
-        from ..utils.clip_grad import clip_by_spec, clip_spec
-        spec = clip_spec(clip, exact=False)
-        if not spec:  # unknown clip object: un-clipped inside the trace
-            return grads
-        keys = list(grads)
-        clipped = clip_by_spec(spec, [grads[k] for k in keys])
-        return dict(zip(keys, clipped))
-
-    def _build(self):
-        _notify_build("train_step")
-        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
-        swap = self._swap
-        trainable = {k for k, p in self._params.items()
-                     if not p.stop_gradient}
-
-        def step_fn(params, buffers, opt_state, lr, rng, *batch):
-            root, count = rng
-            key = jax.random.fold_in(root, count)
-            train_p = {k: v for k, v in params.items() if k in trainable}
-            frozen_p = {k: v for k, v in params.items()
-                        if k not in trainable}
-
-            def loss_of(tp):
-                full = {**tp, **frozen_p}
-                with no_grad(), random_mod.key_stream(key):
-                    inputs = tuple(Tensor(b) for b in batch[:-1]) \
-                        if len(batch) > 1 else (Tensor(batch[0]),)
-                    labels = (Tensor(batch[-1]),) if len(batch) > 1 else ()
-                    (out, new_buffers) = swap.run(
-                        full, buffers, model.__call__, *inputs)
-                    loss_t = loss_fn(out, *labels) if labels else \
-                        loss_fn(out)
-                return loss_t._data.astype(jnp.float32), new_buffers
-
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_p)
-            grads = self._pure_clip(grads)
-            new_params = dict(params)
-            new_opt_state = dict(opt_state)
-            for k in trainable:
-                if hasattr(opt, "_current_pid"):
-                    opt._current_pid = id(self._params[k])
-                g_k = opt._apply_regularizer(params[k], grads[k])
-                new_p, new_s = opt._update(params[k], g_k,
-                                           opt_state[k], lr)
-                new_params[k] = new_p
-                new_opt_state[k] = new_s
-            return (loss, new_params, new_buffers, new_opt_state,
-                    (root, count + jnp.uint32(1)))
-
-        # buffers (argnum 1) are donated too: BN running stats are
-        # returned updated every step, and without aliasing XLA must
-        # copy them; __call__ rebinds each Tensor's _data afterwards
-        donate = (0, 1, 2, 4) if self._donate else ()
-        self._jitted = jax.jit(step_fn, donate_argnums=donate)
+    @staticmethod
+    def _split(batch):
+        if len(batch) > 1:
+            return list(batch[:-1]), [batch[-1]]
+        return list(batch), []
 
     def compile_stats(self, *batch):
         """Compile the step for these batch shapes without running it and
         return XLA's per-device memory analysis (same contract as
         DistTrainStep.compile_stats; bench emits it as peak_hbm_bytes)."""
-        if self._jitted is None:
-            self._build()
-        if self._opt_state is None:
-            self._opt_state = self._init_opt_state()
-        raw = tuple(
-            _tree_unwrap(b) if isinstance(b, Tensor)
-            else b if isinstance(b, jax.Array)
-            else jnp.asarray(np.asarray(b)) for b in batch)
-        params = {k: t._data for k, t in self._params.items()}
-        buffers = {k: t._data for k, t in self._swap.buffers.items()}
-        probe_rng = (jax.random.key(0), jnp.uint32(0))
-        return self._jitted.lower(
-            params, buffers, self._opt_state, jnp.float32(0.0),
-            probe_rng, *raw).compile().memory_analysis()
+        ins, lbls = self._split(batch)
+        return self._step.compile_stats(ins, lbls)
 
     def __call__(self, *batch):
-        if self._jitted is None:
-            self._build()
-        if self._opt_state is None:
-            self._opt_state = self._init_opt_state()
-        # device arrays pass through untouched — np.asarray on a jax.Array
-        # would round-trip the whole batch through the host every step
-        raw = tuple(
-            _tree_unwrap(b) if isinstance(b, Tensor)
-            else b if isinstance(b, jax.Array)
-            else jnp.asarray(np.asarray(b)) for b in batch)
-        params = {k: t._data for k, t in self._params.items()}
-        buffers = {k: t._data for k, t in self._swap.buffers.items()}
-        if self._rng is None or \
-                self._rng_epoch != random_mod.seed_epoch():
-            # ONE draw from the global stream seeds this step's
-            # device-side stream: distinct step objects stay on distinct
-            # streams, the stream follows paddle.seed, and a re-seed
-            # mid-run (epoch bump) re-derives it
-            self._rng = (random_mod.next_key(), jnp.uint32(0))
-            self._rng_epoch = random_mod.seed_epoch()
-        lr_now = float(self.optimizer.get_lr())
-        if self._lr_host != lr_now:
-            self._lr_dev = jnp.float32(lr_now)
-            self._lr_host = lr_now
-        loss, new_params, new_buffers, new_opt, self._rng = self._jitted(
-            params, buffers, self._opt_state, self._lr_dev, self._rng,
-            *raw)
-        for k, t in self._params.items():
-            t._data = new_params[k]
-        for k, t in self._swap.buffers.items():
-            t._data = new_buffers[k]
-        self._opt_state = new_opt
-        self.optimizer._global_step += 1
-        if isinstance(self.optimizer._learning_rate, object) and hasattr(
-                self.optimizer._learning_rate, "step") and not isinstance(
-                    self.optimizer._learning_rate, (int, float)):
-            pass  # schedulers are stepped by the user, matching paddle
-        return Tensor(loss)
+        ins, lbls = self._split(batch)
+        return self._step.step(ins, lbls)
 
 
 def save(layer, path, input_spec=None, **configs):

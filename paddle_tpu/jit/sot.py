@@ -48,13 +48,93 @@ from ..core import random as random_mod
 from ..core import tensor as tensor_mod
 from ..core import autograd as autograd_mod
 from ..core.flags import define_flag, flag_value
+from ..core.flags import _registry as _flag_registry
 from ..core.tensor import Tensor
+from ..observability import flight as _flight
+from ..observability import metrics as _om
 
-__all__ = ["sot_compile", "SOTFunction", "BucketPolicy"]
+__all__ = ["sot_compile", "SOTFunction", "BucketPolicy", "capture",
+           "CapturedStep", "capture_jit"]
 
 define_flag("sot_cache_size", 64,
             "Max (signature, guard-path) entries in a SOTFunction's "
             "compile cache (LRU eviction)")
+define_flag("sot_capture", True,
+            "Whole-step program capture (jit/sot.py): SOTFunction "
+            "replays recorded paths as compiled segments and "
+            "hapi.Model.train_batch/eval_batch + jit.TrainStep run as "
+            "ONE cached, buffer-donated executable. 0 is the kill "
+            "switch: every consumer falls back to today's per-chain "
+            "eager fusion, bit-for-bit")
+define_flag("sot_capture_cache", 8,
+            "Max captured whole-step executables per CapturedStep "
+            "(LRU eviction; one entry per input signature x "
+            "train/eval-mode x trainable-set x optimizer config)")
+define_flag("sot_guard_budget", 512,
+            "Max TOTAL guard bytes a recorded SOT path may validate "
+            "per replay (per-guard values are capped at 256B "
+            "separately); an over-budget recording stays eager with a "
+            "counted fallback reason")
+
+_capture_flag = _flag_registry["sot_capture"]
+_capture_cache_flag = _flag_registry["sot_capture_cache"]
+_guard_budget_flag = _flag_registry["sot_guard_budget"]
+
+# -- telemetry: the production counters a guard-miss storm is diagnosed
+# from (plus sot.* flight-recorder events for the black-box trail)
+_M = _om.scope("sot")
+_M_flag = _om.flag_info()
+_M_captured = _M.counter(
+    "captured_steps_total",
+    "Step executions served by a captured program — a successful "
+    "SOTFunction whole-path replay or one CapturedStep/capture_jit "
+    "donated executable call")
+_M_guard_miss = _M.counter(
+    "guard_misses_total",
+    "Replay guard validations that missed: the speculated tail was "
+    "discarded (side-effect-free) and the next candidate path or a "
+    "re-record served the call")
+_M_retraces = _M.counter(
+    "retraces_total",
+    "Calls where every cached candidate path missed its guards and "
+    "the branch was re-recorded (the trace tree grew)")
+_M_fallbacks = _M.counter(
+    "fallbacks_total",
+    "Recordings that stayed eager (per-chain fusion), by reason "
+    "(rng / mutation / backward / oversized_guard / guard_budget / "
+    "gate reasons from CapturedStep)")
+_M_seg_compiles = _M.counter(
+    "segment_compiles_total",
+    "SOT path segments jit-compiled (compile-on-second-replay; the "
+    "first replay of a path runs its segments un-jitted)")
+_M_step_compiles = _M.counter(
+    "captured_compiles_total",
+    "Whole-step captured programs built (CapturedStep signatures + "
+    "capture_jit first executions)")
+_M_hits = _M.counter(
+    "cache_hits_total",
+    "CapturedStep executions served by an already-built executable")
+
+
+def _fallback_category(why: str) -> str:
+    """Bounded-cardinality label for fallbacks_total: why_not strings
+    can embed per-call values (byte sizes), counters must not."""
+    if "RNG" in why:
+        return "rng"
+    if "mutation" in why:
+        return "mutation"
+    if "backward" in why:
+        return "backward"
+    if "guard budget" in why:
+        return "guard_budget"
+    if "guard limit" in why or "materialized" in why:
+        return "oversized_guard"
+    return "other"
+
+
+def _count_fallback(reason: str, name: str = "") -> None:
+    _M_fallbacks.inc(reason=reason)
+    _flight.record("sot", "fallback", reason=reason, fn=name)
 
 
 class BucketPolicy:
@@ -121,11 +201,13 @@ class _Op:
 
 
 class _Segment:
-    __slots__ = ("ops", "jitted", "input_ids", "ext_tensors", "output_ids")
+    __slots__ = ("ops", "jitted", "pure", "input_ids", "ext_tensors",
+                 "output_ids")
 
     def __init__(self):
         self.ops: List[_Op] = []
-        self.jitted = None
+        self.jitted = None   # built lazily: compile-on-second-replay
+        self.pure = None     # the un-jitted segment function
         self.input_ids: List[int] = []
         self.ext_tensors: List[Tensor] = []
         self.output_ids: List[int] = []
@@ -383,8 +465,10 @@ class _RecorderSession:
 # replay
 # ---------------------------------------------------------------------------
 
-def _compile_segment(seg: _Segment):
-    """Build one jitted callable: (ext_arrays, input_arrays) -> outputs."""
+def _segment_fn(seg: _Segment):
+    """Build one PURE callable: (ext_arrays, input_arrays) -> outputs.
+    Jitting is the caller's policy (compile-on-second-replay, like the
+    fusion plane's second-sighting rule)."""
     ops = seg.ops
     input_ids = list(seg.input_ids)
     output_ids = list(seg.output_ids)
@@ -407,7 +491,7 @@ def _compile_segment(seg: _Segment):
                 env[oid] = r
         return [env[o] for o in output_ids]
 
-    return jax.jit(seg_fn)
+    return seg_fn
 
 
 @jax.jit
@@ -430,17 +514,34 @@ def _pack_bytes(vals):
 
 
 class _CompiledPath:
-    """One guard path of one signature: compiled segments + guards."""
+    """One guard path of one signature: recorded segments + guards.
+    Segments compile LAZILY — the first replay runs them un-jitted
+    (one-off paths never pay XLA), the second replay jits each segment
+    once (``sot.segment_compiles_total`` + a flight event), and later
+    replays are fully compiled."""
 
-    def __init__(self, rec: _Recording, input_ids: List[int]):
+    def __init__(self, rec: _Recording, input_ids: List[int],
+                 name: str = ""):
         self.rec = rec
         self.input_ids = input_ids
+        self.name = name
+        self.replays = 0  # successful whole-path replays
         for seg in rec.segments:
-            seg.jitted = _compile_segment(seg)
+            seg.pure = _segment_fn(seg)
         # tail guard values (guard 0 is checked early, on its own),
         # concatenated once for the packed single-fetch validation
         self._tail_guard_bytes = b"".join(
             g.value for g in rec.guards[1:])
+
+    def _runner(self, seg: _Segment):
+        if self.replays < 1:
+            return seg.pure
+        if seg.jitted is None:
+            seg.jitted = jax.jit(seg.pure)
+            _M_seg_compiles.inc()
+            _flight.record("sot", "segment_compile", fn=self.name,
+                           ops=len(seg.ops))
+        return seg.jitted
 
     def replay(self, input_tensors: List[Tensor]):
         """Returns (ok, result). ok=False on a guard miss.
@@ -477,11 +578,13 @@ class _CompiledPath:
             if isinstance(t._data, jax.Array):
                 dev_guards.append((t._data, val))
             elif np.asarray(t._data).tobytes() != val:
+                self._note_miss("ext")
                 return False, None
         if dev_guards:
             got = np.asarray(_pack_bytes(
                 [d for d, _ in dev_guards])).tobytes()
             if got != b"".join(v for _, v in dev_guards):
+                self._note_miss("ext")
                 return False, None
         env: Dict[int, Tensor] = dict(zip(self.input_ids, input_tensors))
         guard_vals = []
@@ -506,9 +609,9 @@ class _CompiledPath:
                 n_ext = len(seg.ext_tensors)
                 in_tensors = [env[i] for i in seg.input_ids]
                 if seg.ops:
-                    jitted = seg.jitted
+                    runner = self._runner(seg)
 
-                    def run_seg(*flat, _j=jitted, _n=n_ext):
+                    def run_seg(*flat, _j=runner, _n=n_ext):
                         return tuple(_j(list(flat[:_n]),
                                         list(flat[_n:])))
 
@@ -526,12 +629,14 @@ class _CompiledPath:
                         got = np.asarray(
                             env[g.tensor_id]._data).tobytes()
                         if got != g.value:
+                            self._note_miss("early")
                             return miss()
                     else:
                         guard_vals.append(env[g.tensor_id]._data)
             if guard_vals:
                 got = np.asarray(_pack_bytes(guard_vals)).tobytes()
                 if got != self._tail_guard_bytes:
+                    self._note_miss("tail")
                     return miss()  # miss somewhere on the tail
         except FloatingPointError:
             # wrong-path garbage legitimately trips the NaN check;
@@ -546,7 +651,14 @@ class _CompiledPath:
             return miss()
         autograd_mod._nan_pending = \
             saved_pending + autograd_mod._nan_pending
+        self.replays += 1
+        if _M_flag.value:
+            _M_captured._v += 1  # inline fast cell: per-replay hot path
         return True, self._build_result(env)
+
+    def _note_miss(self, where: str) -> None:
+        _M_guard_miss.inc()
+        _flight.record("sot", "guard_miss", fn=self.name, where=where)
 
     def _build_result(self, env):
         def build(spec):
@@ -701,9 +813,21 @@ class SOTFunction:
         with _RecorderSession(rec_obj):
             result = self._fn(*args, **kwargs)
         rec = rec_obj.finish(result)
+        if rec.replayable:
+            # per-path guard budget: every replay re-validates the whole
+            # guard set, so a path with kilobytes of guards pays more in
+            # validation than compiled replay saves
+            budget = max(int(_guard_budget_flag.value or 0), 0)
+            total = sum(len(g.value) for g in rec.guards) + \
+                sum(len(v) for _, v in rec.ext_guards)
+            if budget and total > budget:
+                rec.replayable = False
+                rec.why_not = (
+                    f"guard budget exceeded ({total}B of guard values > "
+                    f"FLAGS_sot_guard_budget={budget}B)")
         guard_path = tuple(g.value for g in rec.guards)
         if rec.replayable:
-            path = _CompiledPath(rec, input_ids)
+            path = _CompiledPath(rec, input_ids, self._name)
             self._cache_put((sig, guard_path), path)
         else:
             # marker key is distinct from every guard-path key, so a
@@ -712,6 +836,7 @@ class SOTFunction:
             # bounded cardinality: why_not can embed per-call values
             # (guard byte sizes) — past the cap, collapse to <other>
             reason = rec.why_not
+            _count_fallback(_fallback_category(reason), self._name)
             if reason not in self._fallback_reasons and \
                     len(self._fallback_reasons) >= 16:
                 reason = "<other>"
@@ -732,6 +857,9 @@ class SOTFunction:
         # every op — an inner replay would hide ops behind opaque ext refs
         if autograd_mod._op_recorder is not None:
             return self._fn(*args, **kwargs)
+        if not _capture_flag.value:
+            # kill switch: today's per-chain eager fusion, bit-for-bit
+            return self._fn(*args, **kwargs)
         if self._bucket is not None:
             args = self._bucket.apply(args)
         sig = self._signature(args, kwargs)
@@ -747,6 +875,12 @@ class SOTFunction:
             if ok:
                 self._cache.move_to_end(key)
                 return result
+        if candidates:
+            # every cached path for this signature missed: the branch
+            # re-records below (discard-and-retrace)
+            _M_retraces.inc()
+            _flight.record("sot", "retrace", fn=self._name,
+                           candidates=len(candidates))
         if self._cache.get((sig, "eager")) == "eager":
             # a known non-replayable branch for this signature: plain
             # eager, skip the recording bookkeeping
@@ -762,3 +896,503 @@ def sot_compile(fn=None, bucket_policy: Optional[BucketPolicy] = None):
     if fn is not None:
         return deco(fn)
     return deco
+
+
+def capture(fn=None, bucket_policy: Optional[BucketPolicy] = None,
+            name: Optional[str] = None):
+    """``@sot.capture`` — production whole-step capture for an arbitrary
+    step callable: record once, replay as lazily-compiled segments with
+    speculatively validated guards, fall back per-chain to eager fusion
+    on unreplayable events (RNG/mutation/host I/O) with a counted
+    reason. ``FLAGS_sot_capture=0`` restores plain eager execution.
+    (For the known fwd+bwd+optimizer train-step shape, use
+    :class:`CapturedStep` / ``jit.TrainStep`` — those run the whole step
+    as ONE donated executable instead of per-segment replay.)"""
+    def deco(f):
+        return SOTFunction(f, bucket_policy, name=name)
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def capture_jit(fn, donate_argnums=(), name: Optional[str] = None):
+    """Wrap an already-whole-step function (e.g. the serving decode
+    body) as a captured executable: ``jax.jit`` + SOT capture
+    accounting — the first (trace+compile) execution journals a
+    ``sot.capture_compile`` flight event and every call counts into
+    ``sot.captured_steps_total`` while ``FLAGS_sot_capture`` is on.
+    Behavior is identical to ``jax.jit`` (the kill switch only mutes
+    the accounting — the step was already a single executable)."""
+    jf = jax.jit(fn, donate_argnums=donate_argnums)
+    nm = name or getattr(fn, "__name__", "fn")
+    compiled = [False]
+
+    def call(*args, **kwargs):
+        out = jf(*args, **kwargs)
+        # accounting only (execution above is a bare jax.jit either
+        # way); the kill switch mutes ALL of it, and the compile event
+        # lands only after the first call actually succeeded
+        if _capture_flag.value:
+            if not compiled[0]:
+                compiled[0] = True
+                _M_step_compiles.inc()
+                _flight.record("sot", "capture_compile", fn=nm)
+            if _M_flag.value:
+                _M_captured._v += 1  # inline fast cell: hot path
+        return out
+
+    call._jitted = jf
+    call.__name__ = nm
+    return call
+
+
+# ---------------------------------------------------------------------------
+# whole-step capture: fwd + bwd + optimizer as ONE donated executable
+# ---------------------------------------------------------------------------
+
+class CapturedStep:
+    """Execute a train (or eval) step as ONE cached, buffer-donated
+    jitted executable — the Fusion III engine behind
+    ``hapi.Model.train_batch``/``eval_batch`` and ``jit.TrainStep``.
+
+    The capture plan (``analysis.capture_plan``, PR 7) proved a llama
+    ``Model.fit`` step segments CONSISTENT: every flush boundary is
+    absorbed by capture, the loss fetch is HOISTABLE, and the donated
+    optimizer step is the tail segment. This class executes that plan:
+
+    * **One program** per *signature* — batch shapes/dtypes, layer
+      train/eval modes, the trainable set, optimizer type + static
+      hyperparameters + per-param weight-decay statics, clip spec. A
+      signature change is the guard miss: the stale program stays
+      cached (LRU, ``FLAGS_sot_capture_cache``) and the new signature
+      retraces.
+    * **Compile policy** (``strict`` mode): first sighting of a
+      signature runs today's eager path (and warms optimizer state),
+      the second builds + compiles the whole-step program, later calls
+      hit the cache — the fusion plane's compile-on-second-sighting.
+    * **Donation** — params, buffers, optimizer state and the
+      device-resident RNG carry are donated; leaves aliased by a live
+      ``detach()`` snapshot are copied first (the PR 5 alias-registry
+      contract), and pending eager-fusion chains are flushed through
+      ``fusion.capture_handoff()`` before anything is invalidated.
+    * **Hoisted loss** — the returned loss is a LAZY device scalar
+      (a ``Tensor``); nothing inside the captured region syncs to
+      host. Fetch it at the logging boundary (``float(loss)``).
+    * **Fallbacks** are total and counted (``sot.fallbacks_total``
+      {reason} + a flight event): AMP autocast, debug flags
+      (check_nan_inf / benchmark / retain-all), layer or tensor hooks,
+      non-fusable optimizers, unknown clip objects, non-static
+      hyperparams, aliased donation leaves, pre-accumulated grads —
+      each returns ``None`` and the caller runs today's eager path.
+    """
+
+    def __init__(self, network, loss_fn=None, optimizer=None,
+                 mean_reduce: bool = False, cast_loss_f32: bool = False,
+                 donate: bool = True, strict: bool = True,
+                 bucket_policy: Optional[BucketPolicy] = None,
+                 name: str = "step", build_kind: str = "sot_capture"):
+        from .api import _Swap
+        self.network = network
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._swap = _Swap(network)
+        self._mean_reduce = mean_reduce
+        self._cast_f32 = cast_loss_f32
+        self._donate = donate
+        self._strict = strict
+        self._bucket = bucket_policy
+        self._name = name
+        self._build_kind = build_kind
+        self._sublayers = list(network.sublayers(include_self=True))
+        self._cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        # device-resident RNG carry: (root key, step counter), donated
+        # through the program so dropout re-randomizes per step without
+        # a per-step host->device key upload
+        self._rng = None
+        self._rng_epoch = None
+        self.stats: Dict[str, Any] = {
+            "captured_steps": 0, "compiles": 0, "cache_hits": 0,
+            "eager_steps": 0, "fallbacks": {}}
+
+    # -- gating ------------------------------------------------------------
+    def _gate(self, train: bool) -> Optional[str]:
+        """Capture preconditions. None = capturable; otherwise the
+        fallback reason (the caller runs today's eager path)."""
+        from ..amp.auto_cast import _state as _amp_state
+        if _amp_state.enabled:
+            return "amp"
+        if _flag_registry["check_nan_inf"].value:
+            return "nan_check"
+        if _flag_registry["benchmark"].value:
+            return "benchmark"
+        if _flag_registry["retain_grad_for_all_tensor"].value:
+            return "retain_grad"
+        for lyr in self._sublayers:
+            if lyr._forward_pre_hooks or lyr._forward_post_hooks:
+                return "hooks"
+        for p in self._swap.params.values():
+            if p._hooks:
+                return "hooks"
+            if p._dist_attr is not None:
+                return "dist"
+            if isinstance(p._data, jax.core.Tracer):
+                return "tracer"
+        # a layer added/removed after this engine was built would be
+        # invisible to the functionalized program — cheap count gate
+        if sum(1 for _ in self.network.named_parameters()) != \
+                len(self._swap.params):
+            return "network_changed"
+        if train:
+            opt = self.optimizer
+            if opt is None:
+                return "no_optimizer"
+            if getattr(opt, "_fusable_step", True) is False:
+                return "optimizer"
+            from ..utils.clip_grad import clip_spec
+            if clip_spec(opt._grad_clip, exact=True) is None:
+                return "grad_clip"
+            from ..optimizer.fused_step import _hyper_key
+            if _hyper_key(opt) is None:
+                return "hyper"
+            # the captured tail updates the NETWORK's trainables; the
+            # eager step updates the OPTIMIZER's list — they must be
+            # the same set or the semantics differ
+            if {id(p) for p in opt._parameter_list
+                if not p.stop_gradient} != \
+                    {id(p) for p in self._swap.params.values()
+                     if not p.stop_gradient}:
+                return "param_set"
+            if any(not p.stop_gradient and p.grad is not None
+                   for p in self._swap.params.values()):
+                # eager backward ACCUMULATES into primed grads; the
+                # captured program starts from zero — not equivalent
+                return "pending_grads"
+        return None
+
+    def _fallback(self, reason: str) -> None:
+        self.stats["fallbacks"][reason] = \
+            self.stats["fallbacks"].get(reason, 0) + 1
+        _count_fallback(reason, self._name)
+
+    # -- signature ---------------------------------------------------------
+    def _tkeys(self):
+        return [k for k in sorted(self._swap.params)
+                if not self._swap.params[k].stop_gradient]
+
+    def _signature(self, kind: str, arrays, n_ins: int,
+                   tkeys) -> Optional[tuple]:
+        modes = tuple(lyr.training for lyr in self._sublayers)
+        # n_ins is part of the key: same shapes with a different
+        # input/label split are DIFFERENT programs
+        parts: List[Any] = [kind, n_ins, modes, tuple(tkeys)]
+        for a in arrays:
+            parts.append((tuple(a.shape), str(a.dtype)))
+        if kind == "train":
+            from ..optimizer.fused_step import _hyper_key, _param_statics
+            from ..utils.clip_grad import clip_spec
+            opt = self.optimizer
+            statics = _param_statics(
+                opt, [self._swap.params[k] for k in tkeys])
+            if statics is None and self._strict:
+                return None  # caller falls back (param_static)
+            parts.append((type(opt).__qualname__, _hyper_key(opt),
+                          statics,
+                          clip_spec(opt._grad_clip,
+                                    exact=self._strict)))
+        return tuple(parts)
+
+    # -- batch plumbing ----------------------------------------------------
+    def _arrays(self, values) -> Optional[list]:
+        """Raw device/host arrays for the batch; lazy fusion chains
+        hand off at the capture boundary (flush reason sot_capture)."""
+        from ..core import fusion
+        out = []
+        for v in values:
+            if isinstance(v, Tensor):
+                if v._lazy is not None:
+                    fusion.materialize_tensor(v, "sot_capture")
+                d = v._data
+                if self._strict and isinstance(d, jax.core.Tracer):
+                    return None  # under an outer trace: stay eager
+                out.append(d)
+            elif isinstance(v, jax.Array):
+                out.append(v)
+            elif hasattr(v, "aval"):  # raw tracer (nested jit)
+                out.append(v)
+            else:
+                out.append(jnp.asarray(np.asarray(v)))
+        return out
+
+    # -- program build -----------------------------------------------------
+    def _build(self, kind: str, n_ins: int):
+        from .api import _notify_build, _tree_unwrap
+        from ..core.autograd import no_grad
+        _notify_build(self._build_kind)
+        network, loss_fn, opt = self.network, self.loss_fn, self.optimizer
+        swap = self._swap
+        mean_reduce, cast_f32 = self._mean_reduce, self._cast_f32
+
+        def loss_value(out, lbls):
+            loss_t = loss_fn(out, *lbls) if loss_fn is not None else out
+            ld = loss_t._data
+            if mean_reduce and ld.ndim > 0:
+                ld = ld.mean()
+            if cast_f32:
+                ld = ld.astype(jnp.float32)
+            return ld
+
+        if kind == "eval":
+            def eval_fn(params, buffers, key, *batch):
+                with no_grad(), random_mod.key_stream(key):
+                    ins = tuple(Tensor(b) for b in batch[:n_ins])
+                    lbls = tuple(Tensor(b) for b in batch[n_ins:])
+                    out, new_buffers = swap.run(params, buffers,
+                                                network.__call__, *ins)
+                    ld = loss_value(out, lbls) if \
+                        (loss_fn is not None and lbls) else None
+                return _tree_unwrap(out), ld, new_buffers
+
+            return jax.jit(eval_fn)
+
+        tkeys = self._tkeys()
+        trainable = set(tkeys)
+        param_objs = [swap.params[k] for k in tkeys]
+        from ..utils.clip_grad import clip_spec
+        cspec = clip_spec(opt._grad_clip, exact=self._strict) or ()
+
+        def step_fn(params, buffers, states, lr, rng, *batch):
+            root, count = rng
+            key = jax.random.fold_in(root, count)
+            train_p = {k: v for k, v in params.items() if k in trainable}
+            frozen_p = {k: v for k, v in params.items()
+                        if k not in trainable}
+
+            def loss_of(tp):
+                full = {**tp, **frozen_p}
+                with no_grad(), random_mod.key_stream(key):
+                    ins = tuple(Tensor(b) for b in batch[:n_ins])
+                    lbls = tuple(Tensor(b) for b in batch[n_ins:])
+                    out, new_buffers = swap.run(full, buffers,
+                                                network.__call__, *ins)
+                    ld = loss_value(out, lbls)
+                return ld, new_buffers
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_p)
+            from ..optimizer.fused_step import apply_update_tail
+            new_ps, new_ss = apply_update_tail(
+                opt, param_objs, [params[k] for k in tkeys],
+                [grads[k] for k in tkeys], states, lr, cspec)
+            new_params = dict(params)
+            for k, v in zip(tkeys, new_ps):
+                new_params[k] = v
+            return (loss, new_params, new_buffers, new_ss,
+                    (root, count + jnp.uint32(1)))
+
+        donate = (0, 1, 2, 4) if self._donate else ()
+        return jax.jit(step_fn, donate_argnums=donate)
+
+    def _get_program(self, kind: str, sig, n_ins: int):
+        """Compile-on-second-sighting (strict mode): returns the jitted
+        program, or None when this signature should run eager this
+        call."""
+        entry = self._cache.get(sig)
+        if entry is not None and entry is not _SEEN_STEP:
+            self._cache.move_to_end(sig)
+            self.stats["cache_hits"] += 1
+            _M_hits.inc()
+            return entry
+        if entry is None and self._strict:
+            self._cache[sig] = _SEEN_STEP
+            self._trim()
+            return None
+        jitted = self._build(kind, n_ins)
+        self._cache[sig] = jitted
+        self._trim()
+        self.stats["compiles"] += 1
+        _M_step_compiles.inc()
+        _flight.record("sot", "capture_compile", fn=self._name,
+                       kind=kind)
+        return jitted
+
+    def _trim(self):
+        cap = max(int(_capture_cache_flag.value or 8), 1)
+        while len(self._cache) > cap:
+            self._cache.popitem(last=False)
+
+    # -- donation-safe leaf gathering --------------------------------------
+    @staticmethod
+    def _safe_leaf(v):
+        if isinstance(v, Tensor):
+            v = v._data
+        if not isinstance(v, jax.Array):
+            v = jnp.asarray(v)
+        if tensor_mod.buffer_has_alias(v):
+            # a live detach() snapshot shares this buffer: donation
+            # would delete it under the alias — donate a copy instead
+            v = jnp.copy(v)
+        return v
+
+    def _gather(self, train: bool, tkeys=None):
+        """(params, buffers, states) leaves for one call, alias-copied
+        for donation. Two donated leaves sharing one buffer (tied
+        storage — XLA rejects double donation): strict mode returns
+        None (eager fallback); non-strict (TrainStep, no eager path)
+        copies the duplicate and proceeds."""
+        swap, opt = self._swap, self.optimizer
+        params = {k: self._safe_leaf(t._data)
+                  for k, t in swap.params.items()}
+        buffers = {k: self._safe_leaf(t._data)
+                   for k, t in swap.buffers.items()}
+        states = []
+        if train:
+            for k in (self._tkeys() if tkeys is None else tkeys):
+                st = opt._state_for(swap.params[k])
+                states.append({kk: self._safe_leaf(vv)
+                               for kk, vv in st.items()})
+        if self._donate:
+            seen = set()
+
+            def dedup(leaf):
+                if id(leaf) in seen:
+                    return None if self._strict else jnp.copy(leaf)
+                seen.add(id(leaf))
+                return leaf
+
+            for d in (params, buffers):
+                for k, leaf in d.items():
+                    leaf = dedup(leaf)
+                    if leaf is None:
+                        return None
+                    d[k] = leaf
+            for st in states:
+                for k, leaf in st.items():
+                    leaf = dedup(leaf)
+                    if leaf is None:
+                        return None
+                    st[k] = leaf
+        return params, buffers, states
+
+    def _next_rng(self):
+        if self._rng is None or \
+                self._rng_epoch != random_mod.seed_epoch():
+            self._rng = (random_mod.next_key(), jnp.uint32(0))
+            self._rng_epoch = random_mod.seed_epoch()
+        return self._rng
+
+    # -- entry points ------------------------------------------------------
+    def step(self, inputs, labels=()):
+        """One captured train step over ``inputs``/``labels`` (lists of
+        tensors/arrays). Returns the LAZY device loss ``Tensor``, or
+        ``None`` when the caller must run today's eager path (kill
+        switch, gate fallback, first sighting). In non-strict mode
+        (``jit.TrainStep`` — an EXPLICIT whole-step API with no eager
+        fallback) the kill switch and the gates do not apply."""
+        if self._strict:
+            if not _capture_flag.value:
+                return None
+            if autograd_mod._op_recorder is not None:
+                return None  # an outer recorder must see the real ops
+            reason = self._gate(train=True)
+            if reason is not None:
+                self._fallback(reason)
+                return None
+        if self._bucket is not None:
+            inputs = list(self._bucket.apply(tuple(inputs)))
+        arrays = self._arrays(list(inputs) + list(labels))
+        if arrays is None:
+            self._fallback("tracer")
+            return None
+        tkeys = self._tkeys()
+        sig = self._signature("train", arrays, len(inputs), tkeys)
+        if sig is None:
+            self._fallback("param_static")
+            return None
+        jitted = self._get_program("train", sig, len(inputs))
+        if jitted is None:
+            self.stats["eager_steps"] += 1
+            return None
+        gathered = self._gather(train=True, tkeys=tkeys)
+        if gathered is None:
+            self._fallback("aliased")
+            return None
+        params, buffers, states = gathered
+        from ..core import fusion
+        fusion.capture_handoff()
+        from ..optimizer.fused_step import _lr_device
+        opt, swap = self.optimizer, self._swap
+        loss, new_params, new_buffers, new_ss, self._rng = jitted(
+            params, buffers, states, _lr_device(opt), self._next_rng(),
+            *arrays)
+        for k, t in swap.params.items():
+            t._data = new_params[k]
+        for k, t in swap.buffers.items():
+            t._data = new_buffers[k]
+        for k, ns in zip(tkeys, new_ss):
+            opt._states[id(swap.params[k])] = ns
+        opt._global_step += 1
+        if self._strict:  # hapi semantics: step() + clear_grad()
+            for p in opt._parameter_list:
+                p.grad = None
+        self.stats["captured_steps"] += 1
+        if _M_flag.value:
+            _M_captured._v += 1  # inline fast cell: per-step hot path
+        return Tensor(loss)
+
+    def forward(self, inputs, labels=()):
+        """One captured eval/inference forward. Returns ``(out, loss)``
+        — ``out`` re-wrapped as Tensors, ``loss`` a lazy device scalar
+        or None — or ``None`` for the eager path."""
+        if not _capture_flag.value:
+            return None
+        if autograd_mod._op_recorder is not None:
+            return None
+        reason = self._gate(train=False)
+        if reason is not None:
+            self._fallback(reason)
+            return None
+        if self._bucket is not None:
+            inputs = list(self._bucket.apply(tuple(inputs)))
+        arrays = self._arrays(list(inputs) + list(labels))
+        if arrays is None:
+            self._fallback("tracer")
+            return None
+        sig = self._signature("eval", arrays, len(inputs),
+                              self._tkeys())
+        jitted = self._get_program("eval", sig, len(inputs))
+        if jitted is None:
+            self.stats["eager_steps"] += 1
+            return None
+        from ..core import fusion
+        fusion.capture_handoff()
+        swap = self._swap
+        params = {k: t._data for k, t in swap.params.items()}
+        buffers = {k: t._data for k, t in swap.buffers.items()}
+        root, count = self._next_rng()
+        key = jax.random.fold_in(root, count)
+        self._rng = (root, count + jnp.uint32(1))
+        out, loss, new_buffers = jitted(params, buffers, key, *arrays)
+        for k, t in swap.buffers.items():
+            t._data = new_buffers[k]
+        from .api import _tree_wrap
+        self.stats["captured_steps"] += 1
+        if _M_flag.value:
+            _M_captured._v += 1
+        return _tree_wrap(out), (None if loss is None else Tensor(loss))
+
+    def compile_stats(self, inputs, labels=()):
+        """Compile the train step for these batch shapes without running
+        it and return XLA's per-device memory analysis (TrainStep's
+        compile_stats contract; bench emits it as peak_hbm_bytes)."""
+        arrays = self._arrays(list(inputs) + list(labels))
+        jitted = self._build("train", len(inputs))
+        gathered = self._gather(train=True)
+        params, buffers, states = gathered
+        from ..optimizer.fused_step import _lr_device
+        probe_rng = (jax.random.key(0), jnp.uint32(0))
+        return jitted.lower(
+            params, buffers, states, _lr_device(self.optimizer),
+            probe_rng, *arrays).compile().memory_analysis()
+
+
+_SEEN_STEP = object()  # first-sighting marker: signature noted, ran eager

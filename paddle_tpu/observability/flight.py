@@ -9,7 +9,10 @@ category, name, recording thread, an optional ``trace_id`` and a small
 attrs dict — that every subsystem appends into from its existing
 observer seams: fusion chain flushes and program compiles, device→host
 syncs, fused-optimizer donations and fallbacks, whole-step jit builds,
-eager collectives (op/bytes/duration), checkpoint save/restore/
+SOT capture lifecycle events (``sot`` category: segment_compile /
+capture_compile / guard_miss / retrace / fallback-by-reason — a
+production guard-miss storm reads straight out of a dump), eager
+collectives (op/bytes/duration), checkpoint save/restore/
 corruption-fallback, elastic membership transitions, watchdog timeouts
 and the per-request serving lifecycle (submit → queued → admitted →
 decode → finished/expired/rejected, keyed by ``trace_id``).

@@ -62,7 +62,7 @@ from ..observability import metrics as _om
 from ..utils.clip_grad import clip_by_spec, clip_spec
 
 __all__ = ["try_step", "try_step_scaled", "unscale_and_check", "enabled",
-           "clear_cache"]
+           "clear_cache", "apply_update_tail"]
 
 _flag = _flag_registry["fused_optimizer"]
 _cache_cap = _flag_registry["fused_optimizer_cache"]
@@ -187,6 +187,33 @@ class _TraceCtx:
         self.params = None
 
 
+def apply_update_tail(opt, param_objs, p_leaves, g_leaves, s_leaves, lr,
+                      cspec):
+    """The optimizer tail segment: clip -> regularizer -> per-param pure
+    ``_update`` over raw leaves, pure and jittable. ONE definition shared
+    by the fused optimizer step (:func:`_make_fn`) and the SOT whole-step
+    capture engine (jit/sot.py), where the donated optimizer program is
+    the tail of the captured fwd+bwd+opt executable. Returns
+    ``(new_p_leaves, new_s_leaves)``."""
+    gs = list(g_leaves)
+    if cspec:
+        gs = clip_by_spec(cspec, gs)
+    has_pid = hasattr(opt, "_current_pid")
+    new_ps: List[Any] = []
+    new_ss: List[Dict[str, Any]] = []
+    for i, p in enumerate(param_objs):
+        if has_pid:
+            opt._current_pid = id(p)
+        opt._cur_param = p
+        g = opt._apply_regularizer(p_leaves[i], gs[i])
+        new_p, new_s = opt._update(p_leaves[i], g, s_leaves[i], lr)
+        new_ps.append(new_p)
+        new_ss.append(new_s)
+    if has_pid:
+        opt._current_pid = None
+    return new_ps, new_ss
+
+
 def _make_fn(ctx, mode, cspec, n):
     """The pure whole-step function. ``mode``:
 
@@ -201,7 +228,6 @@ def _make_fn(ctx, mode, cspec, n):
 
     def step_fn(params, grads, states, scalars):
         opt, param_objs = ctx.opt, ctx.params
-        has_pid = hasattr(opt, "_current_pid")
         lr = scalars[0]
         gs = list(grads)
         found = None
@@ -211,20 +237,8 @@ def _make_fn(ctx, mode, cspec, n):
             found = jnp.logical_or(found_own, scalars[2])
         elif mode == "found":
             found = scalars[1]
-        if cspec:
-            gs = clip_by_spec(cspec, gs)
-        new_ps: List[Any] = []
-        new_ss: List[Dict[str, Any]] = []
-        for i in range(n):
-            if has_pid:
-                opt._current_pid = id(param_objs[i])
-            opt._cur_param = param_objs[i]
-            g = opt._apply_regularizer(params[i], gs[i])
-            new_p, new_s = opt._update(params[i], g, states[i], lr)
-            new_ps.append(new_p)
-            new_ss.append(new_s)
-        if has_pid:
-            opt._current_pid = None
+        new_ps, new_ss = apply_update_tail(opt, param_objs, params, gs,
+                                           states, lr, cspec)
         if found is not None:
             # conditional skip ON DEVICE: a non-finite grad signal keeps
             # every param AND state leaf at its old value

@@ -157,8 +157,17 @@ class LlamaDecodeEngine:
         self.last_ids = np.zeros((S, 1), np.int32)
 
         # caches are donated: each decode step updates them in place in
-        # HBM instead of allocating a second [L,S,max_seq,...] copy
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        # HBM instead of allocating a second [L,S,max_seq,...] copy.
+        # The jitted step is registered as a CAPTURED step program
+        # (jit.sot.capture_jit): its clean capture plan is checked in
+        # (tests/test_capture_plan.py), so every call counts into
+        # sot.captured_steps_total and the first compile lands in the
+        # flight journal — identical execution to a bare jax.jit
+        from .jit.sot import capture_jit as _capture_jit
+        self._capture_jit = _capture_jit
+        self._decode = _capture_jit(self._decode_impl,
+                                    donate_argnums=(1, 2),
+                                    name="serving.decode")
         self._decode_collect = None
         self._prefills: Dict[int, object] = {}
 
@@ -402,8 +411,9 @@ class LlamaDecodeEngine:
                 f"bounds K/V writes are silently dropped by XLA and the "
                 f"position mask would then attend unwritten rows")
         if self._decode_collect is None:
-            self._decode_collect = jax.jit(self._decode_collect_impl,
-                                           donate_argnums=(1, 2, 5))
+            self._decode_collect = self._capture_jit(
+                self._decode_collect_impl, donate_argnums=(1, 2, 5),
+                name="serving.decode_window")
         ids = jnp.asarray(self.last_ids)
         pos = jnp.asarray(self.pos)
         # tokens accumulate in ONE donated device buffer: holding a

@@ -272,11 +272,18 @@ class TestAuditorLlamaStep:
         return step
 
     def test_capture_report_enumerates_flushes_no_false_positives(self):
+        """The EAGER planning input (Fusion III implemented the plan;
+        FLAGS_sot_capture=0 pins that the per-chain path the planner
+        audited still behaves and attributes as before)."""
         step = self._fit_step()
-        rep = audit(step, warmup=3)
+        set_flags({"FLAGS_sot_capture": 0})
+        try:
+            rep = audit(step, warmup=3)
+        finally:
+            set_flags({"FLAGS_sot_capture": 1})
         # the capture report enumerates flush boundaries with reason
         # AND origin — the Fusion III planning input
-        assert rep.flushes, "a llama train step must flush somewhere"
+        assert rep.flushes, "an eager llama train step must flush"
         assert all(f["reason"] for f in rep.flushes)
         assert all(f["origin"] != "<unknown>" for f in rep.flushes)
         assert rep.flush_sites(), "aggregated top-N flush sites"
@@ -286,10 +293,10 @@ class TestAuditorLlamaStep:
             [d.to_dict() for d in rep.diagnostics]
         assert not [d for d in rep.diagnostics if d.rule == "PTA003"], \
             [d.to_dict() for d in rep.diagnostics]
-        # the ONE deliberate host sync (hapi's per-batch loss fetch) is
-        # attributed to hapi/model.py, nothing else
-        for d in (d for d in rep.diagnostics if d.rule == "PTA001"):
-            assert "hapi/model.py" in d.location, d.to_dict()
+        # the loss fetch is HOISTED out of train_batch (Fusion III):
+        # even the eager step is sync-free in its measured window
+        assert not [d for d in rep.diagnostics if d.rule == "PTA001"], \
+            [d.to_dict() for d in rep.diagnostics]
 
 
 # ---------------------------------------------------------------------------

@@ -487,7 +487,7 @@ class TestPlannerDynamic:
 # ---------------------------------------------------------------------------
 
 class TestLlamaPlanConsistency:
-    def test_fit_step_plan_consistent_with_audit(self):
+    def _fit_model(self):
         from paddle_tpu.hapi import Model
         from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
                                        LlamaPretrainingCriterion)
@@ -499,29 +499,40 @@ class TestLlamaPlanConsistency:
             loss=LlamaPretrainingCriterion())
         rng = np.random.default_rng(0)
         ids = rng.integers(0, 128, (2, 16)).astype(np.int64)
+        return m, ids
+
+    def test_fit_step_plan_consistent_with_audit(self):
+        """The EAGER plan (FLAGS_sot_capture=0): the per-chain path the
+        planner audited before Fusion III implemented it. The loss
+        fetch is now HOISTED out of train_batch, so the plan has no
+        hapi sync row at all — and no allowlist entry carrying it."""
+        m, ids = self._fit_model()
 
         def step():
             m.train_batch([ids], [ids])
 
-        plan = analysis.capture_plan(step, warmup=3)
+        paddle.set_flags({"FLAGS_sot_capture": 0})
+        try:
+            plan = analysis.capture_plan(step, warmup=3)
+        finally:
+            paddle.set_flags({"FLAGS_sot_capture": 1})
         # the consistency contract: every PTA001 host sync and every
         # op_boundary flush site is covered by a PTC diagnostic with a
         # fix hint or classified capture-compatible
         assert plan.consistent(), plan.unaccounted()
-        assert plan.breaks, "a llama train step must have break rows"
+        assert plan.breaks, "an eager llama train step has break rows"
         for b in plan.breaks:
             assert b["classification"] != "unaccounted", b
             assert b["fix"], b
-        # the one deliberate hapi loss fetch is present, matched to its
-        # static PTC003 finding, and classified via the allowlist
+        # the historical hapi loss fetch is GONE (hoisted to the fit
+        # log boundary): no sync row, no PTC003, no allowlist carry
         hapi_rows = [b for b in plan.breaks
                      if "hapi/model.py" in b["site"]
                      and b["reason"] in ("host_sync", "host_read")]
-        assert hapi_rows, plan.breaks
-        assert all(b["classification"] == "compatible"
-                   for b in hapi_rows), hapi_rows
-        assert any("hapi/model.py" in d.location and d.rule == "PTC003"
-                   for d, _ in plan.suppressed)
+        assert hapi_rows == [], hapi_rows
+        assert not any("hapi/model.py" in d.location
+                       and d.rule == "PTC003"
+                       for d, _ in plan.suppressed)
         # op_boundary rows rank by measured flush cost and are absorbed
         ob = [b for b in plan.breaks if b["reason"] == "op_boundary"]
         assert ob and all(b["classification"] == "compatible"
@@ -530,6 +541,37 @@ class TestLlamaPlanConsistency:
         # no steady-state churn, so no bucket rows on the clean step
         assert not [b for b in plan.breaks
                     if b["classification"] == "bucket"]
+
+    def test_captured_fit_step_runs_dispatch_free(self):
+        """ISSUE 10 acceptance, audit as the assertion engine: a
+        steady-state captured llama train step is ONE executable call
+        with ZERO host syncs and ZERO flushes inside the captured
+        region, the plan stays CONSISTENT, and the kill switch restores
+        eager per-chain fusion (the PR 6 -> 7 -> 10 loop closed)."""
+        from paddle_tpu.observability import metrics as om
+        m, ids = self._fit_model()
+
+        def step():
+            m.train_batch([ids], [ids])
+
+        plan = analysis.capture_plan(step, warmup=3)
+        assert plan.consistent(), plan.unaccounted()
+        rep = plan.capture
+        assert rep.syncs == [], rep.syncs
+        assert len(rep.flushes) <= 3, rep.flushes   # a handful, not N
+        assert rep.pair_builds == [] and rep.step_builds == []
+        assert not [d for d in rep.diagnostics
+                    if d.rule in ("PTA001", "PTA002", "PTA003")], \
+            [d.to_dict() for d in rep.diagnostics]
+        # <= 3 jitted executable calls per step (here: exactly one)
+        before = dict(om.snapshot().get("sot", {}))
+        m.train_batch([ids], [ids])
+        after = dict(om.snapshot().get("sot", {}))
+        captured = after.get("captured_steps_total", 0) - \
+            before.get("captured_steps_total", 0)
+        assert 1 <= captured <= 3, captured
+        assert after.get("guard_misses_total", 0) == \
+            before.get("guard_misses_total", 0)
 
 
 # ---------------------------------------------------------------------------
@@ -604,19 +646,18 @@ class TestRepoStepFixtures:
                          f"{pattern!r}) matches no finding — fixed "
                          f"site? delete the entry")
 
-    def test_hapi_loss_fetch_classified(self):
-        """The known hapi loss-fetch sync: detected as hoistable
-        PTC003 at its exact site, with the justified allowlist entry
-        (the satellite's minimum bar)."""
+    def test_hapi_loss_fetch_hoisted(self):
+        """Fusion III hoisted the hapi loss fetch: train_batch/
+        eval_batch scan with ZERO raw findings (no .item() left to
+        allowlist — the stale-entry contract forced the entry out),
+        and the fetch now lives at the fit/evaluate log boundary."""
         raw = capture.scan_repo_steps(use_allowlist=False)
-        hits = [d for d in raw.diagnostics
-                if d.rule == "PTC003"
-                and "hapi/model.py" in d.location
-                and d.data.get("hoistable")]
-        assert hits, [d.to_dict() for d in raw.diagnostics]
-        allow = capture.scan_repo_steps()
-        assert any("hapi/model.py" in d.location
-                   for d, _ in allow.suppressed)
+        hapi = [d for d in raw.diagnostics
+                if "hapi/model.py" in d.location]
+        assert hapi == [], [d.to_dict() for d in hapi]
+        from paddle_tpu.analysis.allowlist import CAPTURE_ALLOWLIST
+        assert not any("hapi" in pattern
+                       for _, pattern, _ in CAPTURE_ALLOWLIST)
 
 
 # ---------------------------------------------------------------------------
