@@ -114,14 +114,16 @@ CAPTURE_ALLOWLIST = [
     # lazy device loss and fit/evaluate fetch at the log boundary, so
     # the step functions now scan clean with no exception needed)
     ("PTC002", "paddle_tpu/serving.py*",
-     "slot bookkeeping (pos/last_ids) advances BETWEEN captured decode "
-     "programs by design: the jitted _decode_impl is the capture "
-     "region, the server loop is the boundary that replays it"),
+     "slot/block bookkeeping (pos/last_ids/active, block-table "
+     "extension, prefill staging) advances BETWEEN captured programs "
+     "by design: the jitted dense/paged _decode_impl and the paged "
+     "_prefill_impl chunks are the capture regions, the server loop "
+     "is the boundary that replays them"),
     ("PTC003", "paddle_tpu/serving.py*",
-     "the per-step/per-window token fetch IS the decode contract: "
-     "continuous batching must see each token on host to admit/retire "
-     "requests; decode_steps already batches it to one fetch per "
-     "window"),
+     "the per-step/per-window token fetch and the final-prefill-chunk "
+     "first-token fetch ARE the decode contract: continuous batching "
+     "must see each token on host to admit/retire requests; "
+     "decode_steps already batches it to one fetch per window"),
     ("PTC003", "bench.py*",
      "deliberate device barriers: a value transfer is the only "
      "trustworthy sync over the TPU tunnel — warmup fetches bound the "

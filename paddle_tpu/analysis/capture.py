@@ -674,6 +674,16 @@ REPO_STEPS: List[Tuple[str, str, Tuple[str, ...]]] = [
      ("params", "k_cache", "v_cache", "last_ids", "pos")),
     ("paddle_tpu/serving.py", "LlamaDecodeEngine.step", ()),
     ("paddle_tpu/serving.py", "LlamaDecodeEngine.decode_steps", ()),
+    ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine._decode_impl",
+     ("params", "kv", "last_ids", "pos", "tables", "act")),
+    ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine._prefill_impl",
+     ("params", "kv", "ids", "table_row", "start", "nvalid",
+      "true_len")),
+    ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine.step", ()),
+    ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine.decode_steps",
+     ()),
+    ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine.prefill_chunk",
+     ()),
     ("bench.py", "bench_llama", ()),
 ]
 

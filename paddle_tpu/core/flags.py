@@ -159,6 +159,26 @@ define_flag("metrics", True,
             "metrics_overhead bench enforces <=5% dispatch overhead. "
             "FLAGS_metrics=0 is the kill switch: every instrument "
             "mutation becomes one cached flag read + return")
+define_flag("serving_block_size", 16,
+            "Tokens per KV block in the paged serving cache "
+            "(serving.PagedLlamaDecodeEngine): the block pool is "
+            "[num_blocks, block_size, KVH, D] per layer and the tiled "
+            "decode attention walks each slot's block table one block "
+            "at a time. Larger blocks = fewer gather steps but coarser "
+            "allocation granularity (internal fragmentation up to "
+            "block_size-1 tokens per request)")
+define_flag("serving_num_blocks", 0,
+            "KV blocks in the paged serving pool, shared by all slots. "
+            "0 (default) = auto-size to dense capacity parity "
+            "(max_slots x ceil(max_seq/block_size)); smaller values "
+            "trade worst-case capacity for HBM, relying on admission "
+            "control (requests wait for blocks instead of OOMing)")
+define_flag("serving_prefill_chunk", 64,
+            "Max prompt tokens a single paged prefill executable "
+            "processes: the GenerationServer loop interleaves one "
+            "chunk with each decode step so a long prompt never "
+            "stalls the in-flight decode batch for more than one "
+            "chunk's forward pass")
 define_flag("use_bf16_matmul", True, "Prefer bfloat16 matmul accumulation defaults")
 define_flag("log_level", 0, "Framework verbosity")
 define_flag("benchmark", False, "Synchronize after each op for timing")

@@ -436,10 +436,13 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     ``generate=True`` additionally serves POST /generate for causal-LM
     artifacts: body is an .npz with ``input_ids`` [L] and scalar
     ``max_new_tokens``; response is ``output_ids`` (the generated
-    continuation). Requests share the engine's fixed decode slots with
-    iteration-level continuous batching — a long generation never
-    blocks a short one (see serving.GenerationServer); ``int8=True``
-    runs the projections as real s8 matmuls.
+    continuation). Requests share the PAGED decode engine's slots with
+    iteration-level continuous batching over a shared KV block pool —
+    a long generation never blocks a short one, a long PROMPT only
+    stalls the batch one prefill chunk at a time, and KV HBM scales
+    with active tokens (see serving.PagedLlamaDecodeEngine +
+    GenerationServer); ``int8=True`` runs the projections as real s8
+    matmuls.
     """
     import io
     import threading
@@ -450,12 +453,12 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
                             window_ms=batch_window_ms)
     gen_server = None
     if generate:
-        from .serving import GenerationServer, LlamaDecodeEngine
+        from .serving import GenerationServer, PagedLlamaDecodeEngine
         # reuse the predictor's already-loaded Layer (a second
         # load_inference_model would hold the weights twice at startup)
         model = predictor.model if predictor.model is not None \
             else load_inference_model(model_path)
-        gen_server = GenerationServer(LlamaDecodeEngine(
+        gen_server = GenerationServer(PagedLlamaDecodeEngine(
             model, max_slots=max_slots, max_seq=max_seq, int8=int8,
             eos_id=eos_id))
 

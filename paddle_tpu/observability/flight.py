@@ -15,7 +15,11 @@ production guard-miss storm reads straight out of a dump), eager
 collectives (op/bytes/duration), checkpoint save/restore/
 corruption-fallback, elastic membership transitions, watchdog timeouts
 and the per-request serving lifecycle (submit → queued → admitted →
-decode → finished/expired/rejected, keyed by ``trace_id``).
+[prefilled] → decode → finished/expired/rejected, keyed by
+``trace_id``), plus the paged KV block pool's allocator
+(``block_alloc`` / ``block_free`` / ``block_exhausted`` — a pool
+running dry reads straight out of a dump next to the starved
+requests' queue time).
 
 Recording is on by default (``FLAGS_flight_recorder``) because an
 append costs the same class of work as a ``Counter`` bump — one cached

@@ -8,23 +8,40 @@ phi/kernels/fusion/gpu/fused_multi_transformer_op.cu). The TPU-native
 equivalent keeps everything STATIC-SHAPED so XLA compiles exactly two
 program families:
 
-- ``prefill[bucket]``: whole-prompt forward (prompt padded to a pow-2
-  bucket) writing K/V into one slot's region of the fixed cache;
+- ``prefill[bucket]``: prompt forward (padded to a pow-2 bucket)
+  writing K/V into the slot's cache;
 - ``decode``: ONE step advancing ALL slots together — q of shape
-  [slots, 1] against [slots, max_seq] caches with per-slot position
+  [slots, 1] against the per-slot K/V history with per-slot position
   masks. Iteration-level (continuous) batching falls out: requests
   join/leave at step boundaries, the compiled program never changes.
 
-KV caches live as per-layer arrays [slots, max_seq, KVH, D] (a
-stacked [L, ...] form measured ~11 ms/step of slice/stack copies),
-donated through the decode step so the update is in-place in HBM.
+Two cache layouts ship:
+
+- **Dense** (:class:`LlamaDecodeEngine`): per-layer arrays
+  [slots, max_seq, KVH, D] (a stacked [L, ...] form measured
+  ~11 ms/step of slice/stack copies), donated through the decode step
+  so the update is in-place in HBM. Simple, but HBM scales with
+  *capacity* (slots x max_seq) whether slots are full or idle.
+- **Paged** (:class:`PagedLlamaDecodeEngine`, the production/server
+  default): a shared per-layer block pool [num_blocks, block_size,
+  KVH, D] plus per-slot block tables (``serving_cache.PagedKVCache``),
+  so HBM scales with *active tokens*; prompts prefill in CHUNKS
+  through their own bucketed executable interleaved with decode steps
+  (a long prompt never stalls the in-flight batch), and the decode
+  attention is a tiled streaming walk of each slot's block list
+  (``serving_cache.paged_attention``) that never materializes a dense
+  [S, max_seq] view. Optional bf16/int8 block storage
+  (``kv_quant=``) reuses the quantize.py absmax math.
+
 ``int8=True`` runs every projection as a REAL s8 x s8 -> s32 MXU matmul
 (dynamic per-tensor activation quant, per-channel weight scales — the
 same math as quantization.Int8Linear) with bf16 caches/activations.
 
 Decode is memory-bound (every step streams the full weight set), so the
 bench grades tokens/s against the weight-streaming roofline:
-slots / (weight_bytes / HBM_BW).
+slots / (weight_bytes / HBM_BW) — with the cache-traffic term sized
+O(slots x max_seq) for the dense engine and O(active tokens) for the
+paged one (``llama_decode_paged_tokens_per_sec``).
 """
 from __future__ import annotations
 
@@ -42,7 +59,8 @@ from .observability import flight as _flight
 from .observability import metrics as _om
 from .utils import fault_injection as _fi
 
-__all__ = ["LlamaDecodeEngine", "GenerationServer"]
+__all__ = ["LlamaDecodeEngine", "PagedLlamaDecodeEngine",
+           "GenerationServer"]
 
 # process registry instruments (one set across all servers; the
 # per-instance stats() dict stays the legacy view)
@@ -139,6 +157,20 @@ class LlamaDecodeEngine:
             p["head"] = _quantize_w(p["head"])
         self.params = p
 
+        S = self.max_slots
+        # host slot state
+        self.pos = np.zeros(S, np.int32)          # next cache index
+        self.active = np.zeros(S, bool)
+        self.last_ids = np.zeros((S, 1), np.int32)
+
+        from .jit.sot import capture_jit as _capture_jit
+        self._capture_jit = _capture_jit
+        self._init_cache()
+
+    def _init_cache(self) -> None:
+        """Build the DENSE cache layout + its compiled step programs
+        (PagedLlamaDecodeEngine overrides with the block pool)."""
+        cfg = self.cfg
         S, L = self.max_slots, cfg.num_hidden_layers
         kvh = cfg.num_key_value_heads
         # per-LAYER cache arrays (not one stacked [L, ...] array): the
@@ -147,15 +179,9 @@ class LlamaDecodeEngine:
         # at 6 layers x 8 slots x 1024); per-layer donated leaves
         # update in place
         self.k_cache = [jnp.zeros((S, self.max_seq, kvh, self.head_dim),
-                                  dt) for _ in range(L)]
+                                  self.dtype) for _ in range(L)]
         self.v_cache = [jnp.zeros_like(self.k_cache[0])
                         for _ in range(L)]
-
-        # host slot state
-        self.pos = np.zeros(S, np.int32)          # next cache index
-        self.active = np.zeros(S, bool)
-        self.last_ids = np.zeros((S, 1), np.int32)
-
         # caches are donated: each decode step updates them in place in
         # HBM instead of allocating a second [L,S,max_seq,...] copy.
         # The jitted step is registered as a CAPTURED step program
@@ -163,11 +189,9 @@ class LlamaDecodeEngine:
         # (tests/test_capture_plan.py), so every call counts into
         # sot.captured_steps_total and the first compile lands in the
         # flight journal — identical execution to a bare jax.jit
-        from .jit.sot import capture_jit as _capture_jit
-        self._capture_jit = _capture_jit
-        self._decode = _capture_jit(self._decode_impl,
-                                    donate_argnums=(1, 2),
-                                    name="serving.decode")
+        self._decode = self._capture_jit(self._decode_impl,
+                                         donate_argnums=(1, 2),
+                                         name="serving.decode")
         self._decode_collect = None
         self._prefills: Dict[int, object] = {}
 
@@ -431,13 +455,20 @@ class LlamaDecodeEngine:
         self.last_ids = toks[:, -1:].astype(np.int32).copy()
         return toks
 
-    def release(self, slot: int) -> None:
+    def release(self, slot: int, evicted: bool = False) -> None:
+        """Free ``slot`` for the next admission. ``evicted`` marks a
+        reclaim (deadline expiry / failure) — meaningful on the paged
+        engine, where it feeds ``serving.block_evictions_total``;
+        the dense engine's rows are slot-owned either way."""
         self.active[slot] = False
         self.pos[slot] = 0
 
     def generate(self, prompt_ids, max_new_tokens: int = 32,
                  slot: int = 0) -> List[int]:
-        """Single-request convenience path (tests / warm-up)."""
+        """Single-request convenience path (tests / warm-up): prefill
+        into ``slot``'s cache region — dense [max_seq] rows here,
+        freshly allocated pool blocks on the paged engine — then greedy
+        single-token steps until eos/budget/capacity."""
         out = [self.prefill(slot, prompt_ids)]
         for _ in range(max_new_tokens - 1):
             if self.eos_id is not None and out[-1] == self.eos_id:
@@ -451,11 +482,396 @@ class LlamaDecodeEngine:
     def export_decode(self):
         """AOT-serialize the decode step via jax.export — the StableHLO
         artifact a serving process can run without this class (ref: the
-        reference predictor's save/load of an analyzed program)."""
+        reference predictor's save/load of an analyzed program). The
+        exported signature matches the live engine's cache layout:
+        dense per-layer [slots, max_seq, KVH, D] arrays here; the paged
+        engine exports its block-pool signature (pools + block tables +
+        active mask) instead."""
         avals = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (self.params, self.k_cache, self.v_cache,
              jnp.asarray(self.last_ids), jnp.asarray(self.pos)))
+        exported = jax.export.export(jax.jit(self._decode_impl))(*avals)
+        return exported.serialize()
+
+
+class PagedLlamaDecodeEngine(LlamaDecodeEngine):
+    """Paged-KV decode engine: the dense engine's math (weights,
+    projections, rope, int8 matmuls) over a **block-pool cache**.
+
+    Layout: one shared pool per layer ``[num_blocks, block_size, KVH,
+    D]`` (``serving_cache.PagedKVCache``) addressed through per-slot
+    block tables, so KV HBM scales with ACTIVE tokens instead of
+    slots x max_seq. Admission reserves a request's worst-case block
+    count (prompt + generation budget), prompt blocks are mapped
+    immediately, and decode extends one block at a time at step
+    boundaries — extension can therefore never fail mid-stream.
+
+    Prefill is CHUNKED: ``begin_request`` allocates, then
+    ``prefill_chunk`` runs at most ``FLAGS_serving_prefill_chunk``
+    prompt tokens through a bucketed executable per call, writing K/V
+    straight into the slot's blocks; the GenerationServer loop
+    interleaves one chunk with each decode step so a long prompt
+    stalls the in-flight batch by at most one chunk forward.
+
+    The decode step (``_decode_impl``, registered through
+    ``capture_jit`` with the pool pytree donated) walks each slot's
+    block list with the tiled streaming attention
+    (``serving_cache.paged_attention``) — no dense ``[S, max_seq]``
+    score or cache view is ever materialized.
+
+    ``kv_quant``: None stores blocks in the model dtype, "bfloat16"
+    halves f32 pools, "int8" stores absmax codes + per-(token, head)
+    scales (quantize.py math) dequantized per gathered tile.
+    """
+
+    paged = True
+
+    def __init__(self, model, max_slots: int = 4, max_seq: int = 256,
+                 int8: bool = False, eos_id: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 kv_quant: Optional[str] = None,
+                 prefill_chunk: Optional[int] = None):
+        from .core.flags import flag_value
+        self.block_size = int(block_size or
+                              flag_value("serving_block_size"))
+        mbs = -(-int(max_seq) // self.block_size)
+        auto = int(max_slots) * mbs  # dense capacity parity
+        self.num_blocks = int(num_blocks or
+                              flag_value("serving_num_blocks") or auto)
+        if kv_quant not in (None, "bfloat16", "int8"):
+            raise ValueError(
+                f"kv_quant must be None, 'bfloat16' or 'int8', got "
+                f"{kv_quant!r}")
+        self.kv_quant = kv_quant
+        self.prefill_chunk_len = int(
+            prefill_chunk or flag_value("serving_prefill_chunk"))
+        super().__init__(model, max_slots=max_slots, max_seq=max_seq,
+                         int8=int8, eos_id=eos_id)
+
+    def _init_cache(self) -> None:
+        from . import serving_cache as _sc
+        self._sc = _sc
+        cfg = self.cfg
+        kvh = cfg.num_key_value_heads
+        self._kv = _sc.PagedKVCache(
+            max_slots=self.max_slots, max_seq=self.max_seq,
+            block_size=self.block_size, num_blocks=self.num_blocks)
+        pool_dt = {"int8": jnp.int8,
+                   "bfloat16": jnp.bfloat16}.get(self.kv_quant,
+                                                 self.dtype)
+        NB, bs, L = self.num_blocks, self.block_size, \
+            cfg.num_hidden_layers
+        kv = {"k": [jnp.zeros((NB, bs, kvh, self.head_dim), pool_dt)
+                    for _ in range(L)],
+              "v": [jnp.zeros((NB, bs, kvh, self.head_dim), pool_dt)
+                    for _ in range(L)]}
+        if self.kv_quant == "int8":
+            kv["ksc"] = [jnp.zeros((NB, bs, kvh), jnp.float32)
+                         for _ in range(L)]
+            kv["vsc"] = [jnp.zeros((NB, bs, kvh), jnp.float32)
+                         for _ in range(L)]
+        self.kvs = kv
+        # the pool pytree is donated each step/chunk: K/V writes land
+        # in place in HBM, and capture_jit keeps the paged step inside
+        # captured-step accounting exactly like the dense one
+        self._decode = self._capture_jit(self._decode_impl,
+                                         donate_argnums=(1,),
+                                         name="serving.paged_decode")
+        self._decode_collect = None
+        self._prefills: Dict[int, object] = {}
+        self._prefill_state: Dict[int, dict] = {}
+
+    # -- device side --------------------------------------------------------
+    def _write_kv(self, kvl, k, v, positions, tables, wmask):
+        """Scatter rope'd K/V rows [S, T, KVH, D] into their (physical
+        block, offset) cells; rows with ``wmask`` False or an unmapped
+        table entry are dropped (OOB index), so prefill padding and
+        inactive slots never touch a real block."""
+        S, T = positions.shape
+        bidx = jnp.minimum(positions // self.block_size,
+                           self._kv.max_blocks_per_slot - 1)
+        phys = jnp.take_along_axis(tables, bidx, axis=1)
+        ok = jnp.logical_and(wmask, phys >= 0)
+        phys = jnp.where(ok, phys, self.num_blocks).reshape(-1)
+        off = (positions % self.block_size).reshape(-1)
+        kf = k.reshape((S * T,) + k.shape[2:])
+        vf = v.reshape((S * T,) + v.shape[2:])
+        out = dict(kvl)
+        if self.kv_quant == "int8":
+            kq, ks = self._sc.absmax_quantize(kf)
+            vq, vs = self._sc.absmax_quantize(vf)
+            out["k"] = self._sc.write_kv_tokens(kvl["k"], phys, off, kq)
+            out["v"] = self._sc.write_kv_tokens(kvl["v"], phys, off, vq)
+            out["ksc"] = self._sc.write_kv_tokens(kvl["ksc"], phys,
+                                                  off, ks)
+            out["vsc"] = self._sc.write_kv_tokens(kvl["vsc"], phys,
+                                                  off, vs)
+        else:
+            out["k"] = self._sc.write_kv_tokens(kvl["k"], phys, off, kf)
+            out["v"] = self._sc.write_kv_tokens(kvl["v"], phys, off, vf)
+        return out
+
+    def _block_paged(self, lp, h, kvl, positions, tables, n_tiles,
+                     wmask):
+        """One decoder layer over [S, T, H] with block-pool K/V writes
+        and the tiled streaming attention."""
+        S, T, H = h.shape
+        kvh = self.cfg.num_key_value_heads
+        res = h
+        x = self._rms(h, lp["in_ln"])
+        q = self._mm(x, lp["q_proj"]).reshape(
+            S, T, self.cfg.num_attention_heads, self.head_dim)
+        k = self._mm(x, lp["k_proj"]).reshape(S, T, kvh, self.head_dim)
+        v = self._mm(x, lp["v_proj"]).reshape(S, T, kvh, self.head_dim)
+        q = self._rope(q, positions)
+        k = self._rope(k, positions)
+        kvl = self._write_kv(kvl, k, v, positions, tables, wmask)
+        att = self._sc.paged_attention(
+            q, kvl["k"], kvl["v"], tables, positions,
+            block_size=self.block_size, n_rep=self.n_rep,
+            n_tiles=n_tiles, k_scale=kvl.get("ksc"),
+            v_scale=kvl.get("vsc"))
+        h = res + self._mm(att.reshape(S, T, H), lp["o_proj"])
+        res = h
+        x = self._rms(h, lp["post_ln"])
+        ff = self._mm(jax.nn.silu(
+            self._mm(x, lp["gate_proj"]).astype(jnp.float32)).astype(
+                x.dtype) * self._mm(x, lp["up_proj"]),
+            lp["down_proj"])
+        return res + ff, kvl
+
+    def _forward_paged(self, params, kv, ids, positions, tables,
+                       n_tiles, wmask):
+        """Shared chunked-prefill/decode body: ids [S, T] -> logits
+        [S, T, V]; the pool pytree is donated, writes land in place."""
+        h = jnp.take(params["emb"], ids, axis=0).astype(self.dtype)
+        out_kv = {key: [] for key in kv}
+        for li, lp in enumerate(params["layers"]):
+            kvl = {key: kv[key][li] for key in kv}
+            h, kvl = self._block_paged(lp, h, kvl, positions, tables,
+                                       n_tiles, wmask)
+            for key in out_kv:
+                out_kv[key].append(kvl[key])
+        h = self._rms(h, params["norm"])
+        logits = self._mm(h, params["head"])
+        # same MXU-vs-fused-argmax barrier as the dense engine
+        logits = jax.lax.optimization_barrier(logits)
+        return logits, out_kv
+
+    def _decode_impl(self, params, kv, last_ids, pos, tables, act):
+        """One token for every slot: ids [S,1], pos [S] = write
+        position, tables [S, max_blocks] block tables, act [S] bool
+        (inactive slots neither write nor advance). The block walk is
+        bounded by the LONGEST active history, so short batches pay
+        only their own tiles."""
+        positions = pos[:, None]                        # [S, 1]
+        n_tiles = jnp.max(pos) // self.block_size + 1
+        logits, kv = self._forward_paged(params, kv, last_ids,
+                                         positions, tables, n_tiles,
+                                         act[:, None])
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return nxt, kv
+
+    def _prefill_impl(self, params, kv, ids, table_row, start, nvalid,
+                      true_len):
+        """ONE prompt chunk for ONE slot: ids [1, B] (bucket-padded)
+        holds prompt tokens [start, start+nvalid); rows write into the
+        slot's blocks and attend to every earlier position (previous
+        chunks' blocks + causal within the chunk). Returns the greedy
+        token at the prompt's LAST position — meaningful only on the
+        final chunk (the host ignores it before that)."""
+        B = ids.shape[1]
+        offs = jnp.arange(B)
+        positions = (start + offs)[None, :]             # [1, B]
+        wmask = (offs < nvalid)[None, :]
+        tables = table_row[None, :]
+        n_tiles = (start + nvalid - 1) // self.block_size + 1
+        logits, kv = self._forward_paged(params, kv, ids, positions,
+                                         tables, n_tiles, wmask)
+        last = jnp.clip(true_len - 1 - start, 0, B - 1)
+        tok = jnp.argmax(logits[0, last, :]).astype(jnp.int32)
+        return tok, kv
+
+    def _decode_collect_impl(self, params, kv, last_ids, pos, buf, i,
+                             tables, act):
+        """Decode step + on-device token collection (buf [S, n]
+        donated; column i written in-place)."""
+        nxt, kv = self._decode_impl(params, kv, last_ids, pos, tables,
+                                    act)
+        buf = jax.lax.dynamic_update_slice(buf, nxt[:, None],
+                                           (jnp.int32(0), i))
+        return nxt, kv, buf
+
+    # -- host orchestration -------------------------------------------------
+    def begin_request(self, slot: int, prompt_ids,
+                      max_new_tokens: int) -> bool:
+        """Admit a request into ``slot``: map blocks for the prompt
+        and reserve its worst-case generation budget. Returns False
+        when the pool cannot cover it right now (caller should keep
+        the request queued — exhaustion queues, never crashes);
+        raises ValueError for a request the pool could NEVER hold."""
+        prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = int(prompt_ids.shape[0])
+        if not 0 < n <= self.max_seq - 1:
+            raise ValueError(
+                f"prompt length {n} not in [1, {self.max_seq - 1}]")
+        total = min(n + max(int(max_new_tokens), 1), self.max_seq)
+        if not self._kv.admit(slot, n, total):
+            return False
+        self._prefill_state[slot] = {"ids": prompt_ids, "next": 0}
+        self.pos[slot] = 0
+        self.active[slot] = False
+        return True
+
+    def prefill_chunk(self, slot: int) -> Optional[int]:
+        """Run the next prompt chunk for ``slot``. Returns None while
+        prefill is incomplete; on the final chunk, activates the slot
+        and returns the first generated token (greedy)."""
+        st = self._prefill_state[slot]
+        ids, start = st["ids"], st["next"]
+        n = int(ids.shape[0])
+        c = min(self.prefill_chunk_len, n - start)
+        b = min(self._bucket(c), self.prefill_chunk_len)
+        if b not in self._prefills:
+            self._prefills[b] = self._capture_jit(
+                self._prefill_impl, donate_argnums=(1,),
+                name="serving.paged_prefill")
+        padded = np.zeros((1, b), np.int32)
+        padded[0, :c] = ids[start:start + c]
+        row = jnp.asarray(self._kv.block_tables[slot])
+        tok, self.kvs = self._prefills[b](
+            self.params, self.kvs, jnp.asarray(padded), row,
+            jnp.int32(start), jnp.int32(c), jnp.int32(n))
+        st["next"] = start + c
+        if st["next"] < n:
+            return None
+        first = int(tok)
+        del self._prefill_state[slot]
+        self.pos[slot] = n
+        self.active[slot] = True
+        self.last_ids[slot, 0] = first
+        return first
+
+    def prefill(self, slot: int, prompt_ids,
+                budget: Optional[int] = None) -> int:
+        """One-shot prefill (dense-API compat: tests / direct use):
+        admits with ``budget`` generation tokens reserved (default:
+        the worst case, max_seq - len(prompt)) and runs every chunk
+        back to back. The server path uses begin_request +
+        prefill_chunk instead to interleave with decode."""
+        prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = int(prompt_ids.shape[0])
+        if budget is None:
+            budget = self.max_seq - n
+        if not self.begin_request(slot, prompt_ids, budget):
+            raise RuntimeError(
+                f"KV block pool exhausted admitting slot {slot} "
+                f"({self._kv.stats()}); release a slot or raise "
+                f"FLAGS_serving_num_blocks")
+        while True:
+            first = self.prefill_chunk(slot)
+            if first is not None:
+                return first
+
+    def _extend_tables(self) -> None:
+        """Step-boundary block extension: map the block covering each
+        active slot's next write position (drawn from its admission
+        reservation, so this cannot fail)."""
+        for s in range(self.max_slots):
+            if self.active[s]:
+                self._kv.ensure_token(s, int(self.pos[s]))
+
+    def step(self) -> np.ndarray:
+        """One decode iteration for ALL active slots; returns next
+        token per slot (garbage for inactive slots — callers consult
+        .active)."""
+        self._extend_tables()
+        tables = jnp.asarray(self._kv.block_tables)
+        act = jnp.asarray(self.active)
+        nxt, self.kvs = self._decode(
+            self.params, self.kvs, jnp.asarray(self.last_ids),
+            jnp.asarray(self.pos), tables, act)
+        nxt = np.asarray(nxt)
+        for s in range(self.max_slots):
+            if self.active[s]:
+                self.pos[s] += 1
+                self.last_ids[s, 0] = nxt[s]
+        return nxt
+
+    def decode_steps(self, n: int) -> np.ndarray:
+        """``n`` chained decode iterations with DEVICE-resident token
+        feedback (one host fetch closes the window) — the dense
+        engine's contract over the block pool. Blocks for the whole
+        window are mapped up front so the device-side table stays
+        valid without host round-trips."""
+        if not self.active.all():
+            raise ValueError(
+                "decode_steps advances EVERY slot; use step() when "
+                "some slots are free (the continuous-batching server "
+                "path)")
+        if int(self.pos.max()) + n > self.max_seq - 1:
+            raise ValueError(
+                f"decode_steps({n}) would write past the "
+                f"{self.max_seq}-token capacity (max pos "
+                f"{int(self.pos.max())})")
+        for s in range(self.max_slots):
+            self._kv.reserve_through(s, int(self.pos[s]) + n - 1)
+        if self._decode_collect is None:
+            self._decode_collect = self._capture_jit(
+                self._decode_collect_impl, donate_argnums=(1, 4),
+                name="serving.paged_decode_window")
+        ids = jnp.asarray(self.last_ids)
+        pos = jnp.asarray(self.pos)
+        tables = jnp.asarray(self._kv.block_tables)
+        act = jnp.asarray(self.active)
+        buf = jnp.zeros((self.max_slots, n), jnp.int32)
+        for i in range(n):
+            nxt, self.kvs, buf = self._decode_collect(
+                self.params, self.kvs, ids, pos, buf, jnp.int32(i),
+                tables, act)
+            ids = nxt[:, None]
+            pos = pos + 1
+        toks = np.asarray(buf)                      # the one fetch
+        self.pos += n
+        self.last_ids = toks[:, -1:].astype(np.int32).copy()
+        return toks
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32,
+                 slot: int = 0) -> List[int]:
+        """Single-request convenience path over the block pool: the
+        admission reservation is sized to ``max_new_tokens`` so a
+        short request holds only its own blocks."""
+        out = [self.prefill(slot, prompt_ids, budget=max_new_tokens)]
+        for _ in range(max_new_tokens - 1):
+            if self.eos_id is not None and out[-1] == self.eos_id:
+                break
+            if self.pos[slot] >= self.max_seq - 1:
+                break
+            out.append(int(self.step()[slot]))
+        self.release(slot)
+        return out
+
+    def release(self, slot: int, evicted: bool = False) -> None:
+        """Free the slot AND return its blocks + reservation to the
+        pool; ``evicted=True`` (expiry/failure/cancellation) counts
+        them into ``serving.block_evictions_total``."""
+        self.active[slot] = False
+        self.pos[slot] = 0
+        self._prefill_state.pop(slot, None)
+        self._kv.release(slot, evicted=evicted)
+
+    def export_decode(self):
+        """AOT-serialize the PAGED decode step via jax.export: the
+        signature carries the block pools, per-slot block tables and
+        the active mask, so a serving process can run the streaming
+        decode step without this class."""
+        avals = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (self.params, self.kvs, jnp.asarray(self.last_ids),
+             jnp.asarray(self.pos), jnp.asarray(self._kv.block_tables),
+             jnp.asarray(self.active)))
         exported = jax.export.export(jax.jit(self._decode_impl))(*avals)
         return exported.serialize()
 
@@ -468,20 +884,37 @@ class GenerationServer:
     to finish (ref role: the multi-stream request loop of the
     reference's serving predictor).
 
+    With a :class:`PagedLlamaDecodeEngine` the loop additionally
+    splits prefill from decode: admission allocates + reserves KV
+    blocks (pool exhaustion defers the request — it WAITS for blocks,
+    it never crashes the loop), and each iteration advances at most
+    ONE prompt chunk before the decode step, so a long prompt admitted
+    mid-stream costs already-decoding requests one chunk forward per
+    step instead of the whole prompt.
+
     Robustness contract: ``submit(..., deadline=s)`` bounds a request's
-    wall time — expiry (checked at step boundaries, queued or active)
-    fails THAT request with TimeoutError, keeping whatever tokens it
-    already produced in ``req["out"]``. ``shutdown()`` drains: new
-    submissions are rejected immediately, in-flight and already-queued
-    requests run to completion, then the loop exits — no completed
-    token is ever dropped by a shutdown."""
+    wall time — expiry (checked at step boundaries; queued, waiting
+    for blocks, prefilling or active) fails THAT request with
+    TimeoutError, keeping whatever tokens it already produced in
+    ``req["out"]`` (and returning its KV blocks as counted evictions).
+    ``shutdown()`` drains: new submissions are rejected immediately,
+    in-flight and already-queued requests run to completion, then the
+    loop exits — no completed token is ever dropped by a shutdown."""
 
     _STOP = object()  # queue sentinel: wake the loop for shutdown
 
     def __init__(self, engine: LlamaDecodeEngine):
         self.engine = engine
+        self._paged = bool(getattr(engine, "paged", False))
         self._q: "_queue.Queue" = _queue.Queue()
         self._slots: Dict[int, dict] = {}
+        # paged engines split admission from activation: a slot in
+        # _prefilling holds blocks and runs one prompt chunk per loop
+        # iteration; _waiting holds admitted-order requests deferred
+        # because the block pool couldn't cover their reservation yet
+        self._prefilling: Dict[int, dict] = {}
+        self._waiting: List[dict] = []
+        self._cancel_waiting = False  # set by shutdown(drain=False)
         self.steps_run = 0
         self.admitted = 0
         self.rejected = 0           # submissions after shutdown
@@ -636,23 +1069,134 @@ class GenerationServer:
                        trace_id=req.get("trace_id"), slot=slot)
         self._finish_if_done(slot, req)
 
+    def _release_slot(self, slot, evicted: bool = False) -> None:
+        """Free an engine slot on a failure/expiry path. Only paged
+        engines take the eviction marker (it feeds
+        serving.block_evictions_total); duck-typed dense engines keep
+        the bare release(slot) contract."""
+        if self._paged:
+            self.engine.release(slot, evicted=evicted)
+        else:
+            self.engine.release(slot)
+
     def _free_slots(self):
         eng = self.engine
-        return [s for s in range(eng.max_slots) if not eng.active[s]]
+        return [s for s in range(eng.max_slots)
+                if not eng.active[s] and s not in self._prefilling]
+
+    def _admit_paged(self, req, slot) -> str:
+        """Paged admission: allocate + reserve blocks and start the
+        chunked prefill. Returns 'admitted', 'defer' (pool cannot
+        cover the reservation yet — exhaustion queues, never
+        crashes) or 'dropped' (sentinel/expired/failed)."""
+        eng = self.engine
+        if req is self._STOP or req["done"].is_set():
+            return "dropped"
+        if self._expired(req):
+            self.deadline_expired += 1
+            _M_expired.inc()
+            self._fail(req, TimeoutError(
+                "request deadline expired while queued"))
+            return "dropped"
+        try:
+            ok = eng.begin_request(slot, req["prompt"], req["max_new"])
+        except Exception as e:  # noqa: BLE001 — surfaced per request
+            self._fail(req, e)
+            return "dropped"
+        if not ok:
+            return "defer"
+        req["t_admit"] = time.monotonic()
+        _M_queue_s.observe(req["t_admit"] - req["t0"])
+        self._prefilling[slot] = req
+        self.admitted += 1
+        _M_admitted.inc()
+        _flight.record("serving", "admitted",
+                       trace_id=req.get("trace_id"), slot=slot)
+        return "admitted"
 
     def _admit(self):
+        if not self._paged:
+            free = self._free_slots()
+            while free:
+                try:
+                    req = self._q.get_nowait()
+                except _queue.Empty:
+                    return
+                if req is self._STOP or req["done"].is_set():
+                    continue  # sentinel, or failed while queued
+                self._admit_one(req, free[0])
+                if req["done"].is_set() and req["error"] is not None:
+                    continue  # rejected before prefill: slot still free
+                free.pop(0)
+            return
+        if self._cancel_waiting:
+            # shutdown(drain=False) signalled: cancel block-deferred
+            # requests HERE, on the loop thread — failing them from
+            # the shutdown thread would race this function's
+            # done-check-then-admit sequence (a request could be
+            # cancelled and admitted simultaneously)
+            for req in self._waiting:
+                if not req["done"].is_set():
+                    self._fail(req, RuntimeError(
+                        "request cancelled: server shut down before "
+                        "admission"))
+            self._waiting = []
         free = self._free_slots()
-        while free:
+        # block-deferred requests retry first, and HOLD THE LINE: while
+        # any of them still cannot be covered, nothing newer is pulled
+        # from the queue — otherwise a stream of small later requests
+        # would keep re-consuming every freed block and starve a large
+        # deferred request forever (fairness over utilization; the
+        # backlog accrues queue_seconds and deadlines as usual)
+        still: List[dict] = []
+        for req in self._waiting:
+            if req["done"].is_set():
+                continue  # cancelled/expired while deferred
+            if not free:
+                still.append(req)
+                continue
+            verdict = self._admit_paged(req, free[0])
+            if verdict == "admitted":
+                free.pop(0)
+            elif verdict == "defer":
+                still.append(req)
+        self._waiting = still
+        while free and not self._waiting:
             try:
                 req = self._q.get_nowait()
             except _queue.Empty:
                 return
-            if req is self._STOP or req["done"].is_set():
-                continue  # sentinel, or failed while queued (deadline)
-            self._admit_one(req, free[0])
-            if req["done"].is_set() and req["error"] is not None:
-                continue  # rejected before prefill: the slot is still free
-            free.pop(0)
+            verdict = self._admit_paged(req, free[0])
+            if verdict == "admitted":
+                free.pop(0)
+            elif verdict == "defer":
+                self._waiting.append(req)
+
+    def _run_prefill(self):
+        """Advance ONE prompt chunk of the OLDEST-admitted prefilling
+        slot (dict insertion order — slot-index order would let a
+        newer request admitted into a lower slot starve an older
+        in-progress prefill) — the prefill/decode interleave: each
+        loop iteration costs at most one chunk forward on top of the
+        decode step, so already-admitted slots keep streaming."""
+        for slot in list(self._prefilling):
+            req = self._prefilling[slot]
+            try:
+                first = self.engine.prefill_chunk(slot)
+            except Exception as e:  # noqa: BLE001 — per-request
+                del self._prefilling[slot]
+                self._release_slot(slot, evicted=True)
+                self._fail(req, e)
+                return
+            if first is not None:
+                del self._prefilling[slot]
+                req["out"].append(first)
+                self._slots[slot] = req
+                _flight.record("serving", "prefilled",
+                               trace_id=req.get("trace_id"), slot=slot,
+                               prompt_len=int(req["prompt"].shape[0]))
+                self._finish_if_done(slot, req)
+            return
 
     def _finish_if_done(self, slot, req):
         eng = self.engine
@@ -671,19 +1215,40 @@ class GenerationServer:
         return done
 
     def _expire_active(self):
-        """Step-boundary deadline sweep: an expired active request is
-        failed with TimeoutError and its slot freed; the tokens it
-        already produced stay in ``req['out']``."""
+        """Step-boundary deadline sweep over active, prefilling and
+        block-waiting requests: an expired request is failed with
+        TimeoutError and its slot/blocks freed (paged blocks count as
+        EVICTIONS — serving.block_evictions_total); tokens already
+        produced stay in ``req['out']``."""
         for slot in list(self._slots):
             req = self._slots[slot]
             if self._expired(req):
                 self.deadline_expired += 1
                 _M_expired.inc()
-                self.engine.release(slot)
+                self._release_slot(slot, evicted=True)
                 del self._slots[slot]
                 self._fail(req, TimeoutError(
                     f"request deadline expired after "
                     f"{len(req['out'])} token(s)"))
+        for slot in list(self._prefilling):
+            req = self._prefilling[slot]
+            if self._expired(req):
+                self.deadline_expired += 1
+                _M_expired.inc()
+                self._release_slot(slot, evicted=True)
+                del self._prefilling[slot]
+                self._fail(req, TimeoutError(
+                    "request deadline expired during prefill"))
+        still = []
+        for req in self._waiting:
+            if not req["done"].is_set() and self._expired(req):
+                self.deadline_expired += 1
+                _M_expired.inc()
+                self._fail(req, TimeoutError(
+                    "request deadline expired waiting for KV blocks"))
+            elif not req["done"].is_set():
+                still.append(req)
+        self._waiting = still
 
     def _expire_queued(self):
         """Fail expired requests still WAITING in the queue — even when
@@ -704,7 +1269,16 @@ class GenerationServer:
         while True:
             try:
                 self._admit()
+                if self._paged and self._prefilling:
+                    self._run_prefill()
                 if not self._slots:
+                    if self._prefilling or self._waiting:
+                        # prompts still chunking / requests waiting on
+                        # blocks: keep cycling (no decode batch yet)
+                        self._expire_active()
+                        self._expire_queued()
+                        self._set_gauges()
+                        continue
                     if self._stopping.is_set() and self._q.empty():
                         break  # drained: nothing active, nothing queued
                     # idle: block for the next request and admit it
@@ -713,6 +1287,12 @@ class GenerationServer:
                     self._set_gauges()  # idle: a scrape must read 0
                     req = self._q.get()
                     if req is self._STOP:
+                        continue
+                    if self._paged:
+                        verdict = self._admit_paged(
+                            req, self._free_slots()[0])
+                        if verdict == "defer":
+                            self._waiting.append(req)
                         continue
                     self._admit_one(req, self._free_slots()[0])
                     continue
@@ -744,15 +1324,21 @@ class GenerationServer:
                                error=type(e).__name__)
                 for slot, req in list(self._slots.items()):
                     self._fail(req, e)
-                    self.engine.release(slot)
+                    self._release_slot(slot, evicted=True)
                 self._slots.clear()
+                for slot, req in list(self._prefilling.items()):
+                    self._fail(req, e)
+                    self._release_slot(slot, evicted=True)
+                self._prefilling.clear()
                 self._set_gauges()
         self._set_gauges()
         self._drained.set()
 
     def _set_gauges(self) -> None:
-        _G_queue.set(self._q.qsize())
-        _G_inflight.set(len(self._slots))
+        # block-deferred requests are still queued work: a scrape must
+        # see them (queue_seconds keeps accruing for them too)
+        _G_queue.set(self._q.qsize() + len(self._waiting))
+        _G_inflight.set(len(self._slots) + len(self._prefilling))
 
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = 300.0) -> bool:
@@ -765,7 +1351,12 @@ class GenerationServer:
         with self._submit_lock:
             self._stopping.set()
         if not drain:
-            # cancel queued work; requests already in slots complete
+            # cancel queued work; requests already in slots complete.
+            # Queue pops are atomic (whoever pops a request owns
+            # failing it), but the _waiting list belongs to the loop
+            # thread — signal it to cancel those at its next admission
+            # pass instead of racing its done-check-then-admit sequence
+            self._cancel_waiting = True
             while True:
                 try:
                     req = self._q.get_nowait()
@@ -802,9 +1393,14 @@ class GenerationServer:
             queued = sum(1 for r in self._q.queue
                          if r is not self._STOP
                          and not r["done"].is_set())
-        return {"steps_run": self.steps_run, "admitted": self.admitted,
-                "rejected": self.rejected,
-                "deadline_expired": self.deadline_expired,
-                "in_flight": len(self._slots), "queued": queued,
-                "draining": int(self._stopping.is_set()),
-                "drained": int(self._drained.is_set())}
+        out = {"steps_run": self.steps_run, "admitted": self.admitted,
+               "rejected": self.rejected,
+               "deadline_expired": self.deadline_expired,
+               "in_flight": len(self._slots), "queued": queued,
+               "prefilling": len(self._prefilling),
+               "waiting_for_blocks": len(self._waiting),
+               "draining": int(self._stopping.is_set()),
+               "drained": int(self._drained.is_set())}
+        if self._paged:
+            out["kv_pool"] = self.engine._kv.stats()
+        return out

@@ -1,0 +1,356 @@
+"""Paged KV cache for generation serving: block pool, block tables,
+and the tiled block-table-gathered streaming attention step.
+
+The dense serving cache (`serving.LlamaDecodeEngine`) burns HBM
+proportional to *capacity*: every slot owns `max_seq` K/V rows per
+layer whether it holds a 4-token prompt or a full context. This module
+replaces those rows with a **shared per-layer block pool**
+``[num_blocks, block_size, KVH, D]`` plus per-slot **block tables**
+mapping logical block index -> physical block, so HBM scales with
+*active tokens* and a pool sized for N dense slots admits far more
+short requests (the vLLM design; here grounded in the
+FlashAttention-2/CUTLASS memory-streaming tiling of PAPERS.md).
+
+Three pieces live here, deliberately factored apart:
+
+- :class:`PagedKVCache` — the HOST side: a free-list block allocator
+  with admission-time budget *reservations* (a request is admitted
+  only if its worst-case block count fits, so extension at step
+  boundaries can never fail mid-decode), per-slot block tables, and
+  the block-pool telemetry (``serving.blocks_free`` /
+  ``blocks_used`` gauges, ``block_evictions_total`` counter, flight
+  events for alloc/free/exhaustion).
+- :func:`paged_attention` — the DEVICE side: a tiled, online-softmax
+  streaming attention step that walks a slot's block list one
+  ``block_size`` tile at a time, never materializing a dense
+  ``[S, max_seq]`` score or cache view. Pure jnp on the tier-1/CPU
+  path; the tiling is factored as one function with a flat
+  (q, pools, tables, positions) signature precisely so a Pallas TPU
+  kernel can drop in behind the same seam (ROADMAP item 3's
+  block-table-aware variant).
+- :func:`write_kv_tokens` / :func:`absmax_quantize` — the scatter of
+  freshly computed K/V rows into (physical block, offset) cells, with
+  optional int8 block storage using the same symmetric absmax math as
+  ``quantization/quantize.py``'s ``quant_absmax`` (dynamic per-token
+  per-head scales, calibration-free because decode K/V are visible).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .observability import flight as _flight
+from .observability import metrics as _om
+
+__all__ = ["PagedKVCache", "paged_attention", "write_kv_tokens",
+           "absmax_quantize"]
+
+_M = _om.scope("serving")
+_G_blocks_free = _M.gauge(
+    "blocks_free",
+    "Paged KV pool blocks available for admission (free minus "
+    "outstanding budget reservations)")
+_G_blocks_used = _M.gauge(
+    "blocks_used", "Paged KV pool blocks physically mapped to slots")
+_M_evictions = _M.counter(
+    "block_evictions_total",
+    "Paged KV blocks reclaimed from expired/failed/cancelled requests "
+    "(normal completion frees blocks without counting here)")
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-int(a) // int(b))
+
+
+class PagedKVCache:
+    """Host-side paged-KV bookkeeping: free-list allocator + block
+    tables + budget reservations.
+
+    The invariant that makes mid-decode exhaustion impossible:
+    ``len(free) >= reserved_total`` at all times. ``admit`` only
+    succeeds when the request's WORST-CASE block count (prompt +
+    generation budget) fits into ``free - reserved_total``; blocks
+    for the prompt are mapped immediately, the rest stay *reserved*
+    and are materialized one at a time by ``ensure_token`` as decode
+    crosses block boundaries. ``release`` returns both.
+
+    Thread safety: mutations are guarded by an instrumented lock
+    (``analysis.locks.make_lock``) — the server loop is the only
+    writer in production, but tests and direct engine use may churn
+    from other threads.
+    """
+
+    def __init__(self, max_slots: int, max_seq: int, block_size: int,
+                 num_blocks: int):
+        self.block_size = int(block_size)
+        if self.block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.max_blocks_per_slot = _ceil_div(max_seq, self.block_size)
+        self.num_blocks = int(num_blocks)
+        if self.num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        # logical block index -> physical block id; -1 = unmapped. The
+        # decode step receives this (as a device array) every step and
+        # drops writes/reads through unmapped entries.
+        self.block_tables = np.full(
+            (int(max_slots), self.max_blocks_per_slot), -1, np.int32)
+        # LIFO free list popping block 0 first (stable tests/debug)
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._owned: Dict[int, List[int]] = {}
+        self._reserved: Dict[int, int] = {}
+        self._reserved_total = 0
+        self.evictions = 0
+        from .analysis.locks import make_lock
+        self._lock = make_lock("serving.kv_pool")
+        self._sync_gauges()
+
+    # -- accounting ---------------------------------------------------------
+    def available_blocks(self) -> int:
+        """Blocks an admission may still claim (free minus reserved)."""
+        return len(self._free) - self._reserved_total
+
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def stats(self) -> Dict[str, int]:
+        return {"num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "blocks_free": len(self._free),
+                "blocks_available": self.available_blocks(),
+                "blocks_used": self.used_blocks(),
+                "blocks_reserved": self._reserved_total,
+                "evictions": self.evictions}
+
+    def _sync_gauges(self) -> None:
+        _G_blocks_free.set(self.available_blocks())
+        _G_blocks_used.set(self.used_blocks())
+
+    # -- allocator ----------------------------------------------------------
+    def admit(self, slot: int, prompt_tokens: int,
+              total_tokens: int) -> bool:
+        """Admit a request into ``slot``: map blocks for its
+        ``prompt_tokens`` now and reserve the rest of its
+        ``total_tokens`` worst case. Returns False (request should
+        wait) when the pool cannot cover the reservation; raises
+        ValueError when it NEVER could (need exceeds the whole pool),
+        so an impossible request fails loudly instead of queueing
+        forever."""
+        slot = int(slot)
+        now = _ceil_div(max(int(prompt_tokens), 1), self.block_size)
+        total = min(max(_ceil_div(total_tokens, self.block_size), now),
+                    self.max_blocks_per_slot)
+        with self._lock:
+            if total > self.num_blocks:
+                raise ValueError(
+                    f"request needs {total} KV blocks "
+                    f"({total_tokens} tokens at block_size "
+                    f"{self.block_size}) but the pool holds only "
+                    f"{self.num_blocks}; raise FLAGS_serving_num_blocks "
+                    f"or shrink the request")
+            if slot in self._owned:
+                raise ValueError(f"slot {slot} already holds KV blocks")
+            if total > self.available_blocks():
+                avail = self.available_blocks()
+            else:
+                blocks = [self._free.pop() for _ in range(now)]
+                self._owned[slot] = blocks
+                self._reserved[slot] = total - now
+                self._reserved_total += total - now
+                self.block_tables[slot, :now] = blocks
+                self._sync_gauges()
+                avail = None
+        if avail is not None:
+            _flight.record("serving", "block_exhausted", slot=slot,
+                           need=total, available=avail)
+            return False
+        _flight.record("serving", "block_alloc", slot=slot,
+                       blocks=now, reserved=total - now,
+                       available=self.available_blocks())
+        return True
+
+    def ensure_token(self, slot: int, pos: int) -> None:
+        """Map the block covering position ``pos`` of ``slot`` if it
+        is not mapped yet, drawing down the slot's admission-time
+        reservation (step-boundary extension). A RuntimeError here is
+        a caller bug: the budget passed to ``admit`` was too small."""
+        slot, pos = int(slot), int(pos)
+        bidx = pos // self.block_size
+        if bidx >= self.max_blocks_per_slot:
+            raise ValueError(
+                f"position {pos} is past the cache capacity "
+                f"({self.max_blocks_per_slot * self.block_size} tokens)")
+        if self.block_tables[slot, bidx] >= 0:
+            return
+        with self._lock:
+            if self.block_tables[slot, bidx] >= 0:
+                return  # raced: another thread mapped it first — a
+                # double-pop here would orphan a block AND over-draw
+                # the reservation (the check above is lock-free)
+            if self._reserved.get(slot, 0) <= 0:
+                raise RuntimeError(
+                    f"slot {slot} has no KV reservation left at pos "
+                    f"{pos} — the generation budget passed at admission "
+                    f"was too small")
+            b = self._free.pop()
+            self._reserved[slot] -= 1
+            self._reserved_total -= 1
+            self._owned[slot].append(b)
+            self.block_tables[slot, bidx] = b
+            self._sync_gauges()
+        _flight.record("serving", "block_alloc", slot=slot, blocks=1,
+                       block_index=bidx,
+                       available=self.available_blocks())
+
+    def reserve_through(self, slot: int, pos: int) -> None:
+        """Materialize every block covering positions [0, pos] — the
+        decode-window pre-extension (``decode_steps`` needs a block
+        table that stays valid for the whole device-resident loop)."""
+        last = min(int(pos) // self.block_size,
+                   self.max_blocks_per_slot - 1)
+        for bidx in range(last + 1):
+            if self.block_tables[int(slot), bidx] < 0:
+                self.ensure_token(slot, bidx * self.block_size)
+
+    def release(self, slot: int, evicted: bool = False) -> int:
+        """Return all of ``slot``'s blocks and cancel its reservation.
+        ``evicted=True`` marks a reclaim (deadline expiry, failure,
+        cancellation) and bumps ``serving.block_evictions_total``;
+        normal completion leaves the counter alone."""
+        slot = int(slot)
+        with self._lock:
+            blocks = self._owned.pop(slot, [])
+            resv = self._reserved.pop(slot, 0)
+            self._reserved_total -= resv
+            self._free.extend(blocks)
+            self.block_tables[slot, :] = -1
+            if evicted and blocks:
+                self.evictions += len(blocks)
+            self._sync_gauges()
+        if evicted and blocks:
+            _M_evictions.inc(len(blocks))
+        if blocks or resv:
+            _flight.record("serving", "block_free", slot=slot,
+                           blocks=len(blocks), evicted=bool(evicted),
+                           available=self.available_blocks())
+        return len(blocks)
+
+    def active_tokens(self, pos: np.ndarray,
+                      active: np.ndarray) -> int:
+        """Tokens currently resident across active slots (the paged
+        roofline's cache-traffic term: O(active tokens), not
+        O(slots x max_seq))."""
+        return int(sum(int(p) for p, a in zip(pos, active) if a))
+
+
+# ---------------------------------------------------------------------------
+# device side: quantized block writes + tiled streaming attention
+# ---------------------------------------------------------------------------
+
+def absmax_quantize(x, bits: int = 8):
+    """Symmetric per-(token, head) absmax int8 of K/V rows
+    ``[N, KVH, D]`` -> ``(codes int8 [N, KVH, D], scale f32 [N, KVH])``
+    — the ``quantization.quantize.quant_absmax`` step computation
+    (dynamic absmax over the head dim, qmax = 2^(bits-1) - 1), kept
+    raw-code-valued here because the pool STORES the codes and the
+    attention tiles dequantize on gather."""
+    qmax = float(2 ** (bits - 1) - 1)
+    a = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(a), axis=-1), 1e-8) / qmax
+    codes = jnp.clip(jnp.round(a / scale[..., None]),
+                     -qmax, qmax).astype(jnp.int8)
+    return codes, scale
+
+
+def write_kv_tokens(pool, phys, off, vals):
+    """Scatter ``vals [N, ...]`` into ``pool[phys[i], off[i]]`` cells;
+    rows whose ``phys`` is out of range (the caller maps invalid rows
+    to ``num_blocks``) are dropped, so padded prefill rows and
+    inactive decode slots never touch a real block."""
+    return pool.at[phys, off].set(vals.astype(pool.dtype), mode="drop")
+
+
+def paged_attention(q, k_pool, v_pool, tables, positions, *,
+                    block_size: int, n_rep: int, n_tiles=None,
+                    k_scale=None, v_scale=None):
+    """Block-table-gathered streaming attention for one layer.
+
+    ``q [S, T, H, D]`` attends to the K/V history of its slot, stored
+    as pool blocks ``[num_blocks, block_size, KVH, D]`` addressed
+    through ``tables [S, max_blocks]`` (entry < 0 = unmapped). Row
+    ``(s, t)`` may attend every column ``c <= positions[s, t]``.
+
+    The walk is an online-softmax loop over ``block_size`` tiles
+    (``jax.lax.fori_loop``, so ``n_tiles`` — typically
+    ``max(positions)//block_size + 1`` — may be a traced value and
+    short sequences pay only their own tiles): per tile it gathers one
+    block per slot, forms ``[S, ., T, block_size]`` scores, and folds
+    them into running (max, denominator, accumulator) carries. No
+    ``[S, max_seq]`` score or cache view ever exists — peak extra
+    memory is one tile, which is what lets a Pallas TPU kernel replace
+    this function behind the same signature.
+
+    GQA runs against the UNEXPANDED pools (grouped contraction, the
+    dense engine's trick): ``n_rep = H // KVH`` query heads share each
+    KV head. ``k_scale/v_scale [num_blocks, block_size, KVH]`` switch
+    the gather to int8-dequant mode (absmax codes in the pools).
+    """
+    S, T, H, D = q.shape
+    K = k_pool.shape[2]
+    R = int(n_rep)
+    assert K * R == H, (K, R, H)
+    if n_tiles is None:
+        n_tiles = tables.shape[1]
+    q5 = q.reshape(S, T, K, R, D)
+    inv_sqrt_d = 1.0 / np.sqrt(D)
+    cols0 = jnp.arange(block_size)
+    m0 = jnp.full((S, K, R, T), -1e30, jnp.float32)
+    l0 = jnp.zeros((S, K, R, T), jnp.float32)
+    a0 = jnp.zeros((S, K, R, T, D), jnp.float32)
+
+    def tile(i, carry):
+        m, l, acc = carry
+        phys = jnp.maximum(tables[:, i], 0)            # [S]
+        k_t = k_pool[phys]                             # [S, bs, K, D]
+        v_t = v_pool[phys]
+        if k_scale is not None:
+            k_t = (k_t.astype(jnp.float32)
+                   * k_scale[phys][..., None]).astype(q.dtype)
+            v_t = (v_t.astype(jnp.float32)
+                   * v_scale[phys][..., None]).astype(q.dtype)
+        # RECYCLED blocks may hold non-finite garbage from a previous
+        # request (a pathological prompt can drive activations to
+        # NaN/inf). Masked columns must contribute EXACTLY zero, but
+        # 0 * NaN = NaN in the PV contraction below — sanitize the
+        # gathered tile so one request's garbage can never leak into
+        # another request sharing the pool (the dense engine's
+        # stale rows are at worst slot-local; the pool's must be
+        # inert everywhere)
+        k_t = jnp.nan_to_num(k_t)
+        v_t = jnp.nan_to_num(v_t)
+        s = jnp.einsum("stkrd,sbkd->skrtb", q5, k_t,
+                       preferred_element_type=jnp.float32) * inv_sqrt_d
+        # [S, T, bs] -> broadcast over (K, R); also masks unmapped
+        # blocks (cols of tile i all exceed positions that never
+        # reached it) and clamped phys-0 garbage for inactive slots
+        ok = (i * block_size + cols0)[None, None, :] \
+            <= positions[:, :, None]
+        okb = ok[:, None, None, :, :]
+        s = jnp.where(okb, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # a fully-masked row has s == m_new == -1e30: exp() gives 1,
+        # so re-mask p to zero its contribution exactly
+        p = jnp.where(okb, jnp.exp(s - m_new[..., None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("skrtb,sbkd->skrtd", p.astype(v_t.dtype), v_t,
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * corr[..., None] + pv
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_tiles, tile, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 3, 1, 2, 4).reshape(S, T, H, D).astype(
+        q.dtype)

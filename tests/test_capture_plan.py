@@ -580,28 +580,46 @@ class TestLlamaPlanConsistency:
 
 class TestRepoStepFixtures:
     def test_serving_decode_impl_is_clean(self):
-        """The jitted decode body is the capture region: zero findings,
-        even unallowlisted."""
+        """The jitted decode/prefill bodies are the capture regions:
+        zero findings, even unallowlisted — for the dense engine AND
+        the paged one (block-table walk, streaming attention, pool
+        scatter all stay functional)."""
         import os
         from paddle_tpu.analysis.lint import REPO_ROOT
         path = os.path.join(REPO_ROOT, "paddle_tpu", "serving.py")
-        diags, _ = capture.scan_file_function(
-            path, "LlamaDecodeEngine._decode_impl",
-            ("params", "k_cache", "v_cache", "last_ids", "pos"))
-        assert diags == [], [d.to_dict() for d in diags]
+        for qual, params in [
+            ("LlamaDecodeEngine._decode_impl",
+             ("params", "k_cache", "v_cache", "last_ids", "pos")),
+            ("PagedLlamaDecodeEngine._decode_impl",
+             ("params", "kv", "last_ids", "pos", "tables", "act")),
+            ("PagedLlamaDecodeEngine._prefill_impl",
+             ("params", "kv", "ids", "table_row", "start", "nvalid",
+              "true_len")),
+        ]:
+            diags, _ = capture.scan_file_function(path, qual, params)
+            assert diags == [], (qual, [d.to_dict() for d in diags])
 
     def test_serving_decode_step_clean_plan_fixture(self):
-        """Checked-in expectation for the decode step/window loop: the
-        ONLY raw findings are the known slot-bookkeeping mutations
-        (PTC002) and the designed per-step/window token fetch (PTC003,
-        hoisted to the tail) — all allowlisted, so the effective plan
-        is clean. Feeds ROADMAP item 2."""
+        """Checked-in expectation for the decode step/window/prefill
+        loops (dense AND paged): the ONLY raw findings are the known
+        slot/block bookkeeping mutations (PTC002) and the designed
+        per-step/window/first-token fetch (PTC003, hoisted to the
+        tail) — all allowlisted, so the effective plan is clean.
+        Feeds ROADMAP item 2."""
         import os
         from paddle_tpu.analysis.lint import REPO_ROOT
         path = os.path.join(REPO_ROOT, "paddle_tpu", "serving.py")
         expected = {
             "LlamaDecodeEngine.step": {"PTC002": 2, "PTC003": 1},
             "LlamaDecodeEngine.decode_steps": {"PTC002": 1, "PTC003": 1},
+            "PagedLlamaDecodeEngine.step": {"PTC002": 2, "PTC003": 1},
+            "PagedLlamaDecodeEngine.decode_steps":
+                {"PTC002": 1, "PTC003": 1},
+            # prefill_chunk: program-cache insert, prompt staging into
+            # the padded host buffer, slot activation bookkeeping
+            # (pos/active/last_ids) + the final-chunk first-token fetch
+            "PagedLlamaDecodeEngine.prefill_chunk":
+                {"PTC002": 5, "PTC003": 1},
         }
         for qual, want in expected.items():
             diags, meta = capture.scan_file_function(path, qual, ())
