@@ -113,17 +113,27 @@ CAPTURE_ALLOWLIST = [
     # hoisted the fetch out of train_batch/eval_batch — they return a
     # lazy device loss and fit/evaluate fetch at the log boundary, so
     # the step functions now scan clean with no exception needed)
+    ("PTC002", "*`self._draft.*",
+     "speculative decoding's draft mirror: the draft engine's slot "
+     "state (last_ids/pos) is re-seeded from the TARGET's committed "
+     "stream at the capture boundary — the draft/verify executables "
+     "themselves are pure, only the accept/rollback bookkeeping "
+     "between them mutates host state"),
     ("PTC002", "paddle_tpu/serving.py*",
      "slot/block bookkeeping (pos/last_ids/active, block-table "
-     "extension, prefill staging) advances BETWEEN captured programs "
-     "by design: the jitted dense/paged _decode_impl and the paged "
-     "_prefill_impl chunks are the capture regions, the server loop "
-     "is the boundary that replays them"),
+     "extension, prefill staging, speculative accept/rollback — "
+     "committing the verified prefix and truncating rejected draft "
+     "block writes) advances BETWEEN captured programs by design: "
+     "the jitted dense/paged _decode_impl, the paged _prefill_impl "
+     "chunks and the spec propose/verify pair are the capture "
+     "regions, the server loop is the boundary that replays them"),
     ("PTC003", "paddle_tpu/serving.py*",
      "the per-step/per-window token fetch and the final-prefill-chunk "
      "first-token fetch ARE the decode contract: continuous batching "
      "must see each token on host to admit/retire requests; "
-     "decode_steps already batches it to one fetch per window"),
+     "decode_steps batches it to one fetch per window and a "
+     "speculative step fetches ONCE for up to spec_k committed "
+     "tokens (the verify outputs drive accept/rollback)"),
     ("PTC003", "bench.py*",
      "deliberate device barriers: a value transfer is the only "
      "trustworthy sync over the TPU tunnel — warmup fetches bound the "

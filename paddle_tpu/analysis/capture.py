@@ -684,6 +684,13 @@ REPO_STEPS: List[Tuple[str, str, Tuple[str, ...]]] = [
      ()),
     ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine.prefill_chunk",
      ()),
+    ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine._propose_impl",
+     ("params", "kv", "last_ids", "pos", "tables", "act")),
+    ("paddle_tpu/serving.py",
+     "PagedLlamaDecodeEngine._spec_verify_impl",
+     ("params", "kv", "last_ids", "draft_tok", "pos", "tables",
+      "act")),
+    ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine.spec_step", ()),
     ("bench.py", "bench_llama", ()),
 ]
 

@@ -179,6 +179,34 @@ define_flag("serving_prefill_chunk", 64,
             "chunk with each decode step so a long prompt never "
             "stalls the in-flight decode batch for more than one "
             "chunk's forward pass")
+define_flag("serving_spec_tokens", 4,
+            "Draft tokens a speculative decode step proposes per "
+            "target step (the speculation window). The target model "
+            "verifies the whole window in ONE batched paged-attention "
+            "call and commits the accepted prefix; greedy output is "
+            "bit-equal to the non-speculative stream regardless of "
+            "the window size — this only trades draft work against "
+            "acceptance length")
+define_flag("serving_spec_draft_layers", 0,
+            "Decoder layers in the auto-built truncated-layer draft "
+            "model (PagedLlamaDecodeEngine.make_draft): the draft "
+            "shares the target's embedding/head/first-N-layer weights "
+            "at zero extra weight HBM. 0 (default) = half the target's "
+            "layers (min 1)")
+define_flag("paged_attention_kernel", True,
+            "Use the Pallas block-table paged-attention TPU kernel "
+            "behind the serving_cache.paged_attention seam when the "
+            "backend supports it; 0 forces the pure-jnp tiled walk "
+            "(the CPU/tier-1 numerics oracle) everywhere. "
+            "decode/verify/prefill all route through the one seam")
+define_flag("serving_shed_queue", 0,
+            "Load-shedding queue bound for the paged GenerationServer: "
+            "when the KV block pool has no available blocks AND more "
+            "than this many admitted-order requests are already "
+            "deferred waiting for blocks, submit() rejects new work "
+            "immediately (rejected reason=shed) instead of deferring "
+            "unboundedly. 0 (default) disables shedding — exhaustion "
+            "queues forever, the pre-policy behavior")
 define_flag("use_bf16_matmul", True, "Prefer bfloat16 matmul accumulation defaults")
 define_flag("log_level", 0, "Framework verbosity")
 define_flag("benchmark", False, "Synchronize after each op for timing")

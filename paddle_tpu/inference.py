@@ -422,7 +422,9 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
           block: bool = True, max_batch: int = 32,
           batch_window_ms: float = 2.0, generate: bool = False,
           max_slots: int = 4, max_seq: int = 256, int8: bool = False,
-          eos_id=None):
+          eos_id=None, speculative: bool = False,
+          spec_tokens: Optional[int] = None,
+          spec_draft_layers: Optional[int] = None):
     """Minimal predictor server (ref: the reference ships its predictor
     behind paddle_serving / the C API server loop; this is the
     batteries-included analog). Concurrent requests are micro-batched
@@ -442,7 +444,12 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     stalls the batch one prefill chunk at a time, and KV HBM scales
     with active tokens (see serving.PagedLlamaDecodeEngine +
     GenerationServer); ``int8=True`` runs the projections as real s8
-    matmuls.
+    matmuls. ``speculative=True`` additionally attaches a
+    truncated-layer draft (``spec_draft_layers`` layers, weights
+    shared with the target) proposing ``spec_tokens``
+    (default ``FLAGS_serving_spec_tokens``) tokens per step — greedy
+    output stays bit-equal, decode steps commit up to the whole
+    accepted window per host round-trip.
     """
     import io
     import threading
@@ -458,9 +465,14 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
         # load_inference_model would hold the weights twice at startup)
         model = predictor.model if predictor.model is not None \
             else load_inference_model(model_path)
-        gen_server = GenerationServer(PagedLlamaDecodeEngine(
+        engine = PagedLlamaDecodeEngine(
             model, max_slots=max_slots, max_seq=max_seq, int8=int8,
-            eos_id=eos_id))
+            eos_id=eos_id)
+        if speculative:
+            engine.attach_draft(
+                engine.make_draft(model, num_layers=spec_draft_layers),
+                spec_tokens=spec_tokens)
+        gen_server = GenerationServer(engine)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet

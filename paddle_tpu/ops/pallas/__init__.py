@@ -6,3 +6,4 @@ layernorm. Each module exposes a jittable function with a custom_vjp and a
 pure-XLA fallback for non-TPU backends (used by the CPU test mesh).
 """
 from . import flash_attention  # noqa: F401
+from . import paged_attention  # noqa: F401

@@ -37,6 +37,20 @@ Two cache layouts ship:
 (dynamic per-tensor activation quant, per-channel weight scales — the
 same math as quantization.Int8Linear) with bf16 caches/activations.
 
+Every engine's attention routes through the ONE
+``serving_cache.paged_attention`` seam (the dense cache is viewed as
+an identity-mapped block pool), behind which
+``FLAGS_paged_attention_kernel`` selects the Pallas block-table TPU
+kernel or the pure-jnp tile walk (the CPU/tier-1 numerics oracle).
+The paged engine additionally supports **speculative decoding**
+(``attach_draft``): a cheap draft — typically ``make_draft``'s
+truncated-layer weight-sharing view — proposes
+``FLAGS_serving_spec_tokens`` tokens per step, the target verifies
+the whole window in one batched call, accepted prefixes commit and
+rejected suffixes roll their block writes back through the admission
+reservation (``PagedKVCache.truncate``); greedy output stays
+BIT-equal to the non-speculative stream.
+
 Decode is memory-bound (every step streams the full weight set), so the
 bench grades tokens/s against the weight-streaming roofline:
 slots / (weight_bytes / HBM_BW) — with the cache-traffic term sized
@@ -89,6 +103,34 @@ _M_queue_s = _M.histogram(
 _M_decode_s = _M.histogram(
     "decode_seconds",
     "Admission-to-completion wall time per request (prefill + decode)")
+# speculative decoding (per-step counted so acceptance rate is
+# readable off the registry: accepted/proposed)
+_M_spec_steps = _M.counter(
+    "spec_steps_total", "Speculative decode steps (draft propose + "
+    "one batched verify) run by engines")
+_M_spec_proposed = _M.counter(
+    "spec_proposed_total", "Draft tokens proposed to the target")
+_M_spec_accepted = _M.counter(
+    "spec_accepted_total",
+    "Draft tokens the target verified and committed")
+_M_spec_rolled = _M.counter(
+    "spec_rolled_back_total",
+    "KV blocks rolled back from rejected draft suffixes (re-credited "
+    "to the slot's admission reservation)")
+_M_shed = _M.counter(
+    "shed_total",
+    "Submissions rejected by the load-shedding policy (block pool "
+    "exhausted AND the deferred-waiting list over "
+    "FLAGS_serving_shed_queue)")
+# which implementation the paged_attention seam runs (decided once per
+# engine at program-build time; the compiled steps bake the path in)
+_M_pa_kernel = _M.counter(
+    "paged_attention_kernel_steps_total",
+    "Engine steps whose attention ran the Pallas block-table kernel")
+_M_pa_fallback = _M.counter(
+    "paged_attention_fallback_steps_total",
+    "Engine steps whose attention ran the pure-jnp tile walk (the "
+    "CPU/oracle fallback)")
 
 # process-unique request trace ids: every lifecycle event of a request
 # carries one, so a flight dump (or GenerationServer.trace) replays a
@@ -114,47 +156,70 @@ class LlamaDecodeEngine:
     """
 
     def __init__(self, model, max_slots: int = 4, max_seq: int = 256,
-                 int8: bool = False, eos_id: Optional[int] = None):
+                 int8: bool = False, eos_id: Optional[int] = None,
+                 num_layers: Optional[int] = None,
+                 share_params: Optional[Dict[str, object]] = None):
         cfg = model.config
         self.cfg = cfg
         self.max_slots = int(max_slots)
         self.max_seq = int(max_seq)
         self.eos_id = eos_id
         self.int8 = bool(int8)
+        # num_layers < cfg.num_hidden_layers builds the TRUNCATED-LAYER
+        # view (first N decoder layers + the full norm/head): the cheap
+        # draft model of speculative decoding shares every retained
+        # weight with its target at zero extra HBM (see make_draft)
+        self.n_layers = int(num_layers or cfg.num_hidden_layers)
+        if not 1 <= self.n_layers <= cfg.num_hidden_layers:
+            raise ValueError(
+                f"num_layers must be in [1, {cfg.num_hidden_layers}], "
+                f"got {num_layers}")
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
 
-        sd = {k: v._data for k, v in model.named_parameters()}
         dt = jnp.bfloat16 if str(cfg.dtype) == "bfloat16" else jnp.float32
         self.dtype = dt
 
-        def get(name):
-            return jnp.asarray(sd[name], dt)
+        if share_params is not None:
+            # truncated-layer VIEW of another engine's params (the
+            # make_draft path): re-bind the caller's device arrays —
+            # never re-upload/re-transpose/re-quantize a second weight
+            # set, which would transiently double weight HBM exactly
+            # where speculative decoding wants headroom least
+            p: Dict[str, object] = dict(share_params)
+            p["layers"] = list(share_params["layers"])[:self.n_layers]
+        else:
+            sd = {k: v._data for k, v in model.named_parameters()}
 
-        p: Dict[str, object] = {"emb": get("llama.embed_tokens.weight"),
-                                "norm": get("llama.norm.weight")}
-        # projections stored transposed ([out, in]) — see _mm
-        if cfg.tie_word_embeddings:
-            p["head"] = p["emb"]          # [V, H] is already the
-        else:                             # transposed head
-            p["head"] = get("lm_head.weight").T
-        layers = []
-        for i in range(cfg.num_hidden_layers):
-            pre = f"llama.layers.{i}."
-            lp = {"in_ln": get(pre + "input_layernorm.weight"),
-                  "post_ln": get(pre + "post_attention_layernorm.weight")}
-            for nm in ("q_proj", "k_proj", "v_proj", "o_proj"):
-                lp[nm] = get(pre + "self_attn." + nm + ".weight").T
-            for nm in ("gate_proj", "up_proj", "down_proj"):
-                lp[nm] = get(pre + "mlp." + nm + ".weight").T
+            def get(name):
+                return jnp.asarray(sd[name], dt)
+
+            p = {"emb": get("llama.embed_tokens.weight"),
+                 "norm": get("llama.norm.weight")}
+            # projections stored transposed ([out, in]) — see _mm
+            if cfg.tie_word_embeddings:
+                p["head"] = p["emb"]      # [V, H] is already the
+            else:                         # transposed head
+                p["head"] = get("lm_head.weight").T
+            layers = []
+            for i in range(self.n_layers):
+                pre = f"llama.layers.{i}."
+                lp = {"in_ln": get(pre + "input_layernorm.weight"),
+                      "post_ln": get(pre
+                                     + "post_attention_layernorm"
+                                       ".weight")}
+                for nm in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                    lp[nm] = get(pre + "self_attn." + nm + ".weight").T
+                for nm in ("gate_proj", "up_proj", "down_proj"):
+                    lp[nm] = get(pre + "mlp." + nm + ".weight").T
+                if int8:
+                    for nm in ("q_proj", "k_proj", "v_proj", "o_proj",
+                               "gate_proj", "up_proj", "down_proj"):
+                        lp[nm] = _quantize_w(lp[nm])
+                layers.append(lp)
+            p["layers"] = layers
             if int8:
-                for nm in ("q_proj", "k_proj", "v_proj", "o_proj",
-                           "gate_proj", "up_proj", "down_proj"):
-                    lp[nm] = _quantize_w(lp[nm])
-            layers.append(lp)
-        p["layers"] = layers
-        if int8:
-            p["head"] = _quantize_w(p["head"])
+                p["head"] = _quantize_w(p["head"])
         self.params = p
 
         S = self.max_slots
@@ -163,6 +228,19 @@ class LlamaDecodeEngine:
         self.active = np.zeros(S, bool)
         self.last_ids = np.zeros((S, 1), np.int32)
 
+        from . import serving_cache as _sc
+        self._sc = _sc
+        # every engine's attention rides the ONE paged_attention seam
+        # (the dense cache is viewed as an identity-mapped block pool);
+        # the implementation behind it — Pallas kernel vs jnp walk —
+        # is decided here ONCE so the per-step path counters report
+        # what the compiled programs actually baked in
+        self._pa_kernel = _sc.use_kernel_default()
+        self._attend_tile = next(
+            ts for ts in (128, 64, 32, 16, 8, 4, 2, 1)
+            if self.max_seq % ts == 0)
+        self._draft: Optional["PagedLlamaDecodeEngine"] = None
+        self._spec_k = 0
         from .jit.sot import capture_jit as _capture_jit
         self._capture_jit = _capture_jit
         self._init_cache()
@@ -171,7 +249,7 @@ class LlamaDecodeEngine:
         """Build the DENSE cache layout + its compiled step programs
         (PagedLlamaDecodeEngine overrides with the block pool)."""
         cfg = self.cfg
-        S, L = self.max_slots, cfg.num_hidden_layers
+        S, L = self.max_slots, self.n_layers
         kvh = cfg.num_key_value_heads
         # per-LAYER cache arrays (not one stacked [L, ...] array): the
         # stacked form costs a slice per layer + a stack per step that
@@ -242,37 +320,33 @@ class LlamaDecodeEngine:
             [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
             axis=-1).astype(x.dtype)
 
-    def _attend(self, q, k_all, v_all, col_mask):
-        """q [S,T,H,D] vs caches [S,max_seq,KVH,D]; col_mask
-        [S,T,max_seq] True where attendable. Dots run in the cache
-        dtype with f32 accumulation (preferred_element_type) so the
-        bf16 cache is never materialized as f32 — that conversion cost
-        a full extra cache pass per step."""
-        if self.n_rep > 1:
-            # grouped contraction against the UNEXPANDED caches: a
-            # jnp.repeat would stream n_rep x the cache bytes per step,
-            # defeating exactly the KV saving GQA exists for
-            S, T, H, D = q.shape
-            q5 = q.reshape(S, T, -1, self.n_rep, D)
-            scores = jnp.einsum("stkrd,smkd->skrtm", q5, k_all,
-                                preferred_element_type=jnp.float32)
-            scores = scores / np.sqrt(self.head_dim)
-            scores = jnp.where(col_mask[:, None, None, :, :], scores,
-                               -1e30)
-            w = jax.nn.softmax(scores, axis=-1)
-            out = jnp.einsum("skrtm,smkd->stkrd", w.astype(v_all.dtype),
-                             v_all, preferred_element_type=jnp.float32)
-            return out.reshape(S, T, H, D).astype(q.dtype)
-        scores = jnp.einsum("sthd,smhd->shtm", q, k_all,
-                            preferred_element_type=jnp.float32)
-        scores = scores / np.sqrt(self.head_dim)
-        scores = jnp.where(col_mask[:, None, :, :], scores, -1e30)
-        w = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("shtm,smhd->sthd", w.astype(v_all.dtype),
-                         v_all, preferred_element_type=jnp.float32)
-        return out.astype(q.dtype)
+    def _attend(self, q, k_all, v_all, positions):
+        """q [S,T,H,D] vs caches [S,max_seq,KVH,D]; row (s,t) may
+        attend every column c <= positions[s,t]. Routed through the
+        ONE ``serving_cache.paged_attention`` seam by viewing the
+        dense per-slot rows as an identity-mapped block pool (a free
+        leading-dim reshape), so no engine — dense or paged — ever
+        materializes a ``[*, max_seq]`` score row (the two historical
+        ``jax.nn.softmax(scores)`` sites lived here), GQA stays a
+        grouped contraction against the UNEXPANDED caches, and the
+        Pallas kernel accelerates the dense engine too. The walk still
+        streams every max_seq column (all tiles): the dense cache IS
+        capacity-sized — O(active tokens) streaming is precisely what
+        the paged engine's block tables buy."""
+        S, M = k_all.shape[0], k_all.shape[1]
+        ts = self._attend_tile
+        nb = M // ts
+        k_pool = k_all.reshape((S * nb, ts) + k_all.shape[2:])
+        v_pool = v_all.reshape((S * nb, ts) + v_all.shape[2:])
+        tables = jnp.arange(S * nb, dtype=jnp.int32).reshape(S, nb)
+        # use_kernel pinned to the __init__-time decision so the
+        # compiled programs bake exactly what _count_pa_path reports
+        # (a flag flip after construction changes neither)
+        return self._sc.paged_attention(
+            q, k_pool, v_pool, tables, positions, block_size=ts,
+            n_rep=self.n_rep, use_kernel=self._pa_kernel)
 
-    def _block(self, lp, h, kc_l, vc_l, positions, col_mask, write_cols):
+    def _block(self, lp, h, kc_l, vc_l, positions, write_cols):
         """One decoder layer over [S, T, H] with fixed-cache K/V
         writes at write_cols [S, T]."""
         S, T, H = h.shape
@@ -288,7 +362,7 @@ class LlamaDecodeEngine:
         sl = jnp.arange(S)[:, None].repeat(T, 1)      # [S, T] slot ids
         kc_l = kc_l.at[sl, write_cols].set(k)
         vc_l = vc_l.at[sl, write_cols].set(v)
-        att = self._attend(q, kc_l, vc_l, col_mask)
+        att = self._attend(q, kc_l, vc_l, positions)
         h = res + self._mm(att.reshape(S, T, H), lp["o_proj"])
         res = h
         x = self._rms(h, lp["post_ln"])
@@ -298,16 +372,14 @@ class LlamaDecodeEngine:
             lp["down_proj"])
         return res + ff, kc_l, vc_l
 
-    def _forward(self, params, k_cache, v_cache, ids, positions,
-                 col_mask):
+    def _forward(self, params, k_cache, v_cache, ids, positions):
         """Shared prefill/decode body: ids [S, T] -> logits [S, T, V];
         caches are per-layer lists (donated leaves, in-place)."""
         h = jnp.take(params["emb"], ids, axis=0).astype(self.dtype)
         new_k, new_v = [], []
         for li, lp in enumerate(params["layers"]):
             h, kc_l, vc_l = self._block(
-                lp, h, k_cache[li], v_cache[li], positions, col_mask,
-                positions)
+                lp, h, k_cache[li], v_cache[li], positions, positions)
             new_k.append(kc_l)
             new_v.append(vc_l)
         h = self._rms(h, params["norm"])
@@ -322,10 +394,8 @@ class LlamaDecodeEngine:
         """One token for every slot: ids [S,1], pos [S] = cache index
         to write (== tokens so far)."""
         positions = pos[:, None]                        # [S, 1]
-        cols = jnp.arange(self.max_seq)[None, None, :]  # [1,1,max_seq]
-        col_mask = cols <= positions[:, :, None]
         logits, k_cache, v_cache = self._forward(
-            params, k_cache, v_cache, last_ids, positions, col_mask)
+            params, k_cache, v_cache, last_ids, positions)
         nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
         return nxt, k_cache, v_cache
 
@@ -333,22 +403,18 @@ class LlamaDecodeEngine:
                       true_len):
         """Prompt forward for ONE slot: ids [1, B] (bucket-padded),
         writes cache rows [0, B), returns argmax at the last real
-        token. Runs the whole-cache forward with the other slots
-        masked off (their K/V rows are untouched: write_cols for
-        inactive slots point at their own rows but values are zero —
-        instead we narrow to the one slot by slicing)."""
+        token, narrowed to the one slot by slicing. Rows past
+        true_len are bucket padding: their outputs are never read and
+        their cache rows are overwritten by later decode writes
+        before any position mask can attend them, so the causal
+        positions mask alone is sufficient."""
         B = ids.shape[1]
         positions = jnp.arange(B)[None, :]              # [1, B]
-        cols = jnp.arange(self.max_seq)[None, None, :]
-        causal = cols <= positions[:, :, None]
-        valid = cols < jnp.minimum(true_len, B)
-        col_mask = jnp.logical_and(causal, valid)
         kc = [jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0)
               for c in k_cache]
         vc = [jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0)
               for c in v_cache]
-        logits, kc, vc = self._forward(params, kc, vc, ids, positions,
-                                       col_mask)
+        logits, kc, vc = self._forward(params, kc, vc, ids, positions)
         k_cache = [jax.lax.dynamic_update_slice_in_dim(c, u, slot, axis=0)
                    for c, u in zip(k_cache, kc)]
         v_cache = [jax.lax.dynamic_update_slice_in_dim(c, u, slot, axis=0)
@@ -362,6 +428,13 @@ class LlamaDecodeEngine:
         while b < n:
             b *= 2
         return min(b, self.max_seq)
+
+    def _count_pa_path(self, n: int = 1) -> None:
+        """Per-step accounting of which implementation the
+        paged_attention seam ran — Pallas kernel vs jnp walk, decided
+        once at program-build time (``_pa_kernel``), so the counters
+        report what the compiled steps actually baked in."""
+        (_M_pa_kernel if self._pa_kernel else _M_pa_fallback).inc(n)
 
     def prefill(self, slot: int, prompt_ids: np.ndarray) -> int:
         """Load a prompt into ``slot``; returns the first generated
@@ -392,6 +465,7 @@ class LlamaDecodeEngine:
         nxt, self.k_cache, self.v_cache = self._decode(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(self.last_ids), jnp.asarray(self.pos))
+        self._count_pa_path()
         nxt = np.asarray(nxt)
         for s in range(self.max_slots):
             if self.active[s]:
@@ -450,6 +524,7 @@ class LlamaDecodeEngine:
                 jnp.int32(i))
             ids = nxt[:, None]
             pos = pos + 1
+        self._count_pa_path(n)
         toks = np.asarray(buf)                      # the one fetch
         self.pos += n
         self.last_ids = toks[:, -1:].astype(np.int32).copy()
@@ -532,7 +607,9 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
                  kv_quant: Optional[str] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 num_layers: Optional[int] = None,
+                 share_params: Optional[Dict[str, object]] = None):
         from .core.flags import flag_value
         self.block_size = int(block_size or
                               flag_value("serving_block_size"))
@@ -548,7 +625,9 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         self.prefill_chunk_len = int(
             prefill_chunk or flag_value("serving_prefill_chunk"))
         super().__init__(model, max_slots=max_slots, max_seq=max_seq,
-                         int8=int8, eos_id=eos_id)
+                         int8=int8, eos_id=eos_id,
+                         num_layers=num_layers,
+                         share_params=share_params)
 
     def _init_cache(self) -> None:
         from . import serving_cache as _sc
@@ -561,8 +640,7 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         pool_dt = {"int8": jnp.int8,
                    "bfloat16": jnp.bfloat16}.get(self.kv_quant,
                                                  self.dtype)
-        NB, bs, L = self.num_blocks, self.block_size, \
-            cfg.num_hidden_layers
+        NB, bs, L = self.num_blocks, self.block_size, self.n_layers
         kv = {"k": [jnp.zeros((NB, bs, kvh, self.head_dim), pool_dt)
                     for _ in range(L)],
               "v": [jnp.zeros((NB, bs, kvh, self.head_dim), pool_dt)
@@ -632,7 +710,7 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
             q, kvl["k"], kvl["v"], tables, positions,
             block_size=self.block_size, n_rep=self.n_rep,
             n_tiles=n_tiles, k_scale=kvl.get("ksc"),
-            v_scale=kvl.get("vsc"))
+            v_scale=kvl.get("vsc"), use_kernel=self._pa_kernel)
         h = res + self._mm(att.reshape(S, T, H), lp["o_proj"])
         res = h
         x = self._rms(h, lp["post_ln"])
@@ -704,22 +782,150 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
                                            (jnp.int32(0), i))
         return nxt, kv, buf
 
+    def _propose_impl(self, params, kv, last_ids, pos, tables, act):
+        """DRAFT side of a speculative step: ``_spec_propose_k``
+        sequential greedy decode steps chained device-side inside ONE
+        captured executable (token feedback never touches the host),
+        writing the draft's own block pool at positions
+        [pos, pos + k). Returns (draft tokens [S, k], kv)."""
+        ids, p = last_ids, pos
+        toks = []
+        for _ in range(self._spec_propose_k):
+            nxt, kv = self._decode_impl(params, kv, ids, p, tables,
+                                        act)
+            toks.append(nxt)
+            ids = nxt[:, None]
+            p = p + 1
+        return jnp.stack(toks, axis=1), kv
+
+    def _spec_verify_impl(self, params, kv, last_ids, draft_tok, pos,
+                          tables, act):
+        """TARGET side: score the whole speculation window in ONE
+        batched paged-attention call — ids [S, k+1] = [last_id,
+        d1..dk] at positions [pos, pos+k] (the same multi-position
+        executable family chunked prefill runs), writing the target's
+        K/V for every window position. Greedy targets t [S, k+1]
+        (t[:, i] conditions on the prefix through d_i) and the
+        device-computed accepted-prefix length n_acc [S] =
+        |leading i with d_{i+1} == t_i| come back together; the host
+        commits min(n_acc + 1, k) tokens and rolls the rest back, so
+        the greedy stream is BIT-equal to non-speculative decode."""
+        k = draft_tok.shape[1]
+        ids = jnp.concatenate([last_ids, draft_tok], axis=1)
+        positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+        n_tiles = (jnp.max(pos) + k) // self.block_size + 1
+        wmask = jnp.broadcast_to(act[:, None], positions.shape)
+        logits, kv = self._forward_paged(params, kv, ids, positions,
+                                         tables, n_tiles, wmask)
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        match = (draft_tok == t[:, :k]).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        return t, n_acc, kv
+
     # -- host orchestration -------------------------------------------------
+    def make_draft(self, model,
+                   num_layers: Optional[int] = None
+                   ) -> "PagedLlamaDecodeEngine":
+        """Build the cheap draft engine for speculative decoding as a
+        TRUNCATED-LAYER view of this target: same geometry (slots,
+        max_seq, block pool sizing, quantization), first
+        ``num_layers`` decoder layers (default
+        ``FLAGS_serving_spec_draft_layers``, 0 = half the target's,
+        min 1) — and the retained weights are re-bound to the
+        TARGET'S device arrays, so the draft costs only its own KV
+        pool, never a second weight set."""
+        from .core.flags import flag_value
+        n = int(num_layers or flag_value("serving_spec_draft_layers")
+                or max(1, self.n_layers // 2))
+        if not 1 <= n <= self.n_layers:
+            raise ValueError(
+                f"draft num_layers must be in [1, {self.n_layers}] — "
+                f"the TARGET's depth, not the model's — got {n} (a "
+                f"draft at least as deep as its target makes "
+                f"speculation strictly slower than plain stepping)")
+        return PagedLlamaDecodeEngine(
+            model, max_slots=self.max_slots, max_seq=self.max_seq,
+            int8=self.int8, eos_id=self.eos_id,
+            block_size=self.block_size, num_blocks=self.num_blocks,
+            kv_quant=self.kv_quant,
+            prefill_chunk=self.prefill_chunk_len, num_layers=n,
+            share_params=self.params)
+
+    def attach_draft(self, draft: "PagedLlamaDecodeEngine",
+                     spec_tokens: Optional[int] = None
+                     ) -> "PagedLlamaDecodeEngine":
+        """Enable speculative decoding: ``draft`` (a make_draft view
+        or ANY second paged engine over the same geometry) proposes
+        ``spec_tokens`` (default ``FLAGS_serving_spec_tokens``) tokens
+        per step; this target verifies the window in one batched
+        call. Admission reserves ``spec_tokens`` extra budget per
+        request so window pre-extension can never out-draw the
+        reservation; rejected suffixes roll their blocks back
+        (``PagedKVCache.truncate``). Returns self (chainable)."""
+        from .core.flags import flag_value
+        k = int(spec_tokens or flag_value("serving_spec_tokens"))
+        if k < 1:
+            raise ValueError(f"spec_tokens must be >= 1, got {k}")
+        if (draft.max_slots != self.max_slots
+                or draft.max_seq != self.max_seq
+                or draft.block_size != self.block_size):
+            raise ValueError(
+                "draft engine geometry (max_slots/max_seq/block_size) "
+                "must match the target's — the two advance in "
+                "lockstep over mirrored slot state")
+        if self.active.any() or self._prefill_state \
+                or self._kv.used_blocks():
+            raise ValueError(
+                "attach_draft requires an IDLE engine: requests "
+                "admitted before attachment were reserved without the "
+                "spec_k margin and have no mirrored draft slot, so "
+                "the next step would exhaust mid-decode — exactly "
+                "what admission reservations exist to prevent. Drain "
+                "or release every slot first")
+        self._draft = draft
+        self._spec_k = k
+        draft._spec_propose_k = k
+        self._spec_propose = draft._capture_jit(
+            draft._propose_impl, donate_argnums=(1,),
+            name="serving.spec_draft")
+        self._spec_verify = self._capture_jit(
+            self._spec_verify_impl, donate_argnums=(1,),
+            name="serving.spec_verify")
+        return self
+
     def begin_request(self, slot: int, prompt_ids,
                       max_new_tokens: int) -> bool:
         """Admit a request into ``slot``: map blocks for the prompt
-        and reserve its worst-case generation budget. Returns False
-        when the pool cannot cover it right now (caller should keep
-        the request queued — exhaustion queues, never crashes);
-        raises ValueError for a request the pool could NEVER hold."""
+        and reserve its worst-case generation budget (+ the
+        speculation window when a draft is attached — verify writes
+        up to ``spec_k`` positions past the committed stream before
+        rollback). Returns False when the pool cannot cover it right
+        now (caller should keep the request queued — exhaustion
+        queues, never crashes); raises ValueError for a request the
+        pool could NEVER hold. With a draft attached, the draft's
+        pool admits the same request in lockstep."""
         prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         n = int(prompt_ids.shape[0])
         if not 0 < n <= self.max_seq - 1:
             raise ValueError(
                 f"prompt length {n} not in [1, {self.max_seq - 1}]")
-        total = min(n + max(int(max_new_tokens), 1), self.max_seq)
+        budget = max(int(max_new_tokens), 1) + self._spec_k
+        total = min(n + budget, self.max_seq)
         if not self._kv.admit(slot, n, total):
             return False
+        if self._draft is not None:
+            # both pools or neither: a draft that cannot cover the
+            # mirror (defer OR a custom draft pool that could never
+            # hold it) must not strand the target's blocks
+            try:
+                ok = self._draft.begin_request(slot, prompt_ids,
+                                               budget)
+            except Exception:
+                self._kv.release(slot)
+                raise
+            if not ok:
+                self._kv.release(slot)
+                return False
         self._prefill_state[slot] = {"ids": prompt_ids, "next": 0}
         self.pos[slot] = 0
         self.active[slot] = False
@@ -746,12 +952,26 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
             jnp.int32(start), jnp.int32(c), jnp.int32(n))
         st["next"] = start + c
         if st["next"] < n:
+            # draft prefill rides the same interleave budget: one
+            # draft chunk per target chunk (same chunk length — a
+            # make_draft view — finishes in lockstep; an arbitrary
+            # second engine catches up on the final chunk below)
+            if self._draft is not None \
+                    and slot in self._draft._prefill_state:
+                self._draft.prefill_chunk(slot)
             return None
         first = int(tok)
         del self._prefill_state[slot]
         self.pos[slot] = n
         self.active[slot] = True
         self.last_ids[slot, 0] = first
+        if self._draft is not None:
+            while slot in self._draft._prefill_state:
+                self._draft.prefill_chunk(slot)
+            # the draft's stream mirrors the TARGET's: its own
+            # prefill token is discarded, the target's first token
+            # seeds both engines' next step
+            self._draft.last_ids[slot, 0] = first
         return first
 
     def prefill(self, slot: int, prompt_ids,
@@ -786,19 +1006,122 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
     def step(self) -> np.ndarray:
         """One decode iteration for ALL active slots; returns next
         token per slot (garbage for inactive slots — callers consult
-        .active)."""
+        .active). With a draft attached, the draft runs a mirrored
+        (cheap, truncated-layer) step on the SAME inputs so its KV
+        cache stays complete — a plain-step iteration (capacity
+        fallback, direct use) must not punch holes in the draft's
+        history, or every later speculation window would propose from
+        garbage and acceptance would silently collapse."""
         self._extend_tables()
-        tables = jnp.asarray(self._kv.block_tables)
+        draft = self._draft
         act = jnp.asarray(self.active)
+        ids = jnp.asarray(self.last_ids)
+        pos = jnp.asarray(self.pos)
+        if draft is not None:
+            for s in range(self.max_slots):
+                if self.active[s]:
+                    draft._kv.ensure_token(s, int(self.pos[s]))
+            _, draft.kvs = draft._decode(
+                draft.params, draft.kvs, ids, pos,
+                jnp.asarray(draft._kv.block_tables), act)
         nxt, self.kvs = self._decode(
-            self.params, self.kvs, jnp.asarray(self.last_ids),
-            jnp.asarray(self.pos), tables, act)
+            self.params, self.kvs, ids, pos,
+            jnp.asarray(self._kv.block_tables), act)
+        self._count_pa_path()
         nxt = np.asarray(nxt)
         for s in range(self.max_slots):
             if self.active[s]:
                 self.pos[s] += 1
                 self.last_ids[s, 0] = nxt[s]
+                if draft is not None:
+                    draft.pos[s] = self.pos[s]
+                    draft.last_ids[s, 0] = nxt[s]
         return nxt
+
+    def spec_ready(self) -> bool:
+        """True when the next iteration can run speculatively: a
+        draft is attached, at least one slot is active, and every
+        active slot has room for the whole verify window (a slot
+        within ``spec_k`` tokens of capacity drops the batch to plain
+        single-token steps for that iteration — correctness never
+        depends on the window fitting)."""
+        if self._draft is None:
+            return False
+        act = [s for s in range(self.max_slots) if self.active[s]]
+        if not act:
+            return False
+        k = self._spec_k
+        return all(int(self.pos[s]) + k + 1 <= self.max_seq - 1
+                   for s in act)
+
+    def spec_step(self):
+        """One SPECULATIVE decode iteration for all active slots: the
+        draft proposes ``spec_k`` tokens (one captured executable,
+        device-chained), the target verifies the whole window in one
+        batched paged-attention call (a second captured executable),
+        and ONE host fetch closes the window — the same fetch budget
+        as a single plain step, for up to ``spec_k`` committed tokens.
+
+        Greedy acceptance: with d1..dk the draft's proposals and
+        t0..tk the target's greedy tokens per window position, the
+        committed prefix is t[:m], m = min(|leading d_{i+1}==t_i|+1,
+        k) — every committed token conditions on a committed prefix,
+        so the stream is BIT-equal to non-speculative decode. The
+        first rejection truncates ``pos`` and rolls the rejected
+        suffix's block writes back through
+        ``PagedKVCache.truncate`` (re-crediting the admission
+        reservation); a fully-accepted window commits k tokens and
+        leaves both engines exactly one pending write behind, the
+        plain-step invariant.
+
+        Returns ``(tokens [S, k+1], counts [S])``: row s's first
+        ``counts[s]`` tokens are the committed stream continuation
+        (garbage for inactive slots — callers consult ``.active``)."""
+        k = self._spec_k
+        draft = self._draft
+        for s in range(self.max_slots):
+            if self.active[s]:
+                # window pre-extension, drawn from the +spec_k
+                # admission margin: target writes [pos, pos+k],
+                # draft writes [pos, pos+k-1]
+                self._kv.reserve_through(s, int(self.pos[s]) + k)
+                draft._kv.reserve_through(s, int(self.pos[s]) + k - 1)
+        last = jnp.asarray(self.last_ids)
+        pos = jnp.asarray(self.pos)
+        act = jnp.asarray(self.active)
+        draft_tok, draft.kvs = self._spec_propose(
+            draft.params, draft.kvs, last, pos,
+            jnp.asarray(draft._kv.block_tables), act)
+        t, n_acc, self.kvs = self._spec_verify(
+            self.params, self.kvs, last, draft_tok, pos,
+            jnp.asarray(self._kv.block_tables), act)
+        self._count_pa_path()
+        toks = np.asarray(t)
+        acc = np.asarray(n_acc)
+        counts = np.minimum(acc + 1, k).astype(np.int32)
+        proposed = accepted = rolled = 0
+        for s in range(self.max_slots):
+            if not self.active[s]:
+                continue
+            m = int(counts[s])
+            self.pos[s] += m
+            self.last_ids[s, 0] = toks[s, m - 1]
+            draft.pos[s] = self.pos[s]
+            draft.last_ids[s, 0] = toks[s, m - 1]
+            rolled += self._kv.truncate(s, int(self.pos[s]))
+            rolled += draft._kv.truncate(s, int(self.pos[s]))
+            proposed += k
+            accepted += int(acc[s])
+        _M_spec_steps.inc()
+        if proposed:
+            _M_spec_proposed.inc(proposed)
+        if accepted:
+            _M_spec_accepted.inc(accepted)
+        if rolled:
+            _M_spec_rolled.inc(rolled)
+        _flight.record("serving", "spec_step", proposed=proposed,
+                       accepted=accepted, rolled_back=rolled)
+        return toks, counts
 
     def decode_steps(self, n: int) -> np.ndarray:
         """``n`` chained decode iterations with DEVICE-resident token
@@ -833,6 +1156,7 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
                 tables, act)
             ids = nxt[:, None]
             pos = pos + 1
+        self._count_pa_path(n)
         toks = np.asarray(buf)                      # the one fetch
         self.pos += n
         self.last_ids = toks[:, -1:].astype(np.int32).copy()
@@ -856,11 +1180,14 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
     def release(self, slot: int, evicted: bool = False) -> None:
         """Free the slot AND return its blocks + reservation to the
         pool; ``evicted=True`` (expiry/failure/cancellation) counts
-        them into ``serving.block_evictions_total``."""
+        them into ``serving.block_evictions_total``. An attached
+        draft releases its mirrored slot in the same call."""
         self.active[slot] = False
         self.pos[slot] = 0
         self._prefill_state.pop(slot, None)
         self._kv.release(slot, evicted=evicted)
+        if self._draft is not None:
+            self._draft.release(slot, evicted=evicted)
 
     def export_decode(self):
         """AOT-serialize the PAGED decode step via jax.export: the
@@ -917,7 +1244,8 @@ class GenerationServer:
         self._cancel_waiting = False  # set by shutdown(drain=False)
         self.steps_run = 0
         self.admitted = 0
-        self.rejected = 0           # submissions after shutdown
+        self.rejected = 0           # submissions after shutdown/shed
+        self.shed = 0               # rejections by load-shedding alone
         self.deadline_expired = 0   # requests failed by their deadline
         self._stopping = threading.Event()
         self._drained = threading.Event()
@@ -966,6 +1294,21 @@ class GenerationServer:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
                 f"(prefill always produces the first token)")
+        if self._shed():
+            self.shed += 1
+            self.rejected += 1
+            _M_shed.inc()
+            _M_rejected.inc()
+            _flight.record("serving", "rejected", trace_id=trace_id,
+                           reason="shed",
+                           waiting=len(self._waiting),
+                           blocks_available=self.engine._kv
+                           .available_blocks())
+            raise RuntimeError(
+                f"request shed: KV block pool exhausted and "
+                f"{len(self._waiting)} requests already deferred "
+                f"(over FLAGS_serving_shed_queue) — retry later or "
+                f"raise FLAGS_serving_num_blocks")
         if deadline is not None and deadline <= 0:
             _flight.record("serving", "rejected", trace_id=trace_id,
                            reason="invalid_deadline")
@@ -1000,6 +1343,25 @@ class GenerationServer:
         if req["error"] is not None:
             raise req["error"]
         return list(req["out"])
+
+    def _shed(self) -> bool:
+        """Load-shedding policy (ROADMAP 1c), evaluated at submit
+        time on the evidence the paged pool already exports: shed
+        when admission is block-starved (``serving.blocks_free`` at
+        zero AND a request is already deferred on blocks — the
+        signal that queue_seconds is about to climb) and the waiting
+        backlog (deferred + queued, the ``queue_depth`` gauge's own
+        sum — hold-the-line fairness keeps the deferred list itself
+        at one) exceeds ``FLAGS_serving_shed_queue``. 0 (default)
+        disables the policy — exhaustion defers unboundedly as
+        before."""
+        from .core.flags import flag_value
+        bound = int(flag_value("serving_shed_queue"))
+        if not self._paged or bound <= 0:
+            return False
+        return (self._waiting != []
+                and self._q.qsize() + len(self._waiting) > bound
+                and self.engine._kv.available_blocks() <= 0)
 
     def _expired(self, req) -> bool:
         return (req["expires"] is not None
@@ -1302,12 +1664,31 @@ class GenerationServer:
                 # recorder's threading.excepthook dump carries every
                 # in-flight request's lifecycle trail
                 _fi.fire("serving.decode")
-                nxt = self.engine.step()
+                eng = self.engine
+                if self._paged and eng.spec_ready():
+                    # speculative iteration: up to spec_k committed
+                    # tokens per slot for one step's host fetch; the
+                    # greedy stream is bit-equal to plain stepping,
+                    # so requests cut off mid-window (eos / budget)
+                    # see exactly the tokens they would have anyway
+                    toks, counts = eng.spec_step()
+                else:
+                    # plain stepping is the counts == 1 case of the
+                    # same commit loop
+                    toks = eng.step()[:, None]
+                    counts = np.ones(eng.max_slots, np.int32)
                 self.steps_run += 1
                 _M_steps.inc()
                 for slot in list(self._slots):
                     req = self._slots[slot]
-                    req["out"].append(int(nxt[slot]))
+                    for j in range(int(counts[slot])):
+                        tok = int(toks[slot, j])
+                        req["out"].append(tok)
+                        if len(req["out"]) >= req["max_new"]:
+                            break
+                        if eng.eos_id is not None \
+                                and tok == eng.eos_id:
+                            break
                     _flight.record("serving", "decode",
                                    trace_id=req.get("trace_id"),
                                    step=self.steps_run,
@@ -1394,7 +1775,7 @@ class GenerationServer:
                          if r is not self._STOP
                          and not r["done"].is_set())
         out = {"steps_run": self.steps_run, "admitted": self.admitted,
-               "rejected": self.rejected,
+               "rejected": self.rejected, "shed": self.shed,
                "deadline_expired": self.deadline_expired,
                "in_flight": len(self._slots), "queued": queued,
                "prefilling": len(self._prefilling),

@@ -46,7 +46,7 @@ from .observability import flight as _flight
 from .observability import metrics as _om
 
 __all__ = ["PagedKVCache", "paged_attention", "write_kv_tokens",
-           "absmax_quantize"]
+           "absmax_quantize", "use_kernel_default"]
 
 _M = _om.scope("serving")
 _G_blocks_free = _M.gauge(
@@ -214,6 +214,43 @@ class PagedKVCache:
             if self.block_tables[int(slot), bidx] < 0:
                 self.ensure_token(slot, bidx * self.block_size)
 
+    def truncate(self, slot: int, tokens: int) -> int:
+        """Roll back ``slot``'s mapping to its first ``tokens``
+        positions: blocks past the last kept position are returned to
+        the free list and RE-CREDITED to the slot's reservation — the
+        speculative-decode rollback seam (a rejected draft's tokens
+        are just extra block writes; un-mapping them restores the
+        admission-time budget so the next window's pre-extension can
+        draw the same blocks again). Returns the block count rolled
+        back."""
+        slot, tokens = int(slot), int(tokens)
+        keep = _ceil_div(tokens, self.block_size) if tokens > 0 else 0
+        rolled = 0
+        with self._lock:
+            owned = self._owned.get(slot)
+            if owned is None:
+                return 0
+            for bidx in range(keep, self.max_blocks_per_slot):
+                b = int(self.block_tables[slot, bidx])
+                if b < 0:
+                    continue
+                self.block_tables[slot, bidx] = -1
+                owned.remove(b)
+                self._free.append(b)
+                rolled += 1
+            if rolled:
+                # invariant preserved: free and reserved_total grow by
+                # the same amount, so free >= reserved_total still holds
+                self._reserved[slot] = self._reserved.get(slot, 0) \
+                    + rolled
+                self._reserved_total += rolled
+                self._sync_gauges()
+        if rolled:
+            _flight.record("serving", "block_rollback", slot=slot,
+                           blocks=rolled, kept_tokens=tokens,
+                           available=self.available_blocks())
+        return rolled
+
     def release(self, slot: int, evicted: bool = False) -> int:
         """Return all of ``slot``'s blocks and cancel its reservation.
         ``evicted=True`` marks a reclaim (deadline expiry, failure,
@@ -272,9 +309,28 @@ def write_kv_tokens(pool, phys, off, vals):
     return pool.at[phys, off].set(vals.astype(pool.dtype), mode="drop")
 
 
+# [T, H, D] f32 bytes above which the Pallas kernel's per-program
+# VMEM working set (acc scratch + q/out tiles, each T*H*D*4) risks the
+# ~16 MB/core budget — such calls fall back to the jnp walk
+_KERNEL_Q_VMEM_BUDGET = 4 * 1024 * 1024
+
+
+def use_kernel_default() -> bool:
+    """The seam's path decision: the Pallas block-table kernel when
+    ``FLAGS_paged_attention_kernel`` is on AND the backend supports it;
+    the pure-jnp tiled walk (the numerics oracle) otherwise. One
+    function so engines can count the live path per step without
+    re-deriving the policy."""
+    from .core.flags import flag_value
+    if not flag_value("paged_attention_kernel"):
+        return False
+    from .ops.pallas import paged_attention as _pk
+    return _pk.kernel_available()
+
+
 def paged_attention(q, k_pool, v_pool, tables, positions, *,
                     block_size: int, n_rep: int, n_tiles=None,
-                    k_scale=None, v_scale=None):
+                    k_scale=None, v_scale=None, use_kernel=None):
     """Block-table-gathered streaming attention for one layer.
 
     ``q [S, T, H, D]`` attends to the K/V history of its slot, stored
@@ -296,7 +352,31 @@ def paged_attention(q, k_pool, v_pool, tables, positions, *,
     dense engine's trick): ``n_rep = H // KVH`` query heads share each
     KV head. ``k_scale/v_scale [num_blocks, block_size, KVH]`` switch
     the gather to int8-dequant mode (absmax codes in the pools).
+
+    ``use_kernel`` selects the implementation behind this ONE seam:
+    None (default) follows ``FLAGS_paged_attention_kernel`` + backend
+    availability, True forces the Pallas TPU kernel
+    (``ops.pallas.paged_attention``), False forces the jnp walk below
+    — which stays the numerics ORACLE the kernel is parity-pinned
+    against (tests/test_serving_spec.py runs the kernel through the
+    Pallas interpreter on CPU and asserts same-numerics).
     """
+    if use_kernel is None:
+        use_kernel = use_kernel_default()
+    if use_kernel and q.shape[1] * q.shape[2] * q.shape[3] * 4 \
+            > _KERNEL_Q_VMEM_BUDGET:
+        # the kernel's f32 accumulator scratch (and its q/out tiles)
+        # scale with T*H*D: decode (T=1), spec verify (T=k+1) and
+        # chunked prefill all fit easily, but the DENSE engine's
+        # un-chunked whole-prompt prefill can exceed per-core VMEM —
+        # those calls take the jnp walk, same numerics
+        use_kernel = False
+    if use_kernel:
+        from .ops.pallas import paged_attention as _pk
+        return _pk.paged_attention_kernel(
+            q, k_pool, v_pool, tables, positions,
+            block_size=block_size, n_rep=n_rep, n_tiles=n_tiles,
+            k_scale=k_scale, v_scale=v_scale)
     S, T, H, D = q.shape
     K = k_pool.shape[2]
     R = int(n_rep)

@@ -595,6 +595,11 @@ class TestRepoStepFixtures:
             ("PagedLlamaDecodeEngine._prefill_impl",
              ("params", "kv", "ids", "table_row", "start", "nvalid",
               "true_len")),
+            ("PagedLlamaDecodeEngine._propose_impl",
+             ("params", "kv", "last_ids", "pos", "tables", "act")),
+            ("PagedLlamaDecodeEngine._spec_verify_impl",
+             ("params", "kv", "last_ids", "draft_tok", "pos",
+              "tables", "act")),
         ]:
             diags, _ = capture.scan_file_function(path, qual, params)
             assert diags == [], (qual, [d.to_dict() for d in diags])
@@ -617,9 +622,15 @@ class TestRepoStepFixtures:
                 {"PTC002": 1, "PTC003": 1},
             # prefill_chunk: program-cache insert, prompt staging into
             # the padded host buffer, slot activation bookkeeping
-            # (pos/active/last_ids) + the final-chunk first-token fetch
+            # (pos/active/last_ids), the draft-mirror last_ids seed +
+            # the final-chunk first-token fetch
             "PagedLlamaDecodeEngine.prefill_chunk":
-                {"PTC002": 5, "PTC003": 1},
+                {"PTC002": 6, "PTC003": 1},
+            # spec_step: commit bookkeeping (pos/last_ids) between the
+            # propose/verify executables + the ONE window fetch
+            # (tokens + accepted counts, both hoisted to the tail)
+            "PagedLlamaDecodeEngine.spec_step":
+                {"PTC002": 2, "PTC003": 2},
         }
         for qual, want in expected.items():
             diags, meta = capture.scan_file_function(path, qual, ())

@@ -1,0 +1,549 @@
+"""Speculative decoding on the paged KV pool + the Pallas block-table
+paged-attention kernel seam (ISSUE 12).
+
+Oracle strategy, in two layers:
+
+- TOKENS: the non-speculative paged engine (itself pinned against the
+  dense engine, transitively against LlamaForCausalLM.generate) is the
+  stream reference — greedy speculative decode must reproduce it
+  BIT-exactly, because every committed token conditions on a committed
+  prefix (the accept rule). A 1-of-2-layer random draft disagrees with
+  its target constantly, so these streams exercise rejection mid-window
+  and rollback on nearly every step.
+- NUMERICS: the pure-jnp tile walk in ``serving_cache.paged_attention``
+  is the kernel's oracle — the Pallas kernel runs through the
+  interpreter on CPU (skipped, not failed, where Pallas is missing) and
+  must agree on every geometry.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (GenerationServer, LlamaDecodeEngine,
+                                PagedLlamaDecodeEngine)
+from paddle_tpu.serving_cache import PagedKVCache
+
+CFG = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=2, use_flash_attention=False)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    return LlamaForCausalLM(LlamaConfig.tiny(**CFG))
+
+
+@pytest.fixture(scope="module")
+def paged_ref(model):
+    """Non-speculative paged reference engine + memoized greedy
+    streams (max_seq 256 so no reference stream truncates early)."""
+    eng = PagedLlamaDecodeEngine(model, max_slots=1, max_seq=256,
+                                 block_size=8, prefill_chunk=8)
+    cache = {}
+
+    def ref(prompt, n_new):
+        key = (tuple(int(t) for t in prompt), int(n_new))
+        if key not in cache:
+            cache[key] = eng.generate(list(key[0]), max_new_tokens=n_new)
+        return cache[key]
+
+    return ref
+
+
+@pytest.fixture(scope="module")
+def spec_eng(model):
+    """Shared speculative engine: 2 slots over a 64-token paged space,
+    8-token blocks/chunks, a truncated-layer draft (1 of 2 layers,
+    weight-shared) proposing 3 tokens per step."""
+    eng = PagedLlamaDecodeEngine(model, max_slots=2, max_seq=64,
+                                 block_size=8, prefill_chunk=8)
+    return eng.attach_draft(eng.make_draft(model, num_layers=1),
+                            spec_tokens=3)
+
+
+def _pool_invariants(kv):
+    st = kv.stats()
+    owned = sum(len(b) for b in kv._owned.values())
+    assert st["blocks_free"] + owned == kv.num_blocks
+    assert st["blocks_reserved"] == sum(kv._reserved.values())
+    assert st["blocks_free"] >= st["blocks_reserved"]
+    mapped = int((kv.block_tables >= 0).sum())
+    assert mapped == owned
+    phys = kv.block_tables[kv.block_tables >= 0]
+    assert len(set(phys.tolist())) == len(phys)
+
+
+class TestSpecBitEquality:
+    def test_server_stream_bit_equal_across_bucketed_prompts(
+            self, model, paged_ref, spec_eng):
+        """Greedy spec-decode streams through the GenerationServer
+        match the non-speculative paged streams token-for-token for
+        prompts spanning the prefill buckets; both pools drain clean
+        afterwards (accept/rollback leaks nothing)."""
+        srv = GenerationServer(spec_eng)
+        try:
+            for prompt in ([5, 9, 11, 3], [2],
+                           [1, 2, 3, 4, 5, 6, 7, 8],
+                           list(range(1, 14)), list(range(3, 33))):
+                want = paged_ref(prompt, 12)
+                got = srv.generate(prompt, 12, timeout=180)
+                assert got == want, (len(prompt), got, want)
+        finally:
+            assert srv.shutdown(drain=True, timeout=120)
+        _pool_invariants(spec_eng._kv)
+        _pool_invariants(spec_eng._draft._kv)
+        assert spec_eng._kv.stats()["blocks_used"] == 0
+        assert spec_eng._draft._kv.stats()["blocks_used"] == 0
+
+    def test_spec_step_rejection_rolls_back_with_invariants(
+            self, model, paged_ref, spec_eng):
+        """Driving spec_step directly: the committed stream continues
+        the reference exactly while the allocator invariants (no
+        double-ownership, reservation balance, no aliasing) hold
+        after EVERY window — including the constant mid-window
+        rejections a random 1-layer draft produces."""
+        from paddle_tpu.observability import metrics as om
+
+        prompt = [5, 9, 11, 3]
+        want = paged_ref(prompt, 16)
+        out = [spec_eng.prefill(0, prompt, budget=20)]
+        before = dict(om.snapshot().get("serving", {}))
+        rejected_windows = 0
+        while len(out) < 16:
+            toks, counts = spec_eng.spec_step()
+            m = int(counts[0])
+            if m < spec_eng._spec_k:
+                rejected_windows += 1
+            out.extend(int(t) for t in toks[0, :m])
+            _pool_invariants(spec_eng._kv)
+            _pool_invariants(spec_eng._draft._kv)
+        spec_eng.release(0)
+        assert out[:16] == want, (out, want)
+        after = dict(om.snapshot().get("serving", {}))
+        steps = after.get("spec_steps_total", 0) - \
+            before.get("spec_steps_total", 0)
+        assert steps >= 1
+        # per-step counters moved: proposed = k * steps, and the
+        # rejections above rolled real blocks back
+        assert after.get("spec_proposed_total", 0) - \
+            before.get("spec_proposed_total", 0) == \
+            spec_eng._spec_k * steps
+        if rejected_windows:
+            assert after.get("spec_rolled_back_total", 0) >= \
+                before.get("spec_rolled_back_total", 0)
+        _pool_invariants(spec_eng._kv)
+        assert spec_eng._kv.stats()["blocks_used"] == 0
+
+    def test_capacity_fallback_mixes_plain_and_spec_steps(
+            self, model, paged_ref):
+        """When an active slot is within spec_k of capacity the server
+        drops to plain single-token steps for that iteration (the
+        draft cache develops holes — its proposals degrade, but the
+        target's verify stays authoritative), then resumes
+        speculating: the stream stays bit-correct through the mix."""
+        eng = PagedLlamaDecodeEngine(model, max_slots=2, max_seq=40,
+                                     block_size=8, prefill_chunk=8)
+        eng.attach_draft(eng.make_draft(model, num_layers=1),
+                         spec_tokens=4)
+        srv = GenerationServer(eng)
+        try:
+            prompt = [5, 9, 11, 3]
+            want = paged_ref(prompt, 30)
+            got = srv.generate(prompt, 30, timeout=180)
+            # capacity (max_seq 40) may cut the stream short; every
+            # delivered token must continue the reference exactly
+            assert len(got) >= 25
+            assert got == want[:len(got)], (got, want)
+        finally:
+            assert srv.shutdown(drain=True, timeout=120)
+        assert eng._kv.stats()["blocks_used"] == 0
+        assert eng._draft._kv.stats()["blocks_used"] == 0
+
+    def test_draft_shares_target_weights(self, model, spec_eng):
+        """make_draft is a truncated-layer VIEW: every retained weight
+        is the target's own device array, never a copy."""
+        draft = spec_eng._draft
+        assert draft.n_layers == 1
+        assert draft.params["emb"] is spec_eng.params["emb"]
+        assert draft.params["head"] is spec_eng.params["head"]
+        assert draft.params["layers"][0]["q_proj"] is \
+            spec_eng.params["layers"][0]["q_proj"]
+
+    def test_attach_draft_requires_idle_engine(self, model):
+        """A request admitted BEFORE attachment has no spec_k margin
+        and no mirrored draft slot — attaching then would exhaust
+        mid-decode, so attach_draft refuses until the engine drains."""
+        eng = PagedLlamaDecodeEngine(model, max_slots=1, max_seq=64,
+                                     block_size=8)
+        eng.prefill(0, [1, 2, 3], budget=8)
+        with pytest.raises(ValueError, match="IDLE"):
+            eng.attach_draft(eng.make_draft(model, num_layers=1),
+                             spec_tokens=2)
+        eng.release(0)
+        eng.attach_draft(eng.make_draft(model, num_layers=1),
+                         spec_tokens=2)
+        assert eng.generate([1, 2, 3], max_new_tokens=4)  # now fine
+
+    def test_admission_reserves_spec_margin(self, model):
+        """With a draft attached, admission reserves spec_k extra
+        tokens of budget so window pre-extension can never out-draw
+        the reservation."""
+        eng = PagedLlamaDecodeEngine(model, max_slots=1, max_seq=64,
+                                     block_size=8, num_blocks=8)
+        eng.attach_draft(eng.make_draft(model, num_layers=1),
+                         spec_tokens=3)
+        assert eng.begin_request(0, [1, 2, 3], 8)
+        # 3 prompt tokens -> 1 block now; 3+8+3=14 tokens -> 2 blocks
+        # total reserved beyond the mapped one
+        assert eng._kv.stats()["blocks_reserved"] == 1
+        assert eng._draft._kv.stats()["blocks_reserved"] == 1
+        eng.release(0)
+
+
+class TestTruncateRollback:
+    def test_truncate_recredits_reservation(self):
+        kv = PagedKVCache(max_slots=2, max_seq=64, block_size=8,
+                          num_blocks=8)
+        assert kv.admit(0, 4, 40)          # 1 mapped + 4 reserved
+        kv.ensure_token(0, 8)
+        kv.ensure_token(0, 16)             # 2 drawn from reservation
+        assert kv.stats()["blocks_used"] == 3
+        assert kv.stats()["blocks_reserved"] == 2
+        rolled = kv.truncate(0, 9)         # keep positions [0, 9)
+        assert rolled == 1
+        st = kv.stats()
+        assert st["blocks_used"] == 2
+        assert st["blocks_reserved"] == 3  # re-credited
+        assert st["blocks_free"] >= st["blocks_reserved"]
+        kv.ensure_token(0, 16)             # re-draw after rollback
+        assert kv.stats()["blocks_used"] == 3
+        kv.release(0)
+        st = kv.stats()
+        assert st["blocks_used"] == 0 and st["blocks_reserved"] == 0
+        assert (kv.block_tables == -1).all()
+
+    def test_truncate_noops(self):
+        kv = PagedKVCache(max_slots=2, max_seq=64, block_size=8,
+                          num_blocks=8)
+        assert kv.truncate(0, 8) == 0      # nothing admitted
+        kv.admit(1, 8, 8)
+        assert kv.truncate(1, 8) == 0      # nothing past the kept end
+        kv.release(1)
+
+
+class TestPagedAttentionKernelSeam:
+    """Kernel-vs-oracle parity at the flat seam, via the Pallas
+    interpreter on CPU (skipped where Pallas is unavailable)."""
+
+    def _geometries(self):
+        # (S, T, H, KVH, D, block_size, max_blocks)
+        return [
+            (2, 1, 4, 2, 8, 8, 4),     # decode step, GQA
+            (3, 5, 4, 2, 8, 8, 4),     # verify window, GQA
+            (2, 4, 4, 4, 16, 4, 6),    # MHA (n_rep=1), small blocks
+            (1, 8, 2, 1, 8, 16, 2),    # single slot, deep tiles
+        ]
+
+    def _case(self, S, T, H, K, D, bs, MB, quant, seed):
+        import jax.numpy as jnp
+        from paddle_tpu.serving_cache import (absmax_quantize,
+                                              paged_attention)
+        rng = np.random.default_rng(seed)
+        NB = S * MB + 2
+        q = jnp.asarray(rng.standard_normal((S, T, H, D)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((NB, bs, K, D)),
+                         jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((NB, bs, K, D)),
+                         jnp.float32)
+        tables = rng.permutation(NB)[:S * MB].reshape(S, MB)
+        tables = jnp.asarray(tables.astype(np.int32))
+        tables = tables.at[0, MB - 1].set(-1)   # unmapped tail
+        pos = jnp.asarray(
+            rng.integers(0, bs * MB - T, (S, 1)).astype(np.int32)
+            + np.arange(T, dtype=np.int32)[None, :])
+        kw = dict(block_size=bs, n_rep=H // K)
+        if quant:
+            kq, ks = absmax_quantize(kp.reshape(NB * bs, K, D))
+            vq, vs = absmax_quantize(vp.reshape(NB * bs, K, D))
+            kw.update(k_scale=ks.reshape(NB, bs, K),
+                      v_scale=vs.reshape(NB, bs, K))
+            kp = kq.reshape(NB, bs, K, D)
+            vp = vq.reshape(NB, bs, K, D)
+        return q, kp, vp, tables, pos, kw
+
+    def test_kernel_matches_jnp_walk_on_every_geometry(self):
+        from paddle_tpu.ops.pallas import paged_attention as pk
+        if not pk._HAS_PALLAS:
+            pytest.skip("Pallas unavailable — jnp walk is the only "
+                        "path (skipped, not failed)")
+        import jax.numpy as jnp
+        from paddle_tpu.serving_cache import paged_attention
+        for i, geo in enumerate(self._geometries()):
+            for quant in (False, True):
+                q, kp, vp, tables, pos, kw = self._case(
+                    *geo, quant=quant, seed=i)
+                ref = paged_attention(q, kp, vp, tables, pos,
+                                      use_kernel=False, **kw)
+                got = pk.paged_attention_kernel(
+                    q, kp, vp, tables, pos, interpret=True, **kw)
+                np.testing.assert_allclose(
+                    np.asarray(ref), np.asarray(got), rtol=1e-6,
+                    atol=1e-6, err_msg=f"geometry {geo} quant={quant}")
+
+    def test_kernel_sanitizes_recycled_garbage(self):
+        """The MASKED-garbage contract, kernel side: an unmapped
+        table entry (-1) clamps its gather to physical block 0 — fill
+        block 0 with NaN/inf and keep every position below the
+        unmapped tile, and the clamped garbage must contribute
+        exactly zero (finite output, bit-matching the jnp walk's
+        sanitized result)."""
+        from paddle_tpu.ops.pallas import paged_attention as pk
+        if not pk._HAS_PALLAS:
+            pytest.skip("Pallas unavailable")
+        import jax.numpy as jnp
+        from paddle_tpu.serving_cache import paged_attention
+        rng = np.random.default_rng(9)
+        S, T, H, K, D, bs, MB = 2, 1, 4, 2, 8, 8, 4
+        NB = S * MB + 2
+        q = jnp.asarray(rng.standard_normal((S, T, H, D)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((NB, bs, K, D)),
+                         jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((NB, bs, K, D)),
+                         jnp.float32)
+        # block 0 is nobody's block: tables draw from [1, NB), the
+        # last logical tile of each slot is unmapped (-1 -> clamps to
+        # the poisoned block 0), and positions stop before that tile
+        tables = 1 + rng.permutation(NB - 1)[:S * MB].reshape(S, MB)
+        tables = jnp.asarray(tables.astype(np.int32))
+        tables = tables.at[:, MB - 1].set(-1)
+        pos = jnp.asarray(
+            rng.integers(0, bs * (MB - 1) - T, (S, 1)).astype(np.int32)
+            + np.arange(T, dtype=np.int32)[None, :])
+        kp = kp.at[0].set(jnp.nan)
+        vp = vp.at[0].set(jnp.inf)
+        kw = dict(block_size=bs, n_rep=H // K)
+        ref = paged_attention(q, kp, vp, tables, pos,
+                              use_kernel=False, **kw)
+        got = pk.paged_attention_kernel(q, kp, vp, tables, pos,
+                                        interpret=True, **kw)
+        assert bool(jnp.isfinite(got).all())
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_flag_kills_kernel_path(self):
+        """FLAGS_paged_attention_kernel=0 forces the jnp walk
+        everywhere regardless of backend."""
+        from paddle_tpu.serving_cache import use_kernel_default
+        paddle.set_flags({"FLAGS_paged_attention_kernel": 0})
+        try:
+            assert use_kernel_default() is False
+        finally:
+            paddle.set_flags({"FLAGS_paged_attention_kernel": 1})
+
+
+class TestJaxprPins:
+    def _walk_shapes(self, jaxpr):
+        import jax
+        shapes = []
+
+        def walk(jx):
+            for eqn in jx.eqns:
+                for v in eqn.outvars:
+                    shapes.append(
+                        (eqn.primitive.name,
+                         tuple(getattr(v.aval, "shape", ()))))
+                for p in eqn.params.values():
+                    for sub in (p if isinstance(p, (list, tuple))
+                                else [p]):
+                        if isinstance(sub, jax.core.Jaxpr):
+                            walk(sub)
+                        elif isinstance(sub, jax.core.ClosedJaxpr):
+                            walk(sub.jaxpr)
+
+        walk(jaxpr.jaxpr)
+        return shapes
+
+    def test_dense_decode_no_trailing_max_seq_intermediate(self,
+                                                           model):
+        """Satellite pin: routing the dense engine's attention through
+        the paged_attention seam removed the [*, max_seq]-trailing
+        score rows (and the col_mask) from the dense decode step —
+        the cache arrays themselves keep max_seq at axis 1, which is
+        the dense layout's contract, so the pin is on the TRAILING
+        axis where score rows and masks lived."""
+        import jax
+        import jax.numpy as jnp
+
+        max_seq = 48
+        eng = LlamaDecodeEngine(model, max_slots=3, max_seq=max_seq)
+        args = (eng.params, eng.k_cache, eng.v_cache,
+                jnp.asarray(eng.last_ids), jnp.asarray(eng.pos))
+        jaxpr = jax.make_jaxpr(eng._decode_impl)(*args)
+        offenders = [(p, s) for p, s in self._walk_shapes(jaxpr)
+                     if s and s[-1] == max_seq]
+        assert offenders == [], offenders
+
+    def test_spec_verify_no_dense_view(self, model, spec_eng):
+        """The batched verify step obeys the same pin as the decode
+        step: no [*, max_seq]-shaped intermediate anywhere (max_seq
+        64 collides with CFG's vocab_size — use a 48-token engine)."""
+        import jax
+        import jax.numpy as jnp
+
+        max_seq = 48
+        eng = PagedLlamaDecodeEngine(model, max_slots=2,
+                                     max_seq=max_seq, block_size=16)
+        eng.attach_draft(eng.make_draft(model, num_layers=1),
+                         spec_tokens=3)
+        k = eng._spec_k
+        args = (eng.params, eng.kvs, jnp.asarray(eng.last_ids),
+                jnp.zeros((2, k), jnp.int32), jnp.asarray(eng.pos),
+                jnp.asarray(eng._kv.block_tables),
+                jnp.asarray(eng.active))
+        jaxpr = jax.make_jaxpr(eng._spec_verify_impl)(*args)
+        offenders = [(p, s) for p, s in self._walk_shapes(jaxpr)
+                     if max_seq in s]
+        assert offenders == [], offenders
+
+    def test_kernel_path_jaxpr_no_dense_view(self, model,
+                                             monkeypatch):
+        """The acceptance pin holds on the KERNEL path too: with the
+        seam forced to the Pallas kernel, the paged decode step's
+        jaxpr (pallas_call inner jaxpr included) still carries no
+        [*, max_seq]-shaped intermediate."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu import serving_cache
+        from paddle_tpu.ops.pallas import paged_attention as pk
+        if not pk._HAS_PALLAS:
+            pytest.skip("Pallas unavailable")
+        monkeypatch.setattr(serving_cache, "use_kernel_default",
+                            lambda: True)
+        max_seq = 48
+        eng = PagedLlamaDecodeEngine(model, max_slots=3,
+                                     max_seq=max_seq, block_size=16)
+        args = (eng.params, eng.kvs, jnp.asarray(eng.last_ids),
+                jnp.asarray(eng.pos),
+                jnp.asarray(eng._kv.block_tables),
+                jnp.asarray(eng.active))
+        jaxpr = jax.make_jaxpr(eng._decode_impl)(*args)
+        offenders = [(p, s) for p, s in self._walk_shapes(jaxpr)
+                     if max_seq in s]
+        assert offenders == [], offenders
+
+
+class TestSpecCapture:
+    def test_spec_step_audits_zero_syncs(self, model):
+        """Steady-state speculative step: the draft-propose and
+        batched-verify executables run 0 host syncs and both count
+        into sot.captured_steps_total — the PR 10/11 pin extended
+        over the spec pair (the window fetch + accept/rollback
+        bookkeeping live OUTSIDE the audited region by design: they
+        are the capture boundary, allowlisted as such)."""
+        import jax.numpy as jnp
+        from paddle_tpu import analysis
+        from paddle_tpu.observability import metrics as om
+
+        eng = PagedLlamaDecodeEngine(model, max_slots=2, max_seq=64,
+                                     block_size=8)
+        eng.attach_draft(eng.make_draft(model, num_layers=1),
+                         spec_tokens=2)
+        eng.prefill(0, [1, 2, 3], budget=30)
+        eng.prefill(1, [4, 5], budget=30)
+        for _ in range(2):                 # warm + steady state
+            eng.spec_step()
+        draft, k = eng._draft, eng._spec_k
+
+        def one_spec_step():
+            for s in range(eng.max_slots):
+                if eng.active[s]:
+                    eng._kv.reserve_through(s, int(eng.pos[s]) + k)
+                    draft._kv.reserve_through(
+                        s, int(eng.pos[s]) + k - 1)
+            last = jnp.asarray(eng.last_ids)
+            pos = jnp.asarray(eng.pos)
+            act = jnp.asarray(eng.active)
+            dtok, draft.kvs = eng._spec_propose(
+                draft.params, draft.kvs, last, pos,
+                jnp.asarray(draft._kv.block_tables), act)
+            t, n_acc, eng.kvs = eng._spec_verify(
+                eng.params, eng.kvs, last, dtok, pos,
+                jnp.asarray(eng._kv.block_tables), act)
+            return t, n_acc
+
+        before = dict(om.snapshot().get("sot", {}))
+        rep = analysis.audit(one_spec_step)
+        after = dict(om.snapshot().get("sot", {}))
+        assert rep.syncs == [], rep.syncs
+        assert not [d for d in rep.diagnostics
+                    if d.rule in ("PTA001", "PTA002", "PTA003")], \
+            [d.to_dict() for d in rep.diagnostics]
+        got = after.get("captured_steps_total", 0) - \
+            before.get("captured_steps_total", 0)
+        assert got >= 2, (before, after)   # propose AND verify
+
+
+class TestLoadShedding:
+    def test_shed_rejects_when_starved_and_backlogged(self, model):
+        """ROADMAP 1c policy: pool exhausted + deferred backlog over
+        FLAGS_serving_shed_queue -> submit() rejects immediately with
+        reason=shed (counted + flight event) instead of deferring
+        unboundedly; in-flight work is untouched and the default
+        (flag 0) keeps the pre-policy defer-forever behavior."""
+        from paddle_tpu.observability import flight
+        from paddle_tpu.observability import metrics as om
+
+        eng = PagedLlamaDecodeEngine(model, max_slots=4, max_seq=64,
+                                     block_size=8, num_blocks=4,
+                                     prefill_chunk=8)
+        orig_step = eng.step
+
+        def slow_step():
+            time.sleep(0.02)
+            return orig_step()
+
+        eng.step = slow_step
+        srv = GenerationServer(eng)
+        try:
+            # 12 prompt + 20 budget = 32 tokens = the whole 4-block
+            # pool (any larger could NEVER fit and fails loudly)
+            blocker = srv.submit([1, 2, 3] * 4, 20)
+            deferred = [srv.submit([1, 2, 3] * 4, 8)
+                        for _ in range(3)]
+            for _ in range(300):               # wait for the backlog
+                st = srv.stats()
+                if st["waiting_for_blocks"] >= 1 \
+                        and st["waiting_for_blocks"] + st["queued"] >= 2:
+                    break
+                time.sleep(0.02)
+            st = srv.stats()
+            assert st["waiting_for_blocks"] >= 1, st
+            assert st["waiting_for_blocks"] + st["queued"] >= 2, st
+            paddle.set_flags({"FLAGS_serving_shed_queue": 1})
+            before = dict(om.snapshot().get("serving", {}))
+            with pytest.raises(RuntimeError, match="shed"):
+                srv.submit([7, 8, 9], 4)
+            after = dict(om.snapshot().get("serving", {}))
+            assert srv.stats()["shed"] == 1
+            assert after.get("shed_total", 0) == \
+                before.get("shed_total", 0) + 1
+            sheds = [e for e in flight.events(category="serving")
+                     if e["name"] == "rejected"
+                     and e.get("attrs", {}).get("reason") == "shed"]
+            assert sheds, "no rejected(reason=shed) flight event"
+            # with the policy off, the same submit defers instead
+            paddle.set_flags({"FLAGS_serving_shed_queue": 0})
+            ok = srv.submit([7, 8, 9], 4)
+            assert blocker["done"].wait(180) and \
+                blocker["error"] is None
+            for r in deferred + [ok]:
+                assert r["done"].wait(180)
+                assert r["error"] is None, r["error"]
+        finally:
+            paddle.set_flags({"FLAGS_serving_shed_queue": 0})
+            srv.shutdown(drain=True, timeout=120)
+        assert eng._kv.stats()["blocks_used"] == 0
