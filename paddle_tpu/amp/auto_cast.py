@@ -52,6 +52,18 @@ def amp_state():
     return _state
 
 
+def amp_signature() -> tuple:
+    """Hashable autocast-regime tuple: the non-tensor thread-local state
+    that steers traces (apply_op casts differently under it). ONE
+    definition shared by SOTFunction's path signature and
+    CapturedStep's program signature, so a program traced under one
+    regime can never serve a call made under another."""
+    return (bool(_state.enabled), str(getattr(_state, "dtype", None)),
+            getattr(_state, "level", None),
+            tuple(sorted(_state.custom_white or ())),
+            tuple(sorted(_state.custom_black or ())))
+
+
 class auto_cast:
     """Context manager. level O1 = per-op white list; O2 = everything except
     the black list runs in low precision."""

@@ -16,6 +16,15 @@ When FLAGS_fused_optimizer is on, ``step()`` routes through
 every param update AND the conditional skip run as ONE buffer-donated
 executable. Host transfers happen only at explicit host boundaries
 (``state_dict()``, a user reading ``get_loss_scaling()``).
+
+Whole-step capture (jit/sot.py ``CapturedStep``) folds the ENTIRE
+iteration — loss scale, backward, unscale + finite check, masked
+update AND the dynamic-scale bookkeeping (:func:`_scale_update`) —
+into one captured fwd+bwd+optimizer executable: the scale and the
+good/bad counters ride as donated 0-d device carries
+(:meth:`GradScaler.capture_carry` / :meth:`absorb_captured`), and
+:meth:`capture_statics` gates which scaler/optimizer configurations
+the captured program can reproduce bit-for-bit.
 """
 from __future__ import annotations
 
@@ -175,6 +184,58 @@ class GradScaler:
             jnp.float32(self._incr_ratio), jnp.float32(self._decr_ratio),
             jnp.int32(self._incr_every), jnp.int32(self._decr_every))
 
+    # -- whole-step capture (jit/sot.py CapturedStep) ---------------------
+    def capture_statics(self, optimizer):
+        """Hashable static scaler config for whole-step capture, or
+        ``None`` when this scaler/optimizer pairing must run the eager
+        path: an overridden ``step``/``unscale_``/``update`` (the
+        distributed shard_scaler wrap, a user subclass) or a custom
+        optimizer ``step()`` (the LBFGS pattern) has behavior the
+        captured program cannot reproduce, and a pending manual
+        ``unscale_`` mark means this iteration already started
+        eagerly. The tuple joins the CapturedStep signature, so two
+        scalers with different schedules never share a program."""
+        if type(self).step is not GradScaler.step or \
+                "step" in self.__dict__:
+            return None
+        if type(self).unscale_ is not GradScaler.unscale_ or \
+                "unscale_" in self.__dict__:
+            return None
+        if type(self).update is not GradScaler.update or \
+                "update" in self.__dict__:
+            return None
+        if self._unscaled_opts:
+            return None  # mid-iteration: grads already unscaled eagerly
+        from ..optimizer.optimizer import Optimizer
+        cls = type(optimizer)
+        if (getattr(cls, "step", None) is not Optimizer.step
+                or getattr(cls, "_step_masked", None)
+                is not Optimizer._step_masked
+                or "step" in optimizer.__dict__):
+            return None  # custom step() must run as written (host path)
+        return (bool(self._dynamic), self._incr_ratio, self._decr_ratio,
+                self._incr_every, self._decr_every)
+
+    def capture_carry(self):
+        """The device-resident scaler state as donated 0-d carries:
+        (scale f32, good_steps i32, bad_steps i32). The captured step
+        consumes (donates) these and :meth:`absorb_captured` rebinds
+        the outputs — the loop never uploads or syncs scaler state."""
+        return (jnp.asarray(self._scale, jnp.float32),
+                jnp.asarray(self._good_steps, jnp.int32),
+                jnp.asarray(self._bad_steps, jnp.int32))
+
+    def absorb_captured(self, carry, found) -> None:
+        """Install a captured step's outputs: the new (scale, good,
+        bad) carry and the step's 0-d device found_inf (observability
+        parity — reading it is the caller's sync to pay). The captured
+        program already ran this iteration's ``update()`` bookkeeping,
+        so the iteration ends here: unscale marks clear and the found
+        accumulator holds only this step's flag."""
+        self._scale, self._good_steps, self._bad_steps = carry
+        self._found_inf = found
+        self._unscaled_opts.clear()
+
     def is_enable(self):
         return self._enable
 
@@ -182,7 +243,10 @@ class GradScaler:
         return self._dynamic
 
     def get_loss_scaling(self):
-        return Tensor(jnp.asarray(self._scale))
+        # copy: under whole-step capture the live scale buffer is
+        # DONATED to the next captured step — a returned handle
+        # wrapping it would read a deleted buffer
+        return Tensor(jnp.copy(jnp.asarray(self._scale)))
 
     def set_init_loss_scaling(self, v):
         self._scale = jnp.float32(v)

@@ -139,4 +139,13 @@ CAPTURE_ALLOWLIST = [
      "trustworthy sync over the TPU tunnel — warmup fetches bound the "
      "compile, the final fetch closes the timed region; the timed "
      "loop itself stays fetch-free"),
+    ("PTC001", "paddle_tpu/amp/grad_scaler.py*",
+     "the legacy override path ONLY: an optimizer with a custom "
+     "step() (the LBFGS pattern) must run as written, so the found "
+     "flag branches on host by contract — the plain path masks the "
+     "update on device and never takes this branch, and under "
+     "whole-step capture the entire scaler iteration (scale/backward/"
+     "unscale/check/masked skip/scale bookkeeping) runs inside the "
+     "ONE captured executable without reaching GradScaler.step at "
+     "all"),
 ]

@@ -691,6 +691,11 @@ REPO_STEPS: List[Tuple[str, str, Tuple[str, ...]]] = [
      ("params", "kv", "last_ids", "draft_tok", "pos", "tables",
       "act")),
     ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine.spec_step", ()),
+    ("paddle_tpu/distributed/dist_train.py", "DistTrainStep.__call__",
+     ("batch_and_labels",)),
+    ("paddle_tpu/distributed/dist_train.py", "_DistCapturedStep.step",
+     ("inputs", "labels")),
+    ("paddle_tpu/amp/grad_scaler.py", "GradScaler.step", ()),
     ("bench.py", "bench_llama", ()),
 ]
 

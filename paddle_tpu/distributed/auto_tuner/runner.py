@@ -117,8 +117,8 @@ def build_trial_runner(make_model: Callable[[], object],
         step = DistTrainStep(model, loss_fn, make_optimizer(model))
         batch = make_batch(config)
 
-        mem, compiled, (params, buffers, b, labels) = step.compile_stats(
-            *batch, return_compiled=True)
+        mem, compiled, (params, buffers, opt_state, raw) = \
+            step.compile_stats(*batch, return_compiled=True)
         # donated outputs (new params/opt state) alias their argument
         # buffers at runtime — count the aliased bytes once
         peak = (mem.argument_size_in_bytes + mem.temp_size_in_bytes +
@@ -134,14 +134,12 @@ def build_trial_runner(make_model: Callable[[], object],
         # time through the SAME executable (no second compile); donated
         # buffers force threading the state forward between calls
         import jax
-        opt_state = step._opt_state
         import jax.numpy as jnp
         lr = jnp.float32(0.0)
         rng = (jax.random.key(0), jnp.uint32(0))
 
         def one(params, buffers, opt_state, rng):
-            return compiled(params, buffers, opt_state, lr, rng, b,
-                            labels)
+            return compiled(params, buffers, opt_state, lr, rng, *raw)
 
         loss, params, buffers, opt_state, rng = one(params, buffers,
                                                     opt_state, rng)
@@ -155,11 +153,7 @@ def build_trial_runner(make_model: Callable[[], object],
         # donation consumed the step's original param/buffer/opt-state
         # buffers — re-sync the threaded-through state so the step (and
         # the model it wraps) stays usable after the trial
-        step._opt_state = opt_state
-        for k, t in step._params.items():
-            t._data = params[k]
-        for k, t in step._swap.buffers.items():
-            t._data = buffers[k]
+        step._resync(params, buffers, opt_state)
         items = int(np.asarray(batch[0]).shape[0])
         return items / dt
 

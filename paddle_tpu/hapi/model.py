@@ -75,17 +75,74 @@ class Model:
         self._loss = None
         self._metrics: List = []
         self._captured = None  # SOT whole-step capture engine (lazy)
+        self._amp = None       # auto_cast kwargs (amp_configs)
+        self._scaler = None    # GradScaler driving the AMP step
         self.stop_training = False
 
     # -- configuration -------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
+        """ref: hapi/model.py prepare. ``amp_configs`` (a level string
+        or a dict: level/dtype/custom_white_list/custom_black_list +
+        GradScaler knobs init_loss_scaling/incr_ratio/decr_ratio/
+        incr_every_n_steps/decr_every_n_nan_or_inf/
+        use_dynamic_loss_scaling, or an explicit ``scaler``) turns
+        train/eval batches into AMP steps: forward+loss under
+        ``amp.auto_cast``, backward+update through the GradScaler when
+        one is configured. Under whole-step capture the ENTIRE AMP
+        iteration (scale, backward, unscale, finite check, masked
+        update, scale bookkeeping) runs as ONE donated executable."""
         self._optimizer = optimizer
         self._loss = loss
         ms = metrics or []
         self._metrics = list(ms) if isinstance(ms, (list, tuple)) else [ms]
         self._captured = None  # new loss/optimizer: stale programs out
+        self._amp, self._scaler = self._parse_amp(amp_configs)
         return self
+
+    @staticmethod
+    def _parse_amp(amp_configs):
+        if not amp_configs:
+            return None, None
+        if isinstance(amp_configs, str):
+            amp_configs = {"level": amp_configs}
+        cfg = dict(amp_configs)
+        level = str(cfg.pop("level", "O1")).upper()
+        if level == "O0":
+            return None, None
+        scaler = cfg.pop("scaler", None)
+        scaler_keys = {
+            "init_loss_scaling", "incr_ratio", "decr_ratio",
+            "incr_every_n_steps", "decr_every_n_nan_or_inf",
+            "use_dynamic_loss_scaling"}
+        scaler_kw = {k: cfg.pop(k) for k in list(cfg)
+                     if k in scaler_keys}
+        amp = {"level": level,
+               "dtype": cfg.pop("dtype", "bfloat16"),
+               "custom_white_list": cfg.pop("custom_white_list", None),
+               "custom_black_list": cfg.pop("custom_black_list", None)}
+        cfg.pop("use_fp16_guard", None)  # accepted for reference parity
+        if cfg:
+            raise ValueError(f"unknown amp_configs keys: {sorted(cfg)}")
+        if scaler is not None and scaler_kw:
+            raise ValueError(
+                f"amp_configs passes both an explicit scaler and "
+                f"scaler knobs {sorted(scaler_kw)} — configure the "
+                f"scaler you pass, or drop it and pass the knobs")
+        if scaler is None and (scaler_kw
+                               or str(amp["dtype"]) == "float16"):
+            # fp16 needs loss scaling; bf16 gets a scaler only when
+            # scaler knobs ask for one (same exponent range as fp32)
+            from ..amp import GradScaler
+            scaler = GradScaler(**scaler_kw)
+        return amp, scaler
+
+    def _amp_ctx(self):
+        from ..amp.auto_cast import auto_cast
+        if self._amp is None:
+            import contextlib
+            return contextlib.nullcontext()
+        return auto_cast(True, **{k: v for k, v in self._amp.items()})
 
     def _capture_engine(self):
         """The SOT whole-step engine behind train_batch/eval_batch: one
@@ -116,16 +173,31 @@ class Model:
         ins = [_to_tensor(i) for i in ins]
         lbl = labels if isinstance(labels, (tuple, list)) else [labels]
         lbl = [_to_tensor(v) for v in lbl if v is not None]
+        scaler = self._scaler if self._amp is not None else None
         if update and self._optimizer is not None:
-            loss = self._capture_engine().step(ins, lbl)
+            # the capture engine traces the forward under the ambient
+            # autocast regime; with a scaler the whole AMP iteration
+            # (scale/backward/unscale/check/masked update/scale
+            # bookkeeping) is the one captured executable
+            with self._amp_ctx():
+                loss = self._capture_engine().step(ins, lbl,
+                                                   scaler=scaler)
             if loss is not None:
                 return [loss]
-        out = self.network(*ins)
-        loss = out
-        if self._loss is not None:
-            loss = self._loss(out, *lbl)
-        if loss._data.ndim > 0:
-            loss = loss.mean()
+        with self._amp_ctx():
+            out = self.network(*ins)
+            loss = out
+            if self._loss is not None:
+                loss = self._loss(out, *lbl)
+            if loss._data.ndim > 0:
+                loss = loss.mean()
+        if scaler is not None and scaler.is_enable():
+            scaler.scale(loss).backward()
+            if update and self._optimizer is not None:
+                scaler.step(self._optimizer)
+                scaler.update()
+                self._optimizer.clear_grad()
+            return [loss]
         loss.backward()
         if update and self._optimizer is not None:
             self._optimizer.step()
@@ -142,15 +214,16 @@ class Model:
         lbl = labels if isinstance(labels, (tuple, list)) else [labels]
         lbl = [_to_tensor(v) for v in lbl if v is not None]
         out = loss = None
-        res = self._capture_engine().forward(ins, lbl)
-        if res is not None:
-            out, loss = res
-        else:
-            out = self.network(*ins)
-            if self._loss is not None and labels is not None:
-                loss = self._loss(out, *lbl)
-                if loss._data.ndim > 0:
-                    loss = loss.mean()
+        with self._amp_ctx():
+            res = self._capture_engine().forward(ins, lbl)
+            if res is not None:
+                out, loss = res
+            else:
+                out = self.network(*ins)
+                if self._loss is not None and labels is not None:
+                    loss = self._loss(out, *lbl)
+                    if loss._data.ndim > 0:
+                        loss = loss.mean()
         outs = {}
         if loss is not None:
             outs["loss"] = loss

@@ -745,17 +745,11 @@ class SOTFunction:
             parts.append((k, self._arg_key(kwargs[k])))
         # non-tensor state that steers traces: layer train/eval modes and
         # the AMP autocast regime (apply_op casts differently under it)
-        from ..amp.auto_cast import _state as _amp_state
+        from ..amp.auto_cast import amp_signature
         modes = tuple(
             sub.training for lyr in self._layers
             for sub in lyr.sublayers(include_self=True))
-        parts.append(("mode", modes, bool(_amp_state.enabled),
-                      str(getattr(_amp_state, "dtype", None)),
-                      getattr(_amp_state, "level", None),
-                      tuple(sorted(getattr(_amp_state, "custom_white",
-                                           ()) or ())),
-                      tuple(sorted(getattr(_amp_state, "custom_black",
-                                           ()) or ()))))
+        parts.append(("mode", modes) + amp_signature())
         return tuple(parts)
 
     def _cache_put(self, key, value):
@@ -978,11 +972,19 @@ class CapturedStep:
     * **Hoisted loss** — the returned loss is a LAZY device scalar
       (a ``Tensor``); nothing inside the captured region syncs to
       host. Fetch it at the logging boundary (``float(loss)``).
+    * **AMP + GradScaler** capture too (the PR 10 ``amp`` residue,
+      closed): the autocast regime joins the signature and the forward
+      traces under the ambient thread-local; with ``step(...,
+      scaler=)`` the whole iteration — loss scale, backward, unscale +
+      finite check, device-masked skip, dynamic-scale bookkeeping —
+      is the one donated executable, scaler counters riding as 0-d
+      device carries.
     * **Fallbacks** are total and counted (``sot.fallbacks_total``
-      {reason} + a flight event): AMP autocast, debug flags
+      {reason} + a flight event): debug flags
       (check_nan_inf / benchmark / retain-all), layer or tensor hooks,
       non-fusable optimizers, unknown clip objects, non-static
-      hyperparams, aliased donation leaves, pre-accumulated grads —
+      hyperparams, aliased donation leaves, pre-accumulated grads,
+      overridden scaler/optimizer steps (``scaler``) —
       each returns ``None`` and the caller runs today's eager path.
     """
 
@@ -1015,12 +1017,17 @@ class CapturedStep:
             "eager_steps": 0, "fallbacks": {}}
 
     # -- gating ------------------------------------------------------------
-    def _gate(self, train: bool) -> Optional[str]:
+    def _gate(self, train: bool, scaler=None) -> Optional[str]:
         """Capture preconditions. None = capturable; otherwise the
-        fallback reason (the caller runs today's eager path)."""
-        from ..amp.auto_cast import _state as _amp_state
-        if _amp_state.enabled:
-            return "amp"
+        fallback reason (the caller runs today's eager path). AMP
+        autocast is NOT a gate anymore: the regime is part of the
+        program signature and the forward traces under the ambient
+        thread-local, so AMP (and GradScaler, via the ``scaler``
+        carry) steps capture like plain ones."""
+        if scaler is not None and \
+                scaler.capture_statics(self.optimizer) is None:
+            # an overridden scaler/optimizer step must run as written
+            return "scaler"
         if _flag_registry["check_nan_inf"].value:
             return "nan_check"
         if _flag_registry["benchmark"].value:
@@ -1079,15 +1086,19 @@ class CapturedStep:
         return [k for k in sorted(self._swap.params)
                 if not self._swap.params[k].stop_gradient]
 
-    def _signature(self, kind: str, arrays, n_ins: int,
-                   tkeys) -> Optional[tuple]:
+    def _signature(self, kind: str, arrays, n_ins: int, tkeys,
+                   scaler_statics=None) -> Optional[tuple]:
+        from ..amp.auto_cast import amp_signature
         modes = tuple(lyr.training for lyr in self._sublayers)
         # n_ins is part of the key: same shapes with a different
-        # input/label split are DIFFERENT programs
-        parts: List[Any] = [kind, n_ins, modes, tuple(tkeys)]
+        # input/label split are DIFFERENT programs. The AMP regime is
+        # a guard too: a program traced under autocast must never
+        # serve a plain call (and vice versa).
+        parts: List[Any] = [kind, n_ins, modes, tuple(tkeys),
+                            amp_signature()]
         for a in arrays:
             parts.append((tuple(a.shape), str(a.dtype)))
-        if kind == "train":
+        if kind in ("train", "train_scaled"):
             from ..optimizer.fused_step import _hyper_key, _param_statics
             from ..utils.clip_grad import clip_spec
             opt = self.optimizer
@@ -1099,6 +1110,8 @@ class CapturedStep:
                           statics,
                           clip_spec(opt._grad_clip,
                                     exact=self._strict)))
+        if scaler_statics is not None:
+            parts.append(("scaler",) + tuple(scaler_statics))
         return tuple(parts)
 
     # -- batch plumbing ----------------------------------------------------
@@ -1123,8 +1136,28 @@ class CapturedStep:
                 out.append(jnp.asarray(np.asarray(v)))
         return out
 
+    # -- overridable build hooks (the distributed step specializes) --------
+    def _value_and_grads(self, loss_of, train_p, buffers, batch, labels,
+                         key):
+        """Trace-time hook: loss + grads of the trainable tree for one
+        step. ``loss_of(tp, bufs, mb, lbls, k_) -> (primal, (loss,
+        new_buffers))`` — the primal is what backward differentiates
+        (the SCALED loss under a GradScaler), the aux loss is what the
+        caller sees. The distributed subclass overrides this with the
+        gradient-merge scan."""
+        (_, (loss, new_buffers)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(train_p, buffers, batch, labels, key)
+        return loss, grads, new_buffers
+
+    def _sync_grads(self, grads, tkeys):
+        """Trace-time hook between backward and the optimizer tail:
+        the distributed subclass emits bucketed gradient collectives
+        here (first-class DAG nodes that overlap remaining backward
+        compute). Single-chip base: identity."""
+        return grads
+
     # -- program build -----------------------------------------------------
-    def _build(self, kind: str, n_ins: int):
+    def _build(self, kind: str, n_ins: int, scaler_statics=None):
         from .api import _notify_build, _tree_unwrap
         from ..core.autograd import no_grad
         _notify_build(self._build_kind)
@@ -1154,45 +1187,108 @@ class CapturedStep:
 
             return jax.jit(eval_fn)
 
+        scaled = kind == "train_scaled"
         tkeys = self._tkeys()
         trainable = set(tkeys)
         param_objs = [swap.params[k] for k in tkeys]
         from ..utils.clip_grad import clip_spec
         cspec = clip_spec(opt._grad_clip, exact=self._strict) or ()
 
-        def step_fn(params, buffers, states, lr, rng, *batch):
-            root, count = rng
-            key = jax.random.fold_in(root, count)
+        def run_step(params, buffers, states, lr, key, batch,
+                     scale=None):
+            """fwd + bwd + (unscale/check) + optimizer tail — shared
+            by the plain and the GradScaler-scaled programs."""
             train_p = {k: v for k, v in params.items() if k in trainable}
             frozen_p = {k: v for k, v in params.items()
                         if k not in trainable}
 
-            def loss_of(tp):
+            def loss_of(tp, bufs, mb, lbls, k_):
                 full = {**tp, **frozen_p}
-                with no_grad(), random_mod.key_stream(key):
-                    ins = tuple(Tensor(b) for b in batch[:n_ins])
-                    lbls = tuple(Tensor(b) for b in batch[n_ins:])
-                    out, new_buffers = swap.run(full, buffers,
+                with no_grad(), random_mod.key_stream(k_):
+                    ins = tuple(Tensor(b) for b in mb)
+                    lbl_t = tuple(Tensor(x) for x in lbls)
+                    out, new_buffers = swap.run(full, bufs,
                                                 network.__call__, *ins)
-                    ld = loss_value(out, lbls)
-                return ld, new_buffers
+                    ld = loss_value(out, lbl_t)
+                # the primal backward differentiates is the SCALED loss
+                # (eager parity: scaler.scale(loss).backward()); the
+                # scale is cast into the loss dtype exactly like
+                # GradScaler.scale
+                primal = ld if scale is None else \
+                    ld * scale.astype(ld.dtype)
+                return primal, (ld, new_buffers)
 
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_p)
+            loss, grads, new_buffers = self._value_and_grads(
+                loss_of, train_p, buffers, tuple(batch[:n_ins]),
+                tuple(batch[n_ins:]), key)
+            grads = self._sync_grads(grads, tkeys)
+            g_leaves = [grads[k] for k in tkeys]
+            p_leaves = [params[k] for k in tkeys]
+            found = None
+            if scale is not None:
+                # grad unscale + global finite check: the SAME numeric
+                # definition as GradScaler.unscale_/try_step_scaled
+                from ..optimizer.fused_step import _unscale_fn
+                g_leaves, found = _unscale_fn(
+                    g_leaves, jnp.float32(1.0) / scale)
             from ..optimizer.fused_step import apply_update_tail
             new_ps, new_ss = apply_update_tail(
-                opt, param_objs, [params[k] for k in tkeys],
-                [grads[k] for k in tkeys], states, lr, cspec)
+                opt, param_objs, p_leaves, g_leaves, states, lr, cspec)
+            if found is not None:
+                # conditional skip ON DEVICE (the fused scaled step's
+                # mask): non-finite grads keep every param/state leaf
+                new_ps = [jnp.where(found, p, q)
+                          for p, q in zip(p_leaves, new_ps)]
+                new_ss = [{k2: jnp.where(found, st[k2], v)
+                           for k2, v in ns.items()}
+                          for st, ns in zip(states, new_ss)]
             new_params = dict(params)
             for k, v in zip(tkeys, new_ps):
                 new_params[k] = v
+            return loss, new_params, new_buffers, new_ss, found
+
+        if not scaled:
+            def step_fn(params, buffers, states, lr, rng, *batch):
+                root, count = rng
+                key = jax.random.fold_in(root, count)
+                loss, new_params, new_buffers, new_ss, _ = run_step(
+                    params, buffers, states, lr, key, batch)
+                return (loss, new_params, new_buffers, new_ss,
+                        (root, count + jnp.uint32(1)))
+
+            donate = (0, 1, 2, 4) if self._donate else ()
+            return jax.jit(step_fn, donate_argnums=donate)
+
+        # train_scaled: the whole GradScaler iteration in ONE program —
+        # scale, backward, unscale + finite check, masked update, and
+        # the dynamic-loss-scale bookkeeping on donated 0-d carries
+        from ..amp.grad_scaler import _scale_update
+        dynamic, incr_ratio, decr_ratio, incr_every, decr_every = \
+            scaler_statics
+
+        def scaled_step_fn(params, buffers, states, lr, rng, carry,
+                           *batch):
+            root, count = rng
+            key = jax.random.fold_in(root, count)
+            scale, good, bad = carry
+            loss, new_params, new_buffers, new_ss, found = run_step(
+                params, buffers, states, lr, key, batch, scale=scale)
+            if dynamic:
+                new_scale, new_good, new_bad = _scale_update(
+                    found, scale, good, bad,
+                    jnp.float32(incr_ratio), jnp.float32(decr_ratio),
+                    jnp.int32(incr_every), jnp.int32(decr_every))
+            else:
+                new_scale, new_good, new_bad = scale, good, bad
             return (loss, new_params, new_buffers, new_ss,
-                    (root, count + jnp.uint32(1)))
+                    (root, count + jnp.uint32(1)),
+                    (new_scale, new_good, new_bad), found)
 
-        donate = (0, 1, 2, 4) if self._donate else ()
-        return jax.jit(step_fn, donate_argnums=donate)
+        donate = (0, 1, 2, 4, 5) if self._donate else ()
+        return jax.jit(scaled_step_fn, donate_argnums=donate)
 
-    def _get_program(self, kind: str, sig, n_ins: int):
+    def _get_program(self, kind: str, sig, n_ins: int,
+                     scaler_statics=None):
         """Compile-on-second-sighting (strict mode): returns the jitted
         program, or None when this signature should run eager this
         call."""
@@ -1206,7 +1302,7 @@ class CapturedStep:
             self._cache[sig] = _SEEN_STEP
             self._trim()
             return None
-        jitted = self._build(kind, n_ins)
+        jitted = self._build(kind, n_ins, scaler_statics)
         self._cache[sig] = jitted
         self._trim()
         self.stats["compiles"] += 1
@@ -1221,6 +1317,12 @@ class CapturedStep:
             self._cache.popitem(last=False)
 
     # -- donation-safe leaf gathering --------------------------------------
+    def _opt_state_for(self, p):
+        """Optimizer slot state for one param (creation hook: the
+        distributed subclass co-shards freshly created slots with the
+        parameter's own placement — the ZeRO contract)."""
+        return self.optimizer._state_for(p)
+
     @staticmethod
     def _safe_leaf(v):
         if isinstance(v, Tensor):
@@ -1247,7 +1349,7 @@ class CapturedStep:
         states = []
         if train:
             for k in (self._tkeys() if tkeys is None else tkeys):
-                st = opt._state_for(swap.params[k])
+                st = self._opt_state_for(swap.params[k])
                 states.append({kk: self._safe_leaf(vv)
                                for kk, vv in st.items()})
         if self._donate:
@@ -1281,22 +1383,41 @@ class CapturedStep:
         return self._rng
 
     # -- entry points ------------------------------------------------------
-    def step(self, inputs, labels=()):
+    def step(self, inputs, labels=(), scaler=None):
         """One captured train step over ``inputs``/``labels`` (lists of
         tensors/arrays). Returns the LAZY device loss ``Tensor``, or
         ``None`` when the caller must run today's eager path (kill
         switch, gate fallback, first sighting). In non-strict mode
         (``jit.TrainStep`` — an EXPLICIT whole-step API with no eager
-        fallback) the kill switch and the gates do not apply."""
+        fallback) the kill switch and the gates do not apply.
+
+        With ``scaler`` (an enabled ``amp.GradScaler``) the captured
+        program is the WHOLE AMP iteration: loss scale, backward,
+        grad unscale + finite check, device-masked update and the
+        dynamic-loss-scale bookkeeping — the scaler's scale/counters
+        ride as donated 0-d device carries and the skip decision
+        never syncs to host."""
+        if scaler is not None and not scaler.is_enable():
+            scaler = None
         if self._strict:
             if not _capture_flag.value:
                 return None
             if autograd_mod._op_recorder is not None:
                 return None  # an outer recorder must see the real ops
-            reason = self._gate(train=True)
+            reason = self._gate(train=True, scaler=scaler)
             if reason is not None:
                 self._fallback(reason)
                 return None
+        scaler_statics = None
+        if scaler is not None:
+            scaler_statics = scaler.capture_statics(self.optimizer)
+            if scaler_statics is None:
+                # non-strict callers have no eager path to fall back to
+                raise RuntimeError(
+                    "CapturedStep: this scaler/optimizer pairing "
+                    "(overridden step()/unscale_()/update(), or a "
+                    "pending manual unscale_) cannot run as a captured "
+                    "program")
         if self._bucket is not None:
             inputs = list(self._bucket.apply(tuple(inputs)))
         arrays = self._arrays(list(inputs) + list(labels))
@@ -1304,11 +1425,14 @@ class CapturedStep:
             self._fallback("tracer")
             return None
         tkeys = self._tkeys()
-        sig = self._signature("train", arrays, len(inputs), tkeys)
+        kind = "train" if scaler is None else "train_scaled"
+        sig = self._signature(kind, arrays, len(inputs), tkeys,
+                              scaler_statics)
         if sig is None:
             self._fallback("param_static")
             return None
-        jitted = self._get_program("train", sig, len(inputs))
+        jitted = self._get_program(kind, sig, len(inputs),
+                                   scaler_statics)
         if jitted is None:
             self.stats["eager_steps"] += 1
             return None
@@ -1321,9 +1445,20 @@ class CapturedStep:
         fusion.capture_handoff()
         from ..optimizer.fused_step import _lr_device
         opt, swap = self.optimizer, self._swap
-        loss, new_params, new_buffers, new_ss, self._rng = jitted(
-            params, buffers, states, _lr_device(opt), self._next_rng(),
-            *arrays)
+        if scaler is None:
+            loss, new_params, new_buffers, new_ss, self._rng = jitted(
+                params, buffers, states, _lr_device(opt),
+                self._next_rng(), *arrays)
+        else:
+            # donated carries: a live handle on the scale buffer (a
+            # held get_loss_scaling snapshot) copies before donation
+            carry = tuple(self._safe_leaf(v)
+                          for v in scaler.capture_carry())
+            (loss, new_params, new_buffers, new_ss, self._rng,
+             new_carry, found) = jitted(
+                params, buffers, states, _lr_device(opt),
+                self._next_rng(), carry, *arrays)
+            scaler.absorb_captured(new_carry, found)
         for k, t in swap.params.items():
             t._data = new_params[k]
         for k, t in swap.buffers.items():

@@ -12,7 +12,11 @@ syncs, fused-optimizer donations and fallbacks, whole-step jit builds,
 SOT capture lifecycle events (``sot`` category: segment_compile /
 capture_compile / guard_miss / retrace / fallback-by-reason — a
 production guard-miss storm reads straight out of a dump), eager
-collectives (op/bytes/duration), checkpoint save/restore/
+collectives (op/bytes/duration) plus the captured distributed step's
+bucketed gradient sync (``collective`` category: one ``grad_bucket``
+event per bucket per step — index/payload bytes/grad count, the T3
+overlap-efficiency numerator — and a ``dist_step`` summary carrying
+the step's host dispatch duration), checkpoint save/restore/
 corruption-fallback, elastic membership transitions, watchdog timeouts
 and the per-request serving lifecycle (submit → queued → admitted →
 [prefilled] → decode → finished/expired/rejected, keyed by
