@@ -1722,6 +1722,122 @@ def bench_dist_overlap_dryrun():
           min(b1, b2) / 2.0, detail)
 
 
+def _hot_start_impl():
+    """Worker body for hot_start_time_to_first_step: ONE process boot
+    — build a hapi model + captured train steps and a paged decode
+    engine, optionally pre-warmed from HS_BUNDLE — timing from before
+    model construction to the first captured-step loss fetch + first
+    decode tokens. HS_EXPORT additionally exports the warm bundle and
+    seals it (prewarm in-process so the AOT-lowered flavors persist
+    too). Cache dir arrives as FLAGS_executable_cache_dir in the
+    subprocess env."""
+    import time as _t
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.jit import warmup
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import PagedLlamaDecodeEngine
+
+    bundle = os.environ.get("HS_BUNDLE") or None
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(8, 8)).astype(np.float32)
+    y = rng.integers(0, 4, 8).astype(np.int64)
+
+    t0 = _t.perf_counter()
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 4))
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.AdamW(
+        learning_rate=1e-3, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), warm_bundle=bundle)
+    loss = None
+    for _ in range(3):
+        loss = m.train_batch([X], [y])
+    float(loss[0])                       # the first-step fetch
+    t_train = _t.perf_counter() - t0
+
+    paddle.seed(1)
+    lm = LlamaForCausalLM(LlamaConfig.tiny(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, use_flash_attention=False))
+    eng = PagedLlamaDecodeEngine(lm, max_slots=2, max_seq=64,
+                                 block_size=8, prefill_chunk=16)
+    if bundle:
+        warmup.prewarm(bundle, engine=eng)
+    toks = eng.generate([1, 2, 3, 4], max_new_tokens=4)
+    total = _t.perf_counter() - t0
+
+    export = os.environ.get("HS_EXPORT")
+    if export:
+        warmup.export_bundle(export)
+        warmup.prewarm(export, captured=m._captured, engine=eng)
+    return {"seconds": round(total, 3),
+            "train_seconds": round(t_train, 3),
+            "cache": warmup.cache_stats(),
+            "captured": dict(m._captured.stats, fallbacks=None),
+            "toks": [int(t) for t in toks]}
+
+
+def bench_hot_start():
+    """hot_start_time_to_first_step: cold boot vs pre-warmed boot in
+    capped subprocesses sharing ONE executable cache dir. The cold
+    worker compiles everything, persists it and exports the warm
+    bundle; the warm worker pre-warms from the bundle and must reach
+    its first captured train step + first decode tokens with 100%
+    persistent-cache hits (misses == 0 asserted) at >= 1x the cold
+    wall time (asserted) — the restart-without-compile-storm contract
+    (ROADMAP item 5)."""
+    import json as _json
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    cache = tempfile.mkdtemp(prefix="hot_start_cache_")
+    try:
+        bundle = os.path.join(cache, "warm_bundle.json")
+
+        def run(extra):
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       FLAGS_executable_cache_dir=cache, **extra)
+            env.pop("FLAGS_warmup_bundle", None)
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--hot-start-worker"],
+                env=env, capture_output=True, text=True, timeout=390)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"hot-start worker rc={r.returncode}: "
+                    f"{(r.stderr or '')[-400:]}")
+            return _json.loads(r.stdout.strip().splitlines()[-1])
+
+        cold = run({"HS_EXPORT": bundle})
+        warm = run({"HS_BUNDLE": bundle})
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+    assert warm["cache"]["misses"] == 0, warm["cache"]
+    assert warm["cache"]["hits"] > 0, warm["cache"]
+    assert warm["toks"] == cold["toks"], (warm, cold)
+    speedup = cold["seconds"] / max(warm["seconds"], 1e-9)
+    assert speedup >= 1.0, (cold["seconds"], warm["seconds"])
+    _emit("hot_start_time_to_first_step", warm["seconds"], "s",
+          speedup, {
+              "cold_boot_s": cold["seconds"],
+              "warm_boot_s": warm["seconds"],
+              "cold_train_s": cold["train_seconds"],
+              "warm_train_s": warm["train_seconds"],
+              "speedup": round(speedup, 2),
+              "cold_compiles": cold["cache"]["writes"],
+              "warm_cache": warm["cache"],
+              "warm_first_batch_captured":
+                  warm["captured"]["eager_steps"] == 0,
+              "bar": "warm boot >= 1x cold AND 100% executable-cache "
+                     "hits (0 fresh XLA compiles, counters pinned)"})
+
+
 def bench_analysis_selfcheck():
     """analysis_selfcheck: the analysis plane's seeded-bug smoke
     (python -m paddle_tpu.analysis --self-check in-process): one bug
@@ -1878,6 +1994,7 @@ _SUITE = [
     ("whole_step_capture_speedup", "bench_whole_step_capture"),
     ("amp_captured_step_us", "bench_amp_captured_step"),
     ("dist_overlap_dryrun", "bench_dist_overlap_dryrun"),
+    ("hot_start_time_to_first_step", "bench_hot_start"),
     ("analysis_selfcheck", "bench_analysis_selfcheck"),
     ("bench_llama", "bench_llama"),
     ("bench_llama7b_geometry", "bench_llama7b_geometry"),
@@ -1967,6 +2084,12 @@ def main(argv=None):
         _force_cpu_in_process()
         print(json.dumps(_dist_overlap_impl()), flush=True)
         return
+    if "--hot-start-worker" in argv:
+        # bench_hot_start's subprocess body: one boot against the
+        # shared executable cache dir (cold exports, warm pre-warms)
+        _force_cpu_in_process()
+        print(json.dumps(_hot_start_impl()), flush=True)
+        return
     if "--one" in argv:
         _run_one(argv[argv.index("--one") + 1])
         return
@@ -1983,7 +2106,7 @@ def main(argv=None):
                    bench_eager_fusion, bench_reduction_fusion,
                    bench_fused_optimizer_step,
                    bench_whole_step_capture, bench_amp_captured_step,
-                   bench_analysis_selfcheck):
+                   bench_hot_start, bench_analysis_selfcheck):
             try:
                 fn()
             except Exception as e:  # noqa: BLE001

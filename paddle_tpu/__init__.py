@@ -86,6 +86,12 @@ from . import device as device  # noqa: F401
 from . import hub  # noqa: F401
 from . import onnx  # noqa: F401
 
+# hot start: a boot with FLAGS_executable_cache_dir in the environment
+# gets the persistent executable cache configured BEFORE any compile
+# (model-init jnp programs included) — a no-op string compare when the
+# flag is empty (the compile seams re-check on runtime set_flags)
+jit.warmup.ensure_executable_cache()
+
 
 def disable_static(place=None):
     """Back to eager (the default). ref: paddle.disable_static."""

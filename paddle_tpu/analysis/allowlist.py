@@ -113,6 +113,23 @@ CAPTURE_ALLOWLIST = [
     # hoisted the fetch out of train_batch/eval_batch — they return a
     # lazy device loss and fit/evaluate fetch at the log boundary, so
     # the step functions now scan clean with no exception needed)
+    # -- hot start (ISSUE 14): precise rows FIRST so the broad
+    #    serving globs below don't absorb them with the wrong story --
+    ("PTC002", "paddle_tpu/jit/sot.py*",
+     "CapturedStep.prewarm is the BOOT-time AOT seam, not a step: it "
+     "installs the warm bundle's rebuilt program into the LRU before "
+     "the first step ever runs — the same program-cache bookkeeping "
+     "_get_program does at compile time, never replayed state"),
+    ("PTC002", "*`self._prefills` inside the step*",
+     "lazy program-cache instantiation (the per-bucket prefill "
+     "executable), shared by the serving hot path and the "
+     "warm-bundle _prewarm_entry replay: a dict-of-jitted-programs "
+     "fill, not step state — the programs themselves are pure"),
+    ("PTC002", "*`self.weight_swaps` inside the step*",
+     "hot-swap bookkeeping advances exactly at the step boundary the "
+     "swap is defined at: _apply_pending_swap runs between decode "
+     "steps on the loop thread, installs a validated param tree, and "
+     "never executes inside a captured program"),
     ("PTC002", "*`self._draft.*",
      "speculative decoding's draft mirror: the draft engine's slot "
      "state (last_ids/pos) is re-seeded from the TARGET's committed "

@@ -691,6 +691,12 @@ REPO_STEPS: List[Tuple[str, str, Tuple[str, ...]]] = [
      ("params", "kv", "last_ids", "draft_tok", "pos", "tables",
       "act")),
     ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine.spec_step", ()),
+    ("paddle_tpu/serving.py", "LlamaDecodeEngine.swap_weights", ()),
+    ("paddle_tpu/serving.py",
+     "GenerationServer._apply_pending_swap", ()),
+    ("paddle_tpu/serving.py",
+     "PagedLlamaDecodeEngine._prewarm_entry", ()),
+    ("paddle_tpu/jit/sot.py", "CapturedStep.prewarm", ()),
     ("paddle_tpu/distributed/dist_train.py", "DistTrainStep.__call__",
      ("batch_and_labels",)),
     ("paddle_tpu/distributed/dist_train.py", "_DistCapturedStep.step",

@@ -601,6 +601,8 @@ def _build_pure(sig):
 
 def _build_program(sig):
     """(pure fn, jitted fwd, jitted vjp) for a chain structure."""
+    from ..jit.warmup import ensure_executable_cache
+    ensure_executable_cache()  # fusion programs persist across boots too
     diff_idx = sig[3]
     fused = _build_pure(sig)
     jfwd = jax.jit(fused)

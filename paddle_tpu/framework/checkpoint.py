@@ -47,8 +47,8 @@ from ..utils import fault_injection as _fi
 from .io import _TensorPayload, _pack, _unpack
 
 __all__ = ["atomic_save", "load_checkpoint", "verify_checkpoint",
-           "CheckpointManager", "CheckpointCorruptError",
-           "FORMAT_VERSION"]
+           "extract_state_dict", "CheckpointManager",
+           "CheckpointCorruptError", "FORMAT_VERSION"]
 
 FORMAT_KEY = "__paddle_tpu_ckpt__"
 FORMAT_VERSION = 2
@@ -222,6 +222,32 @@ def load_checkpoint(path: str, return_numpy: bool = False,
     _flight.record("checkpoint", "restore",
                    path=os.path.basename(path), version=version)
     return _unpack(packed, return_numpy=return_numpy)
+
+
+def extract_state_dict(obj) -> Dict[str, Any]:
+    """The model state dict inside a checkpoint payload: a sub-tree
+    under the conventional ``model`` / ``state_dict`` / ``params``
+    keys when the payload is a composite (model + optimizer + step
+    bookkeeping, the trainer convention), else the payload itself
+    when it already is a flat name -> tensor mapping. The serving
+    weight hot-swap (``GenerationServer.swap_weights``) normalizes
+    every checkpoint shape through this one seam."""
+    if isinstance(obj, dict):
+        for key in ("model", "state_dict", "params"):
+            sub = obj.get(key)
+            if isinstance(sub, dict) and sub and \
+                    all(isinstance(k, str) for k in sub) and \
+                    all(hasattr(v, "shape") or hasattr(v, "_data")
+                        for v in sub.values()):
+                return sub
+        if obj and all(isinstance(k, str) for k in obj) and \
+                all(hasattr(v, "shape") or hasattr(v, "_data")
+                    for v in obj.values()):
+            return obj
+    raise ValueError(
+        "cannot find a model state dict in the checkpoint payload — "
+        "expected a flat {name: tensor} mapping or one nested under a "
+        "'model'/'state_dict'/'params' key")
 
 
 def verify_checkpoint(path: str) -> Tuple[bool, str]:

@@ -81,7 +81,7 @@ class Model:
 
     # -- configuration -------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, warm_bundle=None):
         """ref: hapi/model.py prepare. ``amp_configs`` (a level string
         or a dict: level/dtype/custom_white_list/custom_black_list +
         GradScaler knobs init_loss_scaling/incr_ratio/decr_ratio/
@@ -91,13 +91,27 @@ class Model:
         ``amp.auto_cast``, backward+update through the GradScaler when
         one is configured. Under whole-step capture the ENTIRE AMP
         iteration (scale, backward, unscale, finite check, masked
-        update, scale bookkeeping) runs as ONE donated executable."""
+        update, scale bookkeeping) runs as ONE donated executable.
+
+        ``warm_bundle`` (a manifest path or loaded bundle dict;
+        default ``FLAGS_warmup_bundle``) pre-warms the whole-step
+        capture engine NOW — the recorded train/eval programs are
+        rebuilt AOT against the persistent executable cache
+        (``FLAGS_executable_cache_dir``), so the FIRST ``train_batch``
+        runs captured with zero fresh XLA compiles instead of paying
+        the first-sighting eager step + compile."""
         self._optimizer = optimizer
         self._loss = loss
         ms = metrics or []
         self._metrics = list(ms) if isinstance(ms, (list, tuple)) else [ms]
         self._captured = None  # new loss/optimizer: stale programs out
         self._amp, self._scaler = self._parse_amp(amp_configs)
+        from ..jit import warmup as _warmup
+        from ..core.flags import flag_value
+        bundle = warm_bundle if warm_bundle is not None \
+            else (flag_value("warmup_bundle") or None)
+        if bundle:
+            _warmup.prewarm(bundle, captured=self._capture_engine())
         return self
 
     @staticmethod

@@ -424,7 +424,8 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
           max_slots: int = 4, max_seq: int = 256, int8: bool = False,
           eos_id=None, speculative: bool = False,
           spec_tokens: Optional[int] = None,
-          spec_draft_layers: Optional[int] = None):
+          spec_draft_layers: Optional[int] = None,
+          warm_bundle=None):
     """Minimal predictor server (ref: the reference ships its predictor
     behind paddle_serving / the C API server loop; this is the
     batteries-included analog). Concurrent requests are micro-batched
@@ -450,15 +451,27 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     (default ``FLAGS_serving_spec_tokens``) tokens per step — greedy
     output stays bit-equal, decode steps commit up to the whole
     accepted window per host round-trip.
+
+    ``warm_bundle`` (a manifest path or loaded bundle dict; default
+    ``FLAGS_warmup_bundle``) pre-warms the decode/prefill/spec
+    executables against the persistent executable cache
+    (``FLAGS_executable_cache_dir``) BEFORE the server admits its
+    first request — a freshly rolled replica is 100%-cache-hit on its
+    first token instead of paying a compile storm under traffic.
     """
     import io
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    from .core.flags import flag_value
+    from .jit import warmup as _warmup
+    _warmup.ensure_executable_cache()
     predictor = Predictor(Config(model_path))
     batcher = _MicroBatcher(predictor, max_batch=max_batch,
                             window_ms=batch_window_ms)
     gen_server = None
+    if warm_bundle is None:
+        warm_bundle = flag_value("warmup_bundle") or None
     if generate:
         from .serving import GenerationServer, PagedLlamaDecodeEngine
         # reuse the predictor's already-loaded Layer (a second
@@ -472,6 +485,10 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
             engine.attach_draft(
                 engine.make_draft(model, num_layers=spec_draft_layers),
                 spec_tokens=spec_tokens)
+        if warm_bundle:
+            # pre-warm BEFORE the loop thread starts admitting: the
+            # first request's decode/prefill steps must be cache hits
+            _warmup.prewarm(warm_bundle, engine=engine)
         gen_server = GenerationServer(engine)
 
     class Handler(BaseHTTPRequestHandler):

@@ -12,3 +12,4 @@ from .api import ignore_module, TranslatedLayer, enable_to_static  # noqa: F401
 from .api import set_code_level, set_verbosity  # noqa: F401
 from .sot import sot_compile, SOTFunction, BucketPolicy  # noqa: F401
 from .sot import capture, CapturedStep, capture_jit  # noqa: F401
+from . import warmup  # noqa: F401  — hot start: executable cache + pre-warm

@@ -537,6 +537,8 @@ class _CompiledPath:
         if self.replays < 1:
             return seg.pure
         if seg.jitted is None:
+            from .warmup import ensure_executable_cache
+            ensure_executable_cache()
             seg.jitted = jax.jit(seg.pure)
             _M_seg_compiles.inc()
             _flight.record("sot", "segment_compile", fn=self.name,
@@ -909,14 +911,21 @@ def capture(fn=None, bucket_policy: Optional[BucketPolicy] = None,
     return deco
 
 
-def capture_jit(fn, donate_argnums=(), name: Optional[str] = None):
+def capture_jit(fn, donate_argnums=(), name: Optional[str] = None,
+                warm: Optional[Dict[str, Any]] = None):
     """Wrap an already-whole-step function (e.g. the serving decode
     body) as a captured executable: ``jax.jit`` + SOT capture
     accounting — the first (trace+compile) execution journals a
     ``sot.capture_compile`` flight event and every call counts into
     ``sot.captured_steps_total`` while ``FLAGS_sot_capture`` is on.
     Behavior is identical to ``jax.jit`` (the kill switch only mutes
-    the accounting — the step was already a single executable)."""
+    the accounting — the step was already a single executable).
+    ``warm`` (a small JSON-able dict, e.g. the serving engines'
+    program geometry) records the first compile into the warm-bundle
+    manifest (``jit.warmup.note_program``) so a boot pre-warm can
+    rebuild it AOT."""
+    from .warmup import ensure_executable_cache, note_program
+    ensure_executable_cache()
     jf = jax.jit(fn, donate_argnums=donate_argnums)
     nm = name or getattr(fn, "__name__", "fn")
     compiled = [False]
@@ -931,6 +940,8 @@ def capture_jit(fn, donate_argnums=(), name: Optional[str] = None):
                 compiled[0] = True
                 _M_step_compiles.inc()
                 _flight.record("sot", "capture_compile", fn=nm)
+                if warm is not None:
+                    note_program("serving", nm, {"meta": dict(warm)})
             if _M_flag.value:
                 _M_captured._v += 1  # inline fast cell: hot path
         return out
@@ -1288,7 +1299,7 @@ class CapturedStep:
         return jax.jit(scaled_step_fn, donate_argnums=donate)
 
     def _get_program(self, kind: str, sig, n_ins: int,
-                     scaler_statics=None):
+                     scaler_statics=None, arrays=None):
         """Compile-on-second-sighting (strict mode): returns the jitted
         program, or None when this signature should run eager this
         call."""
@@ -1302,6 +1313,9 @@ class CapturedStep:
             self._cache[sig] = _SEEN_STEP
             self._trim()
             return None
+        from .warmup import (ensure_executable_cache, note_program,
+                             sig_to_json)
+        ensure_executable_cache()
         jitted = self._build(kind, n_ins, scaler_statics)
         self._cache[sig] = jitted
         self._trim()
@@ -1309,6 +1323,16 @@ class CapturedStep:
         _M_step_compiles.inc()
         _flight.record("sot", "capture_compile", fn=self._name,
                        kind=kind)
+        # warm-bundle record: enough to rebuild this program AOT at a
+        # future boot (prewarm), plus the exact signature so the warm
+        # program pre-populates the in-memory cache too
+        note_program("captured_step", self._name, {
+            "build": kind, "n_ins": n_ins,
+            "batch": [[list(a.shape), str(a.dtype)]
+                      for a in (arrays or [])],
+            "scaler": (list(scaler_statics) if scaler_statics
+                       else None),
+            "sig": sig_to_json(sig)})
         return jitted
 
     def _trim(self):
@@ -1432,7 +1456,7 @@ class CapturedStep:
             self._fallback("param_static")
             return None
         jitted = self._get_program(kind, sig, len(inputs),
-                                   scaler_statics)
+                                   scaler_statics, arrays=arrays)
         if jitted is None:
             self.stats["eager_steps"] += 1
             return None
@@ -1494,7 +1518,8 @@ class CapturedStep:
             return None
         sig = self._signature("eval", arrays, len(inputs),
                               self._tkeys())
-        jitted = self._get_program("eval", sig, len(inputs))
+        jitted = self._get_program("eval", sig, len(inputs),
+                                   arrays=arrays)
         if jitted is None:
             self.stats["eager_steps"] += 1
             return None
@@ -1514,6 +1539,71 @@ class CapturedStep:
         if _M_flag.value:
             _M_captured._v += 1
         return _tree_wrap(out), (None if loss is None else Tensor(loss))
+
+    def prewarm(self, entry) -> None:
+        """Boot pre-warm from one warm-bundle ``captured_step`` entry:
+        rebuild the recorded program and AOT-compile it over abstract
+        batch args (``lower().compile()`` — with the persistent
+        executable cache enabled this is a disk read, not an XLA
+        compile), then pre-populate the in-memory program cache under
+        the recorded signature so the first real step is a cache hit
+        (strict mode's first-sighting eager run is skipped too). A
+        signature that no longer matches this model/optimizer merely
+        leaves an unused cache entry — the real call still compiles
+        against the disk cache. Raises on unreplayable entries; the
+        caller (``warmup.prewarm``) counts and continues."""
+        kind = entry.get("build")
+        if kind not in ("train", "eval", "train_scaled"):
+            raise ValueError(f"unknown captured_step build {kind!r}")
+        n_ins = int(entry.get("n_ins", 1))
+        batch = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                 for s, d in entry.get("batch", [])]
+        scaler_statics = entry.get("scaler")
+        if scaler_statics is not None:
+            scaler_statics = tuple(scaler_statics)
+        jitted = self._build(kind, n_ins, scaler_statics)
+        swap = self._swap
+        params = {k: t._data for k, t in swap.params.items()}
+        buffers = {k: t._data for k, t in swap.buffers.items()}
+        # helper args reuse the live step's own constructors
+        # (next_key / the 0-d uint32 counter) or pure avals, so the
+        # pre-warm never compiles a helper program the bundle's
+        # writer didn't already write. The key draws are rolled back
+        # after: pre-warm must not advance the seeded RNG stream, or a
+        # warm boot's training randomness diverges from an identically
+        # seeded cold boot.
+        rng_state = random_mod.get_rng_state()
+        try:
+            if kind == "eval":
+                jitted.lower(params, buffers, random_mod.next_key(),
+                             *batch).compile()
+            else:
+                states = []
+                for k in self._tkeys():
+                    st = self._opt_state_for(swap.params[k])
+                    states.append({kk: self._safe_leaf(vv)
+                                   for kk, vv in st.items()})
+                from ..optimizer.fused_step import _lr_device
+                lr = _lr_device(self.optimizer)
+                rng = (random_mod.next_key(), jnp.uint32(0))
+                if kind == "train":
+                    jitted.lower(params, buffers, states, lr, rng,
+                                 *batch).compile()
+                else:
+                    carry = (jax.ShapeDtypeStruct((), jnp.float32),
+                             jax.ShapeDtypeStruct((), jnp.int32),
+                             jax.ShapeDtypeStruct((), jnp.int32))
+                    jitted.lower(params, buffers, states, lr, rng,
+                                 carry, *batch).compile()
+        finally:
+            random_mod.set_rng_state(rng_state)
+        sig = entry.get("sig")
+        if sig is not None:
+            from .warmup import sig_from_json
+            self._cache[sig_from_json(sig)] = jitted
+            self._trim()
+        _flight.record("warmup", "captured_step", fn=self._name,
+                       kind=kind)
 
     def compile_stats(self, inputs, labels=()):
         """Compile the train step for these batch shapes without running

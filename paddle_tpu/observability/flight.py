@@ -23,7 +23,14 @@ and the per-request serving lifecycle (submit → queued → admitted →
 ``trace_id``), plus the paged KV block pool's allocator
 (``block_alloc`` / ``block_free`` / ``block_exhausted`` — a pool
 running dry reads straight out of a dump next to the starved
-requests' queue time).
+requests' queue time), the hot-start plane (``warmup`` category:
+cache_configured / bundle_exported / bundle_failed-by-reason /
+prewarm summary / per-program captured_step+serving_program replays
+— a boot that compiled fresh instead of hitting the executable cache
+reads straight out of its dump) and zero-downtime weight hot-swaps
+(``serving`` ``swap_begin`` / ``swap_end`` pairs bracketing the step
+boundary the new weights installed at, with the in-flight count and
+the ok/rejected verdict).
 
 Recording is on by default (``FLAGS_flight_recorder``) because an
 append costs the same class of work as a ``Counter`` bump — one cached

@@ -314,6 +314,8 @@ def _get_program(key, builder, donate):
 
     ctx = _TraceCtx()
     if entry is _SEEN:
+        from ..jit.warmup import ensure_executable_cache
+        ensure_executable_cache()  # fused steps persist across boots
         jf = jax.jit(builder(ctx), donate_argnums=donate)
         entry = ("jit", _timed_first_call(jf), ctx)
         _put(entry)

@@ -122,6 +122,21 @@ _M_shed = _M.counter(
     "Submissions rejected by the load-shedding policy (block pool "
     "exhausted AND the deferred-waiting list over "
     "FLAGS_serving_shed_queue)")
+# zero-downtime weight hot-swap (GenerationServer.swap_weights):
+# applied between decode steps on the loop thread, in-flight requests
+# keep their KV blocks and continue on the new weights
+_M_swaps = _M.counter(
+    "weight_swaps_total",
+    "Weight hot-swaps applied by server loops (between decode steps; "
+    "no request dropped, no recompile)")
+_M_swap_rejected = _M.counter(
+    "weight_swaps_rejected_total",
+    "Weight hot-swaps rejected (shape/dtype/name mismatch against "
+    "the live tree) — the old weights stay installed")
+_M_swap_s = _M.histogram(
+    "swap_seconds",
+    "Wall seconds a weight hot-swap held the decode loop at its step "
+    "boundary (weight prep + validation + install)")
 # which implementation the paged_attention seam runs (decided once per
 # engine at program-build time; the compiled steps bake the path in)
 _M_pa_kernel = _M.counter(
@@ -137,6 +152,10 @@ _M_pa_fallback = _M.counter(
 # single request's submit -> queued -> admitted -> decode -> terminal
 # trail even across servers
 _REQ_SEQ = itertools.count(1)
+
+# 0-d int32 aval for pre-warm lowers: matches the jnp.int32(...) args
+# the live host orchestration passes, without compiling anything
+_I32 = jax.ShapeDtypeStruct((), np.int32)
 
 
 def _quantize_w(w_t):
@@ -189,37 +208,8 @@ class LlamaDecodeEngine:
             p: Dict[str, object] = dict(share_params)
             p["layers"] = list(share_params["layers"])[:self.n_layers]
         else:
-            sd = {k: v._data for k, v in model.named_parameters()}
-
-            def get(name):
-                return jnp.asarray(sd[name], dt)
-
-            p = {"emb": get("llama.embed_tokens.weight"),
-                 "norm": get("llama.norm.weight")}
-            # projections stored transposed ([out, in]) — see _mm
-            if cfg.tie_word_embeddings:
-                p["head"] = p["emb"]      # [V, H] is already the
-            else:                         # transposed head
-                p["head"] = get("lm_head.weight").T
-            layers = []
-            for i in range(self.n_layers):
-                pre = f"llama.layers.{i}."
-                lp = {"in_ln": get(pre + "input_layernorm.weight"),
-                      "post_ln": get(pre
-                                     + "post_attention_layernorm"
-                                       ".weight")}
-                for nm in ("q_proj", "k_proj", "v_proj", "o_proj"):
-                    lp[nm] = get(pre + "self_attn." + nm + ".weight").T
-                for nm in ("gate_proj", "up_proj", "down_proj"):
-                    lp[nm] = get(pre + "mlp." + nm + ".weight").T
-                if int8:
-                    for nm in ("q_proj", "k_proj", "v_proj", "o_proj",
-                               "gate_proj", "up_proj", "down_proj"):
-                        lp[nm] = _quantize_w(lp[nm])
-                layers.append(lp)
-            p["layers"] = layers
-            if int8:
-                p["head"] = _quantize_w(p["head"])
+            p = self._build_params(
+                {k: v._data for k, v in model.named_parameters()})
         self.params = p
 
         S = self.max_slots
@@ -245,6 +235,139 @@ class LlamaDecodeEngine:
         self._capture_jit = _capture_jit
         self._init_cache()
 
+    def _build_params(self, sd) -> Dict[str, object]:
+        """Device param pytree from a name -> array/Tensor state dict:
+        the same prep ``__init__`` does — dtype cast, TRANSPOSED
+        projections, optional int8 quantization, layer truncation — so
+        a swapped-in tree is layout-identical to a boot-time one and
+        the compiled step programs are reused as-is."""
+        cfg, dt = self.cfg, self.dtype
+
+        def get(name):
+            try:
+                v = sd[name]
+            except KeyError:
+                raise ValueError(
+                    f"weight state dict is missing {name!r} — not a "
+                    f"checkpoint of this model") from None
+            if hasattr(v, "_data"):
+                v = v._data
+            return jnp.asarray(v, dt)
+
+        p: Dict[str, object] = {"emb": get("llama.embed_tokens.weight"),
+                                "norm": get("llama.norm.weight")}
+        # projections stored transposed ([out, in]) — see _mm
+        if cfg.tie_word_embeddings:
+            p["head"] = p["emb"]      # [V, H] is already the
+        else:                         # transposed head
+            p["head"] = get("lm_head.weight").T
+        layers = []
+        for i in range(self.n_layers):
+            pre = f"llama.layers.{i}."
+            lp = {"in_ln": get(pre + "input_layernorm.weight"),
+                  "post_ln": get(pre
+                                 + "post_attention_layernorm"
+                                   ".weight")}
+            for nm in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                lp[nm] = get(pre + "self_attn." + nm + ".weight").T
+            for nm in ("gate_proj", "up_proj", "down_proj"):
+                lp[nm] = get(pre + "mlp." + nm + ".weight").T
+            if self.int8:
+                for nm in ("q_proj", "k_proj", "v_proj", "o_proj",
+                           "gate_proj", "up_proj", "down_proj"):
+                    lp[nm] = _quantize_w(lp[nm])
+            layers.append(lp)
+        p["layers"] = layers
+        if self.int8:
+            p["head"] = _quantize_w(p["head"])
+        return p
+
+    @staticmethod
+    def _leaf_specs(p) -> Dict[str, object]:
+        """leaf name -> (shape, dtype) spec of a param pytree (int8
+        (codes, scales) tuples spec both halves)."""
+        def spec(v):
+            if isinstance(v, tuple):
+                return tuple(spec(x) for x in v)
+            return (tuple(v.shape), str(v.dtype))
+
+        out: Dict[str, object] = {}
+        for k, v in p.items():
+            if k == "layers":
+                for i, lp in enumerate(v):
+                    for nm, lv in lp.items():
+                        out[f"layers.{i}.{nm}"] = spec(lv)
+            else:
+                out[k] = spec(v)
+        return out
+
+    def prepare_swap(self, state_dict):
+        """Build the device param tree for a weight swap WITHOUT
+        installing it — the expensive half (host->device upload,
+        per-layer transposes, optional KV quantization) that a
+        caller can run off the decode loop's thread; pass the result
+        to ``swap_weights(prepared=...)`` for the cheap validate +
+        pointer install at a step boundary."""
+        return self._build_params(dict(state_dict))
+
+    def swap_weights(self, state_dict=None, *, prepared=None) -> None:
+        """Replace this engine's weights IN PLACE between decode
+        steps: ``state_dict`` (model parameter names -> tensors, e.g.
+        a ``CheckpointManager.restore`` payload) is prepped exactly
+        like boot-time weights (or arrives pre-built via
+        ``prepared=``, see :meth:`prepare_swap`), validated
+        leaf-for-leaf against the live tree — same shapes/dtypes ⇒
+        the compiled decode/prefill/spec programs are reused with
+        ZERO recompiles — and only then installed. Any mismatch
+        raises with the old weights intact.
+        Slot state and KV blocks are untouched, so in-flight requests
+        continue on the new weights with their history preserved. An
+        attached weight-sharing draft (``make_draft`` view) is
+        re-pointed at the new arrays in the same swap; an independent
+        draft keeps its own weights (swap it separately) — the accept
+        rule keeps the committed stream correct either way."""
+        new_p = prepared if prepared is not None \
+            else self._build_params(dict(state_dict))
+        old_spec, new_spec = (self._leaf_specs(self.params),
+                              self._leaf_specs(new_p))
+        if old_spec != new_spec:
+            bad = [k for k in sorted(set(old_spec) | set(new_spec))
+                   if old_spec.get(k) != new_spec.get(k)]
+            raise ValueError(
+                f"weight swap rejected: {len(bad)} leaf(s) with "
+                f"incompatible shape/dtype (first: {bad[:4]}) — a "
+                f"zero-recompile swap requires the checkpoint to match "
+                f"the serving model's geometry exactly")
+        old = self.params
+        self.params = new_p
+        draft = self._draft
+        if draft is not None and draft.params.get("emb") is \
+                old.get("emb"):
+            view: Dict[str, object] = dict(new_p)
+            view["layers"] = list(new_p["layers"])[:draft.n_layers]
+            draft.params = view
+
+    def _prewarm_entry(self, entry) -> bool:
+        """AOT-rebuild one recorded serving program (a warm-bundle
+        entry) over this engine's live geometry via
+        ``lower().compile()`` — with the persistent executable cache
+        enabled this is a disk read, not a fresh XLA compile. Returns
+        False for entries this engine cannot replay (unknown program,
+        spec programs without a draft attached)."""
+        meta = entry.get("meta") or {}
+        if meta.get("program") != "decode":
+            return False
+        S = self.max_slots
+        # helper args are NumPy-backed (device_put, not a compiled
+        # fill program): pre-warm must never compile anything the
+        # bundle's writer didn't
+        self._decode._jitted.lower(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(np.zeros((S, 1), np.int32)),
+            jnp.asarray(np.zeros(S, np.int32))).compile()
+        _flight.record("warmup", "serving_program", program="decode")
+        return True
+
     def _init_cache(self) -> None:
         """Build the DENSE cache layout + its compiled step programs
         (PagedLlamaDecodeEngine overrides with the block pool)."""
@@ -269,7 +392,8 @@ class LlamaDecodeEngine:
         # flight journal — identical execution to a bare jax.jit
         self._decode = self._capture_jit(self._decode_impl,
                                          donate_argnums=(1, 2),
-                                         name="serving.decode")
+                                         name="serving.decode",
+                                         warm={"program": "decode"})
         self._decode_collect = None
         self._prefills: Dict[int, object] = {}
 
@@ -656,7 +780,8 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         # captured-step accounting exactly like the dense one
         self._decode = self._capture_jit(self._decode_impl,
                                          donate_argnums=(1,),
-                                         name="serving.paged_decode")
+                                         name="serving.paged_decode",
+                                         warm={"program": "decode"})
         self._decode_collect = None
         self._prefills: Dict[int, object] = {}
         self._prefill_state: Dict[int, dict] = {}
@@ -887,10 +1012,13 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         draft._spec_propose_k = k
         self._spec_propose = draft._capture_jit(
             draft._propose_impl, donate_argnums=(1,),
-            name="serving.spec_draft")
+            name="serving.spec_draft",
+            warm={"program": "spec_draft", "k": k,
+                  "draft_layers": draft.n_layers})
         self._spec_verify = self._capture_jit(
             self._spec_verify_impl, donate_argnums=(1,),
-            name="serving.spec_verify")
+            name="serving.spec_verify",
+            warm={"program": "spec_verify", "k": k})
         return self
 
     def begin_request(self, slot: int, prompt_ids,
@@ -943,7 +1071,8 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         if b not in self._prefills:
             self._prefills[b] = self._capture_jit(
                 self._prefill_impl, donate_argnums=(1,),
-                name="serving.paged_prefill")
+                name="serving.paged_prefill",
+                warm={"program": "prefill", "bucket": b})
         padded = np.zeros((1, b), np.int32)
         padded[0, :c] = ids[start:start + c]
         row = jnp.asarray(self._kv.block_tables[slot])
@@ -1189,6 +1318,58 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         if self._draft is not None:
             self._draft.release(slot, evicted=evicted)
 
+    def _prewarm_entry(self, entry) -> bool:
+        """Paged warm-bundle replay: decode, prefill (per recorded
+        bucket) and — with a draft attached — the speculative
+        propose/verify pair, each rebuilt AOT over the live block-pool
+        geometry (``lower().compile()`` = a persistent-cache disk
+        read). Spec entries without a draft return False (skipped, not
+        failed): the bundle writer's topology simply doesn't apply."""
+        meta = entry.get("meta") or {}
+        prog = meta.get("program")
+        S = self.max_slots
+        # NumPy-backed helper args (device_put, no compiled fill
+        # programs): pre-warm must never compile anything the bundle's
+        # writer didn't
+        ids = jnp.asarray(np.zeros((S, 1), np.int32))
+        pos = jnp.asarray(np.zeros(S, np.int32))
+        tables = jnp.asarray(self._kv.block_tables)
+        act = jnp.asarray(np.zeros(S, bool))
+        if prog == "decode":
+            self._decode._jitted.lower(
+                self.params, self.kvs, ids, pos, tables, act).compile()
+        elif prog == "prefill":
+            b = int(meta.get("bucket", 0) or
+                    min(self._bucket(1), self.prefill_chunk_len))
+            if b not in self._prefills:
+                self._prefills[b] = self._capture_jit(
+                    self._prefill_impl, donate_argnums=(1,),
+                    name="serving.paged_prefill",
+                    warm={"program": "prefill", "bucket": b})
+            self._prefills[b]._jitted.lower(
+                self.params, self.kvs,
+                jnp.asarray(np.zeros((1, b), np.int32)),
+                jnp.asarray(self._kv.block_tables[0]),
+                _I32, _I32, _I32).compile()
+        elif prog == "spec_draft":
+            draft = self._draft
+            if draft is None:
+                return False
+            self._spec_propose._jitted.lower(
+                draft.params, draft.kvs, ids, pos,
+                jnp.asarray(draft._kv.block_tables), act).compile()
+        elif prog == "spec_verify":
+            if self._draft is None:
+                return False
+            self._spec_verify._jitted.lower(
+                self.params, self.kvs, ids,
+                jnp.asarray(np.zeros((S, self._spec_k), np.int32)),
+                pos, tables, act).compile()
+        else:
+            return False
+        _flight.record("warmup", "serving_program", program=str(prog))
+        return True
+
     def export_decode(self):
         """AOT-serialize the PAGED decode step via jax.export: the
         signature carries the block pools, per-slot block tables and
@@ -1247,6 +1428,7 @@ class GenerationServer:
         self.rejected = 0           # submissions after shutdown/shed
         self.shed = 0               # rejections by load-shedding alone
         self.deadline_expired = 0   # requests failed by their deadline
+        self.weight_swaps = 0       # hot-swaps applied by this loop
         self._stopping = threading.Event()
         self._drained = threading.Event()
         # orders submit's stopping-check+enqueue against shutdown's
@@ -1255,6 +1437,10 @@ class GenerationServer:
         # only exits on stopping AND empty queue) cannot strand it
         from .analysis.locks import make_lock
         self._submit_lock = make_lock("serving.submit")
+        # pending weight hot-swap: (state_dict, done Event, result
+        # slot), set under the submit lock, applied by the LOOP thread
+        # at its next step boundary (never mid-decode)
+        self._swap_req = None
         self._metrics_server = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -1343,6 +1529,86 @@ class GenerationServer:
         if req["error"] is not None:
             raise req["error"]
         return list(req["out"])
+
+    @staticmethod
+    def _swap_state(source) -> dict:
+        """Normalize a swap source into a model state dict ON THE
+        CALLER'S THREAD (disk reads and CRC verification never stall
+        the decode loop): a ``CheckpointManager`` restores its newest
+        good checkpoint, a path loads through the verifying
+        ``framework.checkpoint`` reader, a dict passes through —
+        with the conventional 'model'/'state_dict' sub-tree peeled
+        off by ``extract_state_dict``."""
+        from .framework.checkpoint import (CheckpointManager,
+                                           extract_state_dict,
+                                           load_checkpoint)
+        if isinstance(source, CheckpointManager):
+            got = source.restore()
+            if got is None:
+                raise ValueError(
+                    f"no loadable checkpoint under {source.root!r} to "
+                    f"swap from")
+            source = got[1]
+        elif isinstance(source, str):
+            source = load_checkpoint(source)
+        return extract_state_dict(source)
+
+    def swap_weights(self, checkpoint_or_state,
+                     timeout: Optional[float] = 300.0) -> dict:
+        """Zero-downtime weight hot-swap: install new weights into the
+        running engine BETWEEN decode steps, without dropping or
+        corrupting any in-flight request — their KV blocks and partial
+        streams are untouched and the next decode step runs on the new
+        weights (an attached weight-sharing draft rolls in the same
+        swap).
+
+        ``checkpoint_or_state``: a model state dict, a checkpoint path
+        (verified by the ``framework.checkpoint`` reader), or a
+        ``CheckpointManager`` (its newest good checkpoint). Weight
+        prep (disk I/O + the full host->device build,
+        :meth:`~LlamaDecodeEngine.prepare_swap`) happens on THIS
+        thread; the loop thread only validates + pointer-installs at
+        its next step boundary. Same shapes/dtypes ⇒ zero recompiles;
+        any mismatch raises here with the old weights intact (counted
+        in ``serving.weight_swaps_rejected_total``). Returns swap
+        stats (``seconds``, ``in_flight`` at the boundary, ...). A
+        timeout clears the request if the loop has not yet claimed
+        it, so a later swap can be submitted."""
+        sd = self._swap_state(checkpoint_or_state)
+        try:
+            prepped = self.engine.prepare_swap(sd)
+        except Exception:
+            _M_swap_rejected.inc()
+            _flight.record("serving", "swap_end", ok=False,
+                           error="prepare")
+            raise
+        done = threading.Event()
+        slot: dict = {}
+        with self._submit_lock:
+            if self._stopping.is_set():
+                raise RuntimeError(
+                    "GenerationServer is shutting down; weights cannot "
+                    "be swapped into a draining loop")
+            if self._swap_req is not None:
+                raise RuntimeError(
+                    "a weight swap is already pending; wait for it "
+                    "before submitting another")
+            self._swap_req = (prepped, done, slot)
+        self._q.put(self._STOP)  # wake an idle loop (sentinel no-op)
+        if not done.wait(timeout):
+            with self._submit_lock:
+                cancelled = (self._swap_req is not None
+                             and self._swap_req[1] is done)
+                if cancelled:
+                    self._swap_req = None
+            raise TimeoutError(
+                f"weight swap not applied within {timeout}s — "
+                + ("cancelled before the loop claimed it"
+                   if cancelled else
+                   "the loop claimed it mid-apply; it may still land"))
+        if "error" in slot:
+            raise slot["error"]
+        return slot["result"]
 
     def _shed(self) -> bool:
         """Load-shedding policy (ROADMAP 1c), evaluated at submit
@@ -1627,9 +1893,51 @@ class GenerationServer:
                 self._fail(req, TimeoutError(
                     "request deadline expired while queued"))
 
+    def _apply_pending_swap(self) -> None:
+        """Apply a pending weight hot-swap HERE, on the loop thread,
+        at a step boundary: the previous decode step has fully
+        committed its tokens and no new step has dispatched, so no
+        in-flight request drops or corrupts a token — its KV blocks
+        and slot state are untouched and the next step simply runs on
+        the new weights. A rejected swap (engine validation) leaves
+        the old weights installed and the loop running."""
+        if self._swap_req is None:
+            return
+        with self._submit_lock:  # claim races a caller-side timeout
+            req = self._swap_req
+            self._swap_req = None
+        if req is None:
+            return
+        prepped, done, slot = req
+        t0 = time.perf_counter()
+        _flight.record("serving", "swap_begin",
+                       in_flight=len(self._slots),
+                       prefilling=len(self._prefilling))
+        try:
+            self.engine.swap_weights(prepared=prepped)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            _M_swap_rejected.inc()
+            _flight.record("serving", "swap_end", ok=False,
+                           error=type(e).__name__)
+            slot["error"] = e
+            done.set()
+            return
+        dt = time.perf_counter() - t0
+        self.weight_swaps += 1
+        _M_swaps.inc()
+        _M_swap_s.observe(dt)
+        _flight.record("serving", "swap_end", ok=True,
+                       seconds=round(dt, 4))
+        slot["result"] = {"seconds": dt,
+                          "in_flight": len(self._slots),
+                          "prefilling": len(self._prefilling),
+                          "steps_run": self.steps_run}
+        done.set()
+
     def _loop(self):
         while True:
             try:
+                self._apply_pending_swap()
                 self._admit()
                 if self._paged and self._prefilling:
                     self._run_prefill()
@@ -1713,6 +2021,14 @@ class GenerationServer:
                 self._prefilling.clear()
                 self._set_gauges()
         self._set_gauges()
+        # a swap still pending at loop exit can never apply: unblock
+        # its caller with the reason instead of letting it time out
+        req = self._swap_req
+        if req is not None:
+            self._swap_req = None
+            req[2]["error"] = RuntimeError(
+                "server shut down before the weight swap applied")
+            req[1].set()
         self._drained.set()
 
     def _set_gauges(self) -> None:
@@ -1777,6 +2093,7 @@ class GenerationServer:
         out = {"steps_run": self.steps_run, "admitted": self.admitted,
                "rejected": self.rejected, "shed": self.shed,
                "deadline_expired": self.deadline_expired,
+               "weight_swaps": self.weight_swaps,
                "in_flight": len(self._slots), "queued": queued,
                "prefilling": len(self._prefilling),
                "waiting_for_blocks": len(self._waiting),

@@ -125,6 +125,7 @@ class TestArchKeyNormalization:
         assert scale_suffix(0.25) == "0.25"
         assert scale_suffix("0.5") == "0.5"
 
+    @pytest.mark.slow  # ~53s: constructs every zoo CNN to reach the probe
     def test_zoo_arch_keys_exist(self, monkeypatch, tmp_path):
         """Every zoo constructor's pretrained branch must build an arch
         key that exists in its model_urls (probe by capturing the key at

@@ -113,6 +113,7 @@ def test_pretrained_offline_fails_loudly(monkeypatch, tmp_path):
         M.densenet121(pretrained=True)
 
 
+@pytest.mark.slow  # ~87s: a full densenet121 fwd+bwd+step compile on CPU
 def test_densenet_train_step_decreases_loss():
     """End-to-end: one tiny training step works through BN/dense blocks."""
     m = M.densenet121(num_classes=2)
