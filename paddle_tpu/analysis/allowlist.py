@@ -136,6 +136,22 @@ CAPTURE_ALLOWLIST = [
      "stream at the capture boundary — the draft/verify executables "
      "themselves are pure, only the accept/rollback bookkeeping "
      "between them mutates host state"),
+    # -- self-healing serving plane (ISSUE 15): the supervisor/policy
+    #    entry points are HOST control planes between captured
+    #    programs — precise rows first, per concern ------------------
+    ("PTC002", "*`self._steps_seen` inside the step*",
+     "adaptive-admission evidence bookkeeping: on_step folds "
+     "step-boundary gauges into host-side EWMAs and a step counter — "
+     "the policy DECIDES between captured programs, it never executes "
+     "inside one (brownout knobs only steer which already-compiled "
+     "program the next iteration picks)"),
+    ("PTC002", "paddle_tpu/serving_supervisor.py*",
+     "crash-recovery/rollout host bookkeeping is the capture boundary "
+     "BY DESIGN: strike/quarantine/restart counters and the "
+     "re-admission of recovered requests (prompt + committed tokens "
+     "through the normal prefill path) all advance while NO captured "
+     "program is in flight — the dead loop is fenced first, the new "
+     "loop replays the same pure compiled programs after"),
     ("PTC002", "paddle_tpu/serving.py*",
      "slot/block bookkeeping (pos/last_ids/active, block-table "
      "extension, prefill staging, speculative accept/rollback — "

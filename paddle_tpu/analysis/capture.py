@@ -696,6 +696,13 @@ REPO_STEPS: List[Tuple[str, str, Tuple[str, ...]]] = [
      "GenerationServer._apply_pending_swap", ()),
     ("paddle_tpu/serving.py",
      "PagedLlamaDecodeEngine._prewarm_entry", ()),
+    ("paddle_tpu/serving.py",
+     "PagedLlamaDecodeEngine.reset_state", ()),
+    ("paddle_tpu/serving_supervisor.py",
+     "ServingSupervisor._handle_death", ()),
+    ("paddle_tpu/serving_supervisor.py",
+     "AdaptiveAdmissionPolicy.on_step", ()),
+    ("paddle_tpu/serving_supervisor.py", "rollout", ()),
     ("paddle_tpu/jit/sot.py", "CapturedStep.prewarm", ()),
     ("paddle_tpu/distributed/dist_train.py", "DistTrainStep.__call__",
      ("batch_and_labels",)),

@@ -199,6 +199,26 @@ define_flag("paged_attention_kernel", True,
             "backend supports it; 0 forces the pure-jnp tiled walk "
             "(the CPU/tier-1 numerics oracle) everywhere. "
             "decode/verify/prefill all route through the one seam")
+define_flag("serving_admission_policy", "static",
+            "Admission policy a GenerationServer builds when none is "
+            "passed: 'static' keeps the FLAGS_serving_shed_queue rule "
+            "(the fallback policy), 'adaptive' installs "
+            "serving_supervisor.AdaptiveAdmissionPolicy — "
+            "step-boundary EWMAs of blocks_free/backlog/throughput "
+            "driving graceful brownout (speculative window, then "
+            "prefill chunk) before hard shedding, plus deadline-aware "
+            "rejection at submit")
+define_flag("serving_supervisor_backoff", 0.05,
+            "Base seconds of the ServingSupervisor's bounded "
+            "exponential restart backoff: death N of a streak waits "
+            "backoff * 2^(N-1), capped; the streak resets after a "
+            "healthy stretch")
+define_flag("serving_supervisor_stall_seconds", 0.0,
+            "Decode-loop stall watchdog: a loop thread that is alive "
+            "but has not heartbeat for this many seconds WHILE "
+            "holding work is fenced and restarted like a crash (0 = "
+            "stall detection off; an idle loop parked on the empty "
+            "queue never counts as stalled)")
 define_flag("serving_shed_queue", 0,
             "Load-shedding queue bound for the paged GenerationServer: "
             "when the KV block pool has no available blocks AND more "

@@ -425,7 +425,7 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
           eos_id=None, speculative: bool = False,
           spec_tokens: Optional[int] = None,
           spec_draft_layers: Optional[int] = None,
-          warm_bundle=None):
+          warm_bundle=None, supervised: bool = False):
     """Minimal predictor server (ref: the reference ships its predictor
     behind paddle_serving / the C API server loop; this is the
     batteries-included analog). Concurrent requests are micro-batched
@@ -458,6 +458,15 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     (``FLAGS_executable_cache_dir``) BEFORE the server admits its
     first request — a freshly rolled replica is 100%-cache-hit on its
     first token instead of paying a compile storm under traffic.
+
+    ``supervised=True`` attaches a
+    ``serving_supervisor.ServingSupervisor`` to the generation
+    server: a decode-loop crash (or stall, with
+    ``FLAGS_serving_supervisor_stall_seconds`` set) auto-dumps
+    flight, restarts the loop with bounded backoff, and RESUMES
+    in-flight generations bit-equal from their committed tokens —
+    repeat-offender requests are quarantined instead of crash-looping
+    the replica.
     """
     import io
     import threading
@@ -490,6 +499,11 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
             # first request's decode/prefill steps must be cache hits
             _warmup.prewarm(warm_bundle, engine=engine)
         gen_server = GenerationServer(engine)
+        if supervised:
+            from .serving_supervisor import supervise
+            # held on the server so the monitor lives exactly as long
+            # as the serving process does
+            gen_server._supervisor = supervise(gen_server)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet

@@ -44,17 +44,20 @@ from __future__ import annotations
 
 import json
 import os
+import stat as _stat
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
-from ..core.flags import _registry as _flag_registry, define_flag
+from ..core.flags import (_registry as _flag_registry, define_flag,
+                          flag_value)
 from ..observability import flight as _flight
 from ..observability import metrics as _om
 from ..utils import fault_injection as _fi
 
 __all__ = ["ensure_executable_cache", "cache_stats", "note_program",
            "recorded", "clear_recorded", "export_bundle", "load_bundle",
-           "prewarm", "BUNDLE_VERSION"]
+           "prewarm", "gc_cache_dir", "BUNDLE_VERSION"]
 
 define_flag(
     "executable_cache_dir", "",
@@ -71,6 +74,14 @@ define_flag(
     "that take warm_bundle= (Model.prepare, inference.serve, "
     "warmup.prewarm) fall back to this path when none is passed. "
     "Empty (default) = no automatic pre-warm")
+define_flag(
+    "executable_cache_gc_days", 0,
+    "Age-based GC of the persistent executable cache dir: entries "
+    "whose last hit (atime, falling back to mtime) is older than "
+    "this many days are evicted — counted "
+    "executable_cache.evicted_total — opportunistically whenever "
+    "ensure_executable_cache (re)configures the cache, or explicitly "
+    "via warmup.gc_cache_dir(). 0 (default) = never evict")
 
 _dir_flag = _flag_registry["executable_cache_dir"]
 _bundle_flag = _flag_registry["warmup_bundle"]
@@ -91,6 +102,10 @@ _M_misses = _M.counter(
 _M_writes = _M.counter(
     "writes_total",
     "Compiled executables written into the persistent cache dir")
+_M_evicted = _M.counter(
+    "evicted_total",
+    "Persistent-cache entries evicted by last-hit age "
+    "(FLAGS_executable_cache_gc_days / warmup.gc_cache_dir)")
 _W = _om.scope("warmup")
 _M_programs = _W.counter(
     "programs_total",
@@ -141,7 +156,60 @@ def ensure_executable_cache() -> bool:
         pass
     _state["dir"] = d
     _flight.record("warmup", "cache_configured", dir=d or "<off>")
+    if d is not None:
+        # opportunistic age GC: reconfiguration is the natural "a
+        # replica just booted against this dir" moment, and it is
+        # cold-path (the checked-once latch above guards the hot one)
+        try:
+            gc_cache_dir(directory=d)
+        except Exception:  # noqa: BLE001 — GC must never block boot
+            pass
     return d is not None
+
+
+def gc_cache_dir(max_age_days: Optional[float] = None,
+                 directory: Optional[str] = None) -> int:
+    """Evict persistent-executable-cache entries by LAST-HIT age: a
+    regular file in the cache dir whose newest of (atime, mtime) is
+    older than ``max_age_days`` (default
+    ``FLAGS_executable_cache_gc_days``; <= 0 disables) is removed and
+    counted into ``executable_cache.evicted_total``. Warm-bundle
+    manifests (``*.json``) and subdirectories are never touched — only
+    the XLA cache's opaque artifact files age out. Returns the evicted
+    count; all I/O errors degrade to keeping the entry."""
+    if max_age_days is None:
+        max_age_days = flag_value("executable_cache_gc_days")
+    try:
+        age = float(max_age_days)
+    except (TypeError, ValueError):
+        return 0
+    d = directory or (str(_dir_flag.value or "").strip() or None)
+    if not d or age <= 0:
+        return 0
+    cutoff = time.time() - age * 86400.0
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return 0
+    removed = 0
+    for name in names:
+        if name.endswith(".json"):
+            continue  # warm bundles are manifests, not cache entries
+        path = os.path.join(d, name)
+        try:
+            st = os.stat(path)
+            if not _stat.S_ISREG(st.st_mode):
+                continue
+            if max(st.st_atime, st.st_mtime) < cutoff:
+                os.remove(path)
+                removed += 1
+        except OSError:
+            continue  # raced/unreadable: keep it, try next boot
+    if removed:
+        _M_evicted.inc(removed)
+        _flight.record("warmup", "cache_gc", dir=os.path.basename(d),
+                       evicted=removed, max_age_days=age)
+    return removed
 
 
 def _install_counters(_cc) -> None:
@@ -368,7 +436,18 @@ def prewarm(bundle=None, captured=None, engine=None) -> Dict[str, int]:
                 step_target.prewarm(entry)
                 out["programs"] += 1
             elif kind == "serving" and engine is not None:
-                if engine._prewarm_entry(entry):
+                res = engine._prewarm_entry(entry)
+                if res == "stale":
+                    # bundle written by a DIFFERENTLY-configured
+                    # replica (slots/blocks/buckets/spec_k): replaying
+                    # would compile fresh programs at boot while
+                    # claiming warmth — degrade instead, counted
+                    out["failures"] += 1
+                    _M_failures.inc(reason="stale")
+                    _flight.record("warmup", "bundle_failed",
+                                   reason="stale",
+                                   fn=str(entry.get("name", "")))
+                elif res:
                     out["programs"] += 1
                 else:
                     out["skipped"] += 1

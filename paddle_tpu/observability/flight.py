@@ -27,10 +27,22 @@ requests' queue time), the hot-start plane (``warmup`` category:
 cache_configured / bundle_exported / bundle_failed-by-reason /
 prewarm summary / per-program captured_step+serving_program replays
 — a boot that compiled fresh instead of hitting the executable cache
-reads straight out of its dump) and zero-downtime weight hot-swaps
+reads straight out of its dump), zero-downtime weight hot-swaps
 (``serving`` ``swap_begin`` / ``swap_end`` pairs bracketing the step
 boundary the new weights installed at, with the in-flight count and
-the ok/rejected verdict).
+the ok/rejected verdict), and the self-healing serving plane:
+``supervisor`` events (attached / loop_death / recover — per
+recovered request, with its committed-token count / quarantine with
+reason=poison / restart with backoff + streak / give_up /
+abort_drain) journal every decode-loop crash-or-stall recovery,
+``admission`` events (engage_/release_brownout_spec,
+engage_/release_brownout_prefill, engage_/release_shed,
+shed / shed_static / deadline_reject / release_clear) journal every
+adaptive-admission decision with the evidence it was decided on, and
+``rollout`` events (begin / canary_probe with the divergence /
+stage_ok / rollback / halted-by-reason / end) journal a canary weight
+rollout stage by stage — a bad deploy reads straight out of the
+canary's dump.
 
 Recording is on by default (``FLAGS_flight_recorder``) because an
 append costs the same class of work as a ``Counter`` bump — one cached

@@ -121,7 +121,13 @@ _M_shed = _M.counter(
     "shed_total",
     "Submissions rejected by the load-shedding policy (block pool "
     "exhausted AND the deferred-waiting list over "
-    "FLAGS_serving_shed_queue)")
+    "FLAGS_serving_shed_queue, or the adaptive policy at its shed "
+    "level)")
+_M_deadline_rej = _M.counter(
+    "admission_deadline_rejected_total",
+    "Submissions rejected at submit time because the request's "
+    "deadline cannot be met at the observed decode rate (adaptive "
+    "admission; the request never burns KV blocks)")
 # zero-downtime weight hot-swap (GenerationServer.swap_weights):
 # applied between decode steps on the loop thread, in-flight requests
 # keep their KV blocks and continue on the new weights
@@ -231,6 +237,13 @@ class LlamaDecodeEngine:
             if self.max_seq % ts == 0)
         self._draft: Optional["PagedLlamaDecodeEngine"] = None
         self._spec_k = 0
+        # adaptive-admission brownout knobs, applied by the server at
+        # step boundaries: _spec_suppressed drops speculative windows
+        # to plain steps, _chunk_cap bounds the prefill chunk length
+        # (both are step-boundary decisions — no compiled program
+        # changes shape mid-stream)
+        self._spec_suppressed = False
+        self._chunk_cap: Optional[int] = None
         from .jit.sot import capture_jit as _capture_jit
         self._capture_jit = _capture_jit
         self._init_cache()
@@ -347,16 +360,59 @@ class LlamaDecodeEngine:
             view["layers"] = list(new_p["layers"])[:draft.n_layers]
             draft.params = view
 
-    def _prewarm_entry(self, entry) -> bool:
+    def _warm_geo(self) -> Dict[str, object]:
+        """The serving geometry recorded beside every warm-bundle
+        program entry — what ``_bundle_stale`` checks a bundle's
+        entries against at pre-warm time, so a bundle written by a
+        differently-configured replica degrades to cold compile
+        (counted ``warmup.failures_total{reason=stale}``) instead of
+        silently replaying programs the persistent cache has no
+        artifacts for."""
+        return {"layout": "dense", "slots": self.max_slots,
+                "max_seq": self.max_seq}
+
+    def _bundle_stale(self, meta, keys=None) -> List[str]:
+        """Geometry keys on which a warm-bundle entry disagrees with
+        this live engine (empty = fresh). ``keys`` restricts the
+        check to the geometry a given program's SHAPE actually
+        depends on — a replica differing only in an irrelevant knob
+        (e.g. the prefill chunk, for a decode program) must not
+        discard valid warmth. Keys absent from ``meta``
+        (pre-freshness bundles) are not checked — the replay then
+        simply rebuilds over live shapes as before."""
+        geo = self._warm_geo()
+        if keys is not None:
+            geo = {k: geo[k] for k in keys if k in geo}
+        return sorted(k for k, v in geo.items()
+                      if k in meta and meta[k] != v)
+
+    def reset_state(self) -> None:
+        """Discard ALL slot and cache state — the crash-recovery seam:
+        after a decode-loop crash the donated cache buffers may be
+        mid-donation (deleted), so fresh zero pools replace them and
+        the host bookkeeping (pos/active/last_ids) resets. The
+        compiled step programs are KEPT — they are pure functions of
+        their arguments, so recovery costs zero recompiles."""
+        self.pos[:] = 0
+        self.active[:] = False
+        self.last_ids[:] = 0
+        self._alloc_cache()
+
+    def _prewarm_entry(self, entry):
         """AOT-rebuild one recorded serving program (a warm-bundle
         entry) over this engine's live geometry via
         ``lower().compile()`` — with the persistent executable cache
         enabled this is a disk read, not a fresh XLA compile. Returns
         False for entries this engine cannot replay (unknown program,
-        spec programs without a draft attached)."""
+        spec programs without a draft attached) and the string
+        ``"stale"`` for entries whose recorded geometry disagrees with
+        the live config (replaying those would compile FRESH programs
+        at boot while claiming warmth)."""
         meta = entry.get("meta") or {}
         if meta.get("program") != "decode":
             return False
+        if self._bundle_stale(meta):
+            return "stale"
         S = self.max_slots
         # helper args are NumPy-backed (device_put, not a compiled
         # fill program): pre-warm must never compile anything the
@@ -368,9 +424,9 @@ class LlamaDecodeEngine:
         _flight.record("warmup", "serving_program", program="decode")
         return True
 
-    def _init_cache(self) -> None:
-        """Build the DENSE cache layout + its compiled step programs
-        (PagedLlamaDecodeEngine overrides with the block pool)."""
+    def _alloc_cache(self) -> None:
+        """(Re)allocate the dense per-layer cache arrays — fresh zeros
+        at boot AND at crash recovery (``reset_state``)."""
         cfg = self.cfg
         S, L = self.max_slots, self.n_layers
         kvh = cfg.num_key_value_heads
@@ -383,6 +439,11 @@ class LlamaDecodeEngine:
                                   self.dtype) for _ in range(L)]
         self.v_cache = [jnp.zeros_like(self.k_cache[0])
                         for _ in range(L)]
+
+    def _init_cache(self) -> None:
+        """Build the DENSE cache layout + its compiled step programs
+        (PagedLlamaDecodeEngine overrides with the block pool)."""
+        self._alloc_cache()
         # caches are donated: each decode step updates them in place in
         # HBM instead of allocating a second [L,S,max_seq,...] copy.
         # The jitted step is registered as a CAPTURED step program
@@ -393,7 +454,8 @@ class LlamaDecodeEngine:
         self._decode = self._capture_jit(self._decode_impl,
                                          donate_argnums=(1, 2),
                                          name="serving.decode",
-                                         warm={"program": "decode"})
+                                         warm={"program": "decode",
+                                               **self._warm_geo()})
         self._decode_collect = None
         self._prefills: Dict[int, object] = {}
 
@@ -753,14 +815,12 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
                          num_layers=num_layers,
                          share_params=share_params)
 
-    def _init_cache(self) -> None:
-        from . import serving_cache as _sc
-        self._sc = _sc
-        cfg = self.cfg
-        kvh = cfg.num_key_value_heads
-        self._kv = _sc.PagedKVCache(
-            max_slots=self.max_slots, max_seq=self.max_seq,
-            block_size=self.block_size, num_blocks=self.num_blocks)
+    def _alloc_pools(self) -> Dict[str, list]:
+        """Fresh zeroed block pools (per-layer K/V + optional int8
+        scales) — built at boot and again at crash recovery
+        (``reset_state``), where the donated pool pytree may be
+        mid-donation."""
+        kvh = self.cfg.num_key_value_heads
         pool_dt = {"int8": jnp.int8,
                    "bfloat16": jnp.bfloat16}.get(self.kv_quant,
                                                  self.dtype)
@@ -774,17 +834,49 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
                          for _ in range(L)]
             kv["vsc"] = [jnp.zeros((NB, bs, kvh), jnp.float32)
                          for _ in range(L)]
-        self.kvs = kv
+        return kv
+
+    def _init_cache(self) -> None:
+        from . import serving_cache as _sc
+        self._sc = _sc
+        self._kv = _sc.PagedKVCache(
+            max_slots=self.max_slots, max_seq=self.max_seq,
+            block_size=self.block_size, num_blocks=self.num_blocks)
+        self.kvs = self._alloc_pools()
         # the pool pytree is donated each step/chunk: K/V writes land
         # in place in HBM, and capture_jit keeps the paged step inside
         # captured-step accounting exactly like the dense one
         self._decode = self._capture_jit(self._decode_impl,
                                          donate_argnums=(1,),
                                          name="serving.paged_decode",
-                                         warm={"program": "decode"})
+                                         warm={"program": "decode",
+                                               **self._warm_geo()})
         self._decode_collect = None
         self._prefills: Dict[int, object] = {}
         self._prefill_state: Dict[int, dict] = {}
+
+    def _warm_geo(self) -> Dict[str, object]:
+        return {"layout": "paged", "slots": self.max_slots,
+                "max_seq": self.max_seq, "block_size": self.block_size,
+                "num_blocks": self.num_blocks,
+                "chunk": self.prefill_chunk_len}
+
+    def reset_state(self) -> None:
+        """Crash-recovery reset over the block pool: every owned slot
+        is released as a counted EVICTION (its request is being
+        re-admitted or quarantined by the supervisor), staged prefills
+        are dropped, and the donated pool pytree is rebuilt as fresh
+        zeros. Compiled programs are kept — zero recompiles. An
+        attached draft resets in the same call (mirrored slots)."""
+        for s in range(self.max_slots):
+            self._kv.release(s, evicted=True)
+        self._prefill_state.clear()
+        self.pos[:] = 0
+        self.active[:] = False
+        self.last_ids[:] = 0
+        self.kvs = self._alloc_pools()
+        if self._draft is not None:
+            self._draft.reset_state()
 
     # -- device side --------------------------------------------------------
     def _write_kv(self, kvl, k, v, positions, tables, wmask):
@@ -1014,11 +1106,13 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
             draft._propose_impl, donate_argnums=(1,),
             name="serving.spec_draft",
             warm={"program": "spec_draft", "k": k,
-                  "draft_layers": draft.n_layers})
+                  "draft_layers": draft.n_layers,
+                  **self._warm_geo()})
         self._spec_verify = self._capture_jit(
             self._spec_verify_impl, donate_argnums=(1,),
             name="serving.spec_verify",
-            warm={"program": "spec_verify", "k": k})
+            warm={"program": "spec_verify", "k": k,
+                  **self._warm_geo()})
         return self
 
     def begin_request(self, slot: int, prompt_ids,
@@ -1066,13 +1160,20 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         st = self._prefill_state[slot]
         ids, start = st["ids"], st["next"]
         n = int(ids.shape[0])
-        c = min(self.prefill_chunk_len, n - start)
+        # _chunk_cap is the adaptive-admission brownout knob: under
+        # pressure the policy bounds each chunk (floor 8 = the
+        # smallest bucket) so prefill draws smaller slices of the
+        # step budget; None = the configured chunk length
+        limit = self.prefill_chunk_len if self._chunk_cap is None \
+            else max(8, min(self.prefill_chunk_len, self._chunk_cap))
+        c = min(limit, n - start)
         b = min(self._bucket(c), self.prefill_chunk_len)
         if b not in self._prefills:
             self._prefills[b] = self._capture_jit(
                 self._prefill_impl, donate_argnums=(1,),
                 name="serving.paged_prefill",
-                warm={"program": "prefill", "bucket": b})
+                warm={"program": "prefill", "bucket": b,
+                      **self._warm_geo()})
         padded = np.zeros((1, b), np.int32)
         padded[0, :c] = ids[start:start + c]
         row = jnp.asarray(self._kv.block_tables[slot])
@@ -1173,8 +1274,12 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         active slot has room for the whole verify window (a slot
         within ``spec_k`` tokens of capacity drops the batch to plain
         single-token steps for that iteration — correctness never
-        depends on the window fitting)."""
-        if self._draft is None:
+        depends on the window fitting). A brownout
+        (``_spec_suppressed``, set by the adaptive admission policy at
+        a step boundary) also drops to plain steps: under block
+        pressure the +spec_k window pre-extension is exactly the
+        block draw to shed first."""
+        if self._draft is None or self._spec_suppressed:
             return False
         act = [s for s in range(self.max_slots) if self.active[s]]
         if not act:
@@ -1318,15 +1423,44 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         if self._draft is not None:
             self._draft.release(slot, evicted=evicted)
 
-    def _prewarm_entry(self, entry) -> bool:
+    def _prewarm_entry(self, entry):
         """Paged warm-bundle replay: decode, prefill (per recorded
         bucket) and — with a draft attached — the speculative
         propose/verify pair, each rebuilt AOT over the live block-pool
         geometry (``lower().compile()`` = a persistent-cache disk
         read). Spec entries without a draft return False (skipped, not
-        failed): the bundle writer's topology simply doesn't apply."""
+        failed): the bundle writer's topology simply doesn't apply.
+        Entries recorded against a DIFFERENT serving geometry
+        (slots/blocks/chunk/spec_k — ``_bundle_stale``) return
+        ``"stale"``: replaying them would compile fresh programs at
+        boot while the counters claim warmth, so the caller counts
+        ``warmup.failures_total{reason=stale}`` and boots cold
+        instead."""
         meta = entry.get("meta") or {}
         prog = meta.get("program")
+        if prog in ("spec_draft", "spec_verify") and self._draft is None:
+            return False
+        if prog in ("decode", "prefill", "spec_draft", "spec_verify"):
+            # every paged program's shape depends on the POOL geometry;
+            # the prefill chunk is NOT part of any program shape — it
+            # only bounds which buckets are reachable, so a prefill
+            # entry is stale exactly when its recorded bucket exceeds
+            # the live chunk, and decode/spec entries ignore it
+            stale = self._bundle_stale(
+                meta, ("layout", "slots", "max_seq", "block_size",
+                       "num_blocks"))
+            if prog == "prefill" and isinstance(meta.get("bucket"),
+                                                int) \
+                    and meta["bucket"] > self.prefill_chunk_len:
+                stale.append("bucket")
+            if prog in ("spec_draft", "spec_verify") \
+                    and "k" in meta and meta["k"] != self._spec_k:
+                stale.append("k")
+            if stale:
+                _flight.record("warmup", "stale_entry",
+                               program=str(prog),
+                               mismatches=",".join(stale))
+                return "stale"
         S = self.max_slots
         # NumPy-backed helper args (device_put, no compiled fill
         # programs): pre-warm must never compile anything the bundle's
@@ -1342,10 +1476,15 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
             b = int(meta.get("bucket", 0) or
                     min(self._bucket(1), self.prefill_chunk_len))
             if b not in self._prefills:
+                # same warm meta as the prefill_chunk registration:
+                # a bundle RE-exported by this prewarmed replica must
+                # carry the geometry too, or its entries would bypass
+                # the freshness check downstream
                 self._prefills[b] = self._capture_jit(
                     self._prefill_impl, donate_argnums=(1,),
                     name="serving.paged_prefill",
-                    warm={"program": "prefill", "bucket": b})
+                    warm={"program": "prefill", "bucket": b,
+                          **self._warm_geo()})
             self._prefills[b]._jitted.lower(
                 self.params, self.kvs,
                 jnp.asarray(np.zeros((1, b), np.int32)),
@@ -1407,11 +1546,23 @@ class GenerationServer:
     ``req["out"]`` (and returning its KV blocks as counted evictions).
     ``shutdown()`` drains: new submissions are rejected immediately,
     in-flight and already-queued requests run to completion, then the
-    loop exits — no completed token is ever dropped by a shutdown."""
+    loop exits — no completed token is ever dropped by a shutdown.
+
+    Self-healing plane (``serving_supervisor``): admission routes
+    through a policy object (``policy=`` /
+    ``FLAGS_serving_admission_policy``) consulted at submit and fed
+    evidence at step boundaries, and the loop exports the supervision
+    seams — a heartbeat (``_beat``/``_idle``), an epoch fence (a
+    restarted loop's zombie predecessor exits without touching
+    state), and a BaseException boundary that journals the crash and
+    refreshes the gauges before the thread dies — so
+    ``serving_supervisor.supervise(server)`` can restart a crashed or
+    stalled loop and resume its in-flight streams bit-equal from
+    their committed tokens."""
 
     _STOP = object()  # queue sentinel: wake the loop for shutdown
 
-    def __init__(self, engine: LlamaDecodeEngine):
+    def __init__(self, engine: LlamaDecodeEngine, policy=None):
         self.engine = engine
         self._paged = bool(getattr(engine, "paged", False))
         self._q: "_queue.Queue" = _queue.Queue()
@@ -1420,6 +1571,8 @@ class GenerationServer:
         # _prefilling holds blocks and runs one prompt chunk per loop
         # iteration; _waiting holds admitted-order requests deferred
         # because the block pool couldn't cover their reservation yet
+        # (the supervisor also re-admits recovered requests through
+        # its head, so they precede anything newer)
         self._prefilling: Dict[int, dict] = {}
         self._waiting: List[dict] = []
         self._cancel_waiting = False  # set by shutdown(drain=False)
@@ -1427,8 +1580,22 @@ class GenerationServer:
         self.admitted = 0
         self.rejected = 0           # submissions after shutdown/shed
         self.shed = 0               # rejections by load-shedding alone
+        self.deadline_rejected = 0  # unmeetable-deadline rejections
         self.deadline_expired = 0   # requests failed by their deadline
         self.weight_swaps = 0       # hot-swaps applied by this loop
+        self.tokens_delivered = 0   # committed tokens (policy evidence)
+        self.loop_restarts = 0      # supervisor restarts of this loop
+        self.recovered = 0          # requests resumed after a crash
+        self.quarantined = 0        # poison requests failed, not retried
+        # admission policy: a ServingSupervisor-plane object consulted
+        # at submit time (admit_verdict) and fed evidence at step
+        # boundaries (on_step). Default (None) follows
+        # FLAGS_serving_admission_policy — "static" keeps the
+        # FLAGS_serving_shed_queue behavior as the fallback policy
+        if policy is None:
+            from .serving_supervisor import default_policy
+            policy = default_policy()
+        self.policy = policy
         self._stopping = threading.Event()
         self._drained = threading.Event()
         # orders submit's stopping-check+enqueue against shutdown's
@@ -1442,8 +1609,73 @@ class GenerationServer:
         # at its next step boundary (never mid-decode)
         self._swap_req = None
         self._metrics_server = None
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        # supervision plane: _epoch fences zombie loop threads (a
+        # stalled thread that wakes after a supervisor restart sees a
+        # newer epoch and exits without touching state), _beat is the
+        # loop heartbeat the stall watchdog reads, _idle marks the
+        # loop parked on the empty queue (not a stall)
+        self._epoch = 0
+        self._beat = time.monotonic()
+        self._idle = False
+        self._start_loop()
+
+    def _start_loop(self) -> None:
+        """Start (or, from the supervisor, RESTART) the decode-loop
+        thread. The crashed/crash-error markers reset so the
+        supervisor can tell this incarnation's death from the last
+        one's, and the heartbeat restarts NOW — a restarted loop must
+        not inherit the dead one's stale beat, or the stall watchdog
+        would re-fire before the new thread's first iteration."""
+        self._crashed = False
+        self._crash_error: Optional[BaseException] = None
+        self._beat = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serving-loop")
         self._thread.start()
+
+    def _fenced(self) -> bool:
+        """True on a ZOMBIE loop thread: one whose stamped epoch (set
+        at its loop entry) no longer matches the server's. Mutation
+        paths the loop calls into (_admit_one/_admit_paged/
+        _run_prefill) check this before touching request dicts or the
+        slot tables, so a stalled thread that wakes mid-recovery
+        cannot double-commit tokens or register stale slots beside
+        the replacement loop. Non-loop threads (tests driving admit
+        helpers directly) carry no stamp and are never fenced."""
+        my = getattr(threading.current_thread(),
+                     "_serving_loop_epoch", None)
+        return my is not None and my != self._epoch
+
+    def _run(self) -> None:
+        """Decode-loop thread body: the loop, plus the BaseException
+        boundary the satellite audit asked for — a KillPoint (or any
+        other escape ``except Exception`` must not swallow) still
+        kills this thread, but first the crash is journaled and the
+        gauges refreshed so ``queue_depth``/``in_flight`` read the
+        TRUE post-crash state (requests still holding slots/blocks)
+        instead of whatever the last completed step boundary wrote.
+        The re-raise keeps ``threading.excepthook`` crash forensics
+        (automatic flight dump) intact."""
+        try:
+            self._loop()
+        except BaseException as e:
+            self._crashed = True
+            self._crash_error = e
+            _flight.record("serving", "loop_crashed",
+                           error=type(e).__name__,
+                           in_flight=len(self._slots)
+                           + len(self._prefilling))
+            self._set_gauges()
+            raise
+
+    def _apply_brownout(self, spec_off: bool,
+                        chunk_cap: Optional[int]) -> None:
+        """Install the adaptive policy's brownout knobs on the engine
+        (step-boundary-safe: both only steer which ALREADY-COMPILED
+        program the next iteration picks)."""
+        eng = self.engine
+        eng._spec_suppressed = bool(spec_off)
+        eng._chunk_cap = chunk_cap
 
     def metrics_endpoint(self, port: int = 0, host: str = "127.0.0.1"):
         """Serve the process metrics registry over HTTP: ``GET /metrics``
@@ -1480,26 +1712,41 @@ class GenerationServer:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
                 f"(prefill always produces the first token)")
-        if self._shed():
-            self.shed += 1
-            self.rejected += 1
-            _M_shed.inc()
-            _M_rejected.inc()
-            _flight.record("serving", "rejected", trace_id=trace_id,
-                           reason="shed",
-                           waiting=len(self._waiting),
-                           blocks_available=self.engine._kv
-                           .available_blocks())
-            raise RuntimeError(
-                f"request shed: KV block pool exhausted and "
-                f"{len(self._waiting)} requests already deferred "
-                f"(over FLAGS_serving_shed_queue) — retry later or "
-                f"raise FLAGS_serving_num_blocks")
         if deadline is not None and deadline <= 0:
             _flight.record("serving", "rejected", trace_id=trace_id,
                            reason="invalid_deadline")
             raise ValueError(f"deadline must be > 0, got {deadline}")
-        req = {"prompt": np.asarray(prompt_ids, np.int32).reshape(-1),
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        # the admission policy decides here, on submit's thread, from
+        # evidence the loop refreshed at its last step boundary:
+        # "shed" (hard overload) or "deadline" (the request could not
+        # finish in time at the observed rate — rejecting NOW spares
+        # its blocks AND the caller's wait)
+        verdict = self.policy.admit_verdict(
+            self, int(prompt.shape[0]), int(max_new_tokens), deadline)
+        if verdict is not None:
+            self.rejected += 1
+            _M_rejected.inc()
+            if verdict == "deadline":
+                self.deadline_rejected += 1
+                _M_deadline_rej.inc()
+            else:
+                self.shed += 1
+                _M_shed.inc()
+            _flight.record("serving", "rejected", trace_id=trace_id,
+                           reason=verdict,
+                           policy=self.policy.name,
+                           waiting=len(self._waiting))
+            raise RuntimeError(
+                f"request rejected by the {self.policy.name} admission "
+                f"policy (reason={verdict}): "
+                + ("its deadline cannot be met at the observed decode "
+                   "rate — retry with a larger deadline or fewer "
+                   "tokens" if verdict == "deadline" else
+                   "the replica is overloaded (KV blocks exhausted "
+                   "with a deferred backlog) — retry later or raise "
+                   "FLAGS_serving_num_blocks"))
+        req = {"prompt": prompt,
                "max_new": int(max_new_tokens), "out": [],
                "done": threading.Event(), "error": None,
                "trace_id": trace_id,
@@ -1553,8 +1800,9 @@ class GenerationServer:
             source = load_checkpoint(source)
         return extract_state_dict(source)
 
-    def swap_weights(self, checkpoint_or_state,
-                     timeout: Optional[float] = 300.0) -> dict:
+    def swap_weights(self, checkpoint_or_state=None,
+                     timeout: Optional[float] = 300.0, *,
+                     prepared=None) -> dict:
         """Zero-downtime weight hot-swap: install new weights into the
         running engine BETWEEN decode steps, without dropping or
         corrupting any in-flight request — their KV blocks and partial
@@ -1573,15 +1821,23 @@ class GenerationServer:
         in ``serving.weight_swaps_rejected_total``). Returns swap
         stats (``seconds``, ``in_flight`` at the boundary, ...). A
         timeout clears the request if the loop has not yet claimed
-        it, so a later swap can be submitted."""
-        sd = self._swap_state(checkpoint_or_state)
-        try:
-            prepped = self.engine.prepare_swap(sd)
-        except Exception:
-            _M_swap_rejected.inc()
-            _flight.record("serving", "swap_end", ok=False,
-                           error="prepare")
-            raise
+        it, so a later swap can be submitted.
+
+        ``prepared=`` bypasses the prep: a device tree already in the
+        engine's layout (``prepare_swap``'s output — or a RETAINED
+        pre-swap ``engine.params``, which is how the canary rollout
+        rolls a bad checkpoint back without re-reading disk)."""
+        if prepared is not None:
+            prepped = prepared
+        else:
+            sd = self._swap_state(checkpoint_or_state)
+            try:
+                prepped = self.engine.prepare_swap(sd)
+            except Exception:
+                _M_swap_rejected.inc()
+                _flight.record("serving", "swap_end", ok=False,
+                               error="prepare")
+                raise
         done = threading.Event()
         slot: dict = {}
         with self._submit_lock:
@@ -1611,8 +1867,9 @@ class GenerationServer:
         return slot["result"]
 
     def _shed(self) -> bool:
-        """Load-shedding policy (ROADMAP 1c), evaluated at submit
-        time on the evidence the paged pool already exports: shed
+        """The STATIC load-shedding rule (ROADMAP 1c) — now the
+        fallback policy behind ``serving_supervisor.StaticShedPolicy``
+        (the default) and the adaptive policy's floor: shed
         when admission is block-starved (``serving.blocks_free`` at
         zero AND a request is already deferred on blocks — the
         signal that queue_seconds is about to climb) and the waiting
@@ -1681,14 +1938,23 @@ class GenerationServer:
         # submit->admission wait and decode_seconds covers prefill +
         # decode (slow prefill must not masquerade as queueing — the
         # load-shedding signal would point at admission when the real
-        # cost is the model)
+        # cost is the model). t_queue0 rebases the origin for
+        # crash-recovered requests: their pre-crash DECODE time is
+        # not admission starvation
         req["t_admit"] = time.monotonic()
-        _M_queue_s.observe(req["t_admit"] - req["t0"])
+        _M_queue_s.observe(req["t_admit"] - req.get("t_queue0",
+                                                    req["t0"]))
         try:
             first = eng.prefill(slot, req["prompt"])
         except Exception as e:  # noqa: BLE001 — surfaced per request
+            if self._fenced():
+                return  # zombie: the request was already re-admitted
             self._fail(req, e)
             return
+        if self._fenced():
+            return  # zombie woke from a wedged prefill: the new loop
+            # owns this request — committing here would duplicate its
+            # stream and register a stale slot
         req["out"].append(first)
         self._slots[slot] = req
         self.admitted += 1
@@ -1718,7 +1984,8 @@ class GenerationServer:
         cover the reservation yet — exhaustion queues, never
         crashes) or 'dropped' (sentinel/expired/failed)."""
         eng = self.engine
-        if req is self._STOP or req["done"].is_set():
+        if req is self._STOP or req["done"].is_set() \
+                or self._fenced():
             return "dropped"
         if self._expired(req):
             self.deadline_expired += 1
@@ -1727,14 +1994,23 @@ class GenerationServer:
                 "request deadline expired while queued"))
             return "dropped"
         try:
-            ok = eng.begin_request(slot, req["prompt"], req["max_new"])
+            # budget = REMAINING tokens: a crash-recovered request
+            # re-admits with prompt + committed tokens as its prompt,
+            # so reserving the full max_new again would over-draw the
+            # pool for work already delivered (fresh requests have
+            # empty out — identical behavior)
+            ok = eng.begin_request(
+                slot, req["prompt"],
+                max(req["max_new"] - len(req["out"]), 1))
         except Exception as e:  # noqa: BLE001 — surfaced per request
             self._fail(req, e)
             return "dropped"
         if not ok:
             return "defer"
         req["t_admit"] = time.monotonic()
-        _M_queue_s.observe(req["t_admit"] - req["t0"])
+        # t_queue0 = recovery rebase (see _admit_one)
+        _M_queue_s.observe(req["t_admit"] - req.get("t_queue0",
+                                                    req["t0"]))
         self._prefilling[slot] = req
         self.admitted += 1
         _M_admitted.inc()
@@ -1745,6 +2021,17 @@ class GenerationServer:
     def _admit(self):
         if not self._paged:
             free = self._free_slots()
+            # supervisor-recovered requests land in _waiting (dense
+            # engines never defer on blocks, so this list is otherwise
+            # empty): admit them ahead of the queue, oldest first
+            while free and self._waiting:
+                req = self._waiting.pop(0)
+                if req["done"].is_set():
+                    continue
+                self._admit_one(req, free[0])
+                if req["done"].is_set() and req["error"] is not None:
+                    continue  # rejected before prefill: slot still free
+                free.pop(0)
             while free:
                 try:
                     req = self._q.get_nowait()
@@ -1812,10 +2099,15 @@ class GenerationServer:
             try:
                 first = self.engine.prefill_chunk(slot)
             except Exception as e:  # noqa: BLE001 — per-request
+                if self._fenced():
+                    return  # zombie: recovery owns the request now
                 del self._prefilling[slot]
                 self._release_slot(slot, evicted=True)
                 self._fail(req, e)
                 return
+            if self._fenced():
+                return  # zombie woke from a wedged chunk: commit
+                # nothing — the new loop re-admitted this request
             if first is not None:
                 del self._prefilling[slot]
                 req["out"].append(first)
@@ -1935,7 +2227,18 @@ class GenerationServer:
         done.set()
 
     def _loop(self):
+        # the epoch captured here fences THIS incarnation: after a
+        # supervisor restart (crash or stall), a zombie of the old
+        # loop that wakes up sees a newer epoch and exits without
+        # touching slots, engine state, or the queue (the thread
+        # stamp lets the admit/prefill helpers check the same fence
+        # from inside a call the zombie was wedged in)
+        my_epoch = self._epoch
+        threading.current_thread()._serving_loop_epoch = my_epoch
         while True:
+            if self._epoch != my_epoch:
+                return  # fenced: a supervisor replaced this loop
+            self._beat = time.monotonic()  # stall-watchdog heartbeat
             try:
                 self._apply_pending_swap()
                 self._admit()
@@ -1948,6 +2251,7 @@ class GenerationServer:
                         self._expire_active()
                         self._expire_queued()
                         self._set_gauges()
+                        self.policy.on_step(self)
                         continue
                     if self._stopping.is_set() and self._q.empty():
                         break  # drained: nothing active, nothing queued
@@ -1955,7 +2259,17 @@ class GenerationServer:
                     # DIRECTLY — a get-then-requeue would let requests
                     # submitted in the window jump ahead of it (FIFO)
                     self._set_gauges()  # idle: a scrape must read 0
-                    req = self._q.get()
+                    self._idle = True   # parked, not stalled
+                    try:
+                        req = self._q.get()
+                    finally:
+                        self._idle = False
+                    if self._epoch != my_epoch:
+                        # fenced while parked: the request belongs to
+                        # the NEW loop — hand it back and exit
+                        if req is not self._STOP:
+                            self._q.put(req)
+                        return
                     if req is self._STOP:
                         continue
                     if self._paged:
@@ -1985,10 +2299,14 @@ class GenerationServer:
                     # same commit loop
                     toks = eng.step()[:, None]
                     counts = np.ones(eng.max_slots, np.int32)
+                if self._epoch != my_epoch:
+                    return  # fenced mid-step (stall restart): the new
+                    # loop owns the slots — do not commit or fail
                 self.steps_run += 1
                 _M_steps.inc()
                 for slot in list(self._slots):
                     req = self._slots[slot]
+                    before = len(req["out"])
                     for j in range(int(counts[slot])):
                         tok = int(toks[slot, j])
                         req["out"].append(tok)
@@ -1997,6 +2315,7 @@ class GenerationServer:
                         if eng.eos_id is not None \
                                 and tok == eng.eos_id:
                             break
+                    self.tokens_delivered += len(req["out"]) - before
                     _flight.record("serving", "decode",
                                    trace_id=req.get("trace_id"),
                                    step=self.steps_run,
@@ -2008,7 +2327,15 @@ class GenerationServer:
                 # between steps must not report finished requests as
                 # in-flight
                 self._set_gauges()
+                # step boundary: feed the admission policy its
+                # evidence (EWMAs of blocks/backlog/throughput) and
+                # let it move brownout/shed levels
+                self.policy.on_step(self)
             except Exception as e:  # noqa: BLE001 — fail loudly, stay up
+                if self._epoch != my_epoch:
+                    return  # fenced: the slots hold RE-ADMITTED
+                    # requests now — failing them here would double
+                    # their terminal events
                 _flight.record("serving", "loop_error",
                                error=type(e).__name__)
                 for slot, req in list(self._slots.items()):
@@ -2092,8 +2419,14 @@ class GenerationServer:
                          and not r["done"].is_set())
         out = {"steps_run": self.steps_run, "admitted": self.admitted,
                "rejected": self.rejected, "shed": self.shed,
+               "deadline_rejected": self.deadline_rejected,
                "deadline_expired": self.deadline_expired,
                "weight_swaps": self.weight_swaps,
+               "tokens_delivered": self.tokens_delivered,
+               "loop_restarts": self.loop_restarts,
+               "recovered": self.recovered,
+               "quarantined": self.quarantined,
+               "crashed": int(self._crashed),
                "in_flight": len(self._slots), "queued": queued,
                "prefilling": len(self._prefilling),
                "waiting_for_blocks": len(self._waiting),

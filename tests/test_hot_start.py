@@ -309,6 +309,95 @@ class TestBundleFaults:
         # the engine still serves (cold) after the failed pre-warm
         assert len(eng.generate([1, 2], max_new_tokens=3)) == 3
 
+    def test_stale_geometry_bundle_degrades_counted(self):
+        """Freshness check (ISSUE 15): a bundle recorded by a replica
+        with a DIFFERENT serving geometry must not be silently
+        replayed — its entries would compile fresh programs at boot
+        while the counters claim warmth. Every serving entry fails as
+        reason=stale and the engine still boots (cold)."""
+        warmup.clear_recorded()
+        mA = _model_a()
+        eng = PagedLlamaDecodeEngine(mA, **GEO)
+        eng.generate([1, 2, 3], max_new_tokens=4)
+        bundle = warmup.load_bundle(warmup.export_bundle())
+        n_serving = sum(1 for e in bundle["entries"]
+                        if e["kind"] == "serving")
+        assert n_serving >= 2  # decode + >= 1 prefill bucket
+        # entries carry the recording geometry
+        metas = [e["meta"] for e in bundle["entries"]
+                 if e["kind"] == "serving"]
+        assert all(m["layout"] == "paged"
+                   and m["block_size"] == GEO["block_size"]
+                   for m in metas)
+        other = PagedLlamaDecodeEngine(mA, max_slots=2, max_seq=128,
+                                      block_size=16, prefill_chunk=8)
+        before = self._reason_count("stale")
+        out = warmup.prewarm(bundle, engine=other)
+        assert out["programs"] == 0
+        assert out["failures"] == n_serving
+        assert self._reason_count("stale") == before + n_serving
+        # the MATCHING geometry still replays everything
+        twin = PagedLlamaDecodeEngine(mA, **GEO)
+        out2 = warmup.prewarm(bundle, engine=twin)
+        assert out2["programs"] >= 2 and out2["failures"] == 0
+        # precision: a replica differing ONLY in prefill chunk keeps
+        # all its warmth — no program's shape depends on the chunk
+        # (recorded buckets still fit under the larger live chunk)
+        chunky = PagedLlamaDecodeEngine(mA, max_slots=2, max_seq=128,
+                                        block_size=8, prefill_chunk=16)
+        out3 = warmup.prewarm(bundle, engine=chunky)
+        assert out3["programs"] >= 2 and out3["failures"] == 0
+
+    def test_dense_vs_paged_layout_is_stale(self):
+        """A paged replica's bundle into a dense engine (or vice
+        versa) is a LAYOUT mismatch, not warmth."""
+        from paddle_tpu.serving import LlamaDecodeEngine
+        warmup.clear_recorded()
+        mA = _model_a()
+        dense = LlamaDecodeEngine(mA, max_slots=2, max_seq=128)
+        dense.generate([1, 2], max_new_tokens=3)
+        bundle = warmup.load_bundle(warmup.export_bundle())
+        paged = PagedLlamaDecodeEngine(mA, **GEO)
+        before = self._reason_count("stale")
+        out = warmup.prewarm(bundle, engine=paged)
+        assert out["programs"] == 0
+        assert self._reason_count("stale") > before
+
+
+# ---------------------------------------------------------------------------
+# cache-dir GC by last-hit age
+# ---------------------------------------------------------------------------
+
+class TestCacheDirGC:
+    def test_evicts_by_last_hit_age_only(self, tmp_path):
+        """Old cache artifacts age out (counted); fresh entries,
+        warm-bundle manifests and subdirectories are never touched."""
+        from paddle_tpu.jit.warmup import _M_evicted
+        d = tmp_path / "xla_cache"
+        d.mkdir()
+        old = d / "jit__decode-abc123"
+        old.write_bytes(b"stale artifact")
+        stamp = time.time() - 3 * 86400
+        os.utime(old, (stamp, stamp))
+        fresh = d / "jit__prefill-def456"
+        fresh.write_bytes(b"fresh artifact")
+        manifest = d / "warm_bundle.json"
+        manifest.write_text("{}")
+        os.utime(manifest, (stamp, stamp))  # old but a manifest
+        sub = d / "subdir"
+        sub.mkdir()
+        before = _M_evicted.value()
+        assert warmup.gc_cache_dir(max_age_days=1,
+                                   directory=str(d)) == 1
+        assert not old.exists()
+        assert fresh.exists() and manifest.exists() and sub.exists()
+        assert _M_evicted.value() == before + 1
+        # disabled (the flag default) is a no-op
+        assert warmup.gc_cache_dir(max_age_days=0,
+                                   directory=str(d)) == 0
+        assert warmup.gc_cache_dir(directory=str(d)) == 0
+        assert fresh.exists()
+
 
 # ---------------------------------------------------------------------------
 # zero-downtime weight swap
