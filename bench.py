@@ -478,6 +478,108 @@ def bench_llama_decode_paged():
                "KV HBM"})
 
 
+def bench_prefix_sharing_kv():
+    """Prefix-sharing KV cache vs the unshared allocator (ISSUE 16):
+    64 requests sharing a 256-token prefix (16 blocks at block_size
+    16) with 4 unique tail tokens each, served both ways. Three bars:
+    streams BIT-equal to the unshared oracle (sharing must be
+    invisible in the tokens), served tokens/s >= 1.5x (aliased
+    admissions skip 256 of 260 prefill tokens), and admitted slots
+    >= 2x on a fixed pool (a shared block is charged once however
+    many slots alias it)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import PagedLlamaDecodeEngine
+
+    cfg = LlamaConfig.tiny()
+    cfg.dtype = "float32"
+    n_req, prefix_len, tail_len, new_tok = 64, 256, 4, 8
+    bs, max_seq = 16, 320
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, cfg.vocab_size, (prefix_len,)).tolist()
+    warm_prefix = rng.integers(0, cfg.vocab_size,
+                               (prefix_len,)).tolist()
+    prompts = [prefix + rng.integers(
+        0, cfg.vocab_size, (tail_len,)).tolist() for _ in range(n_req)]
+
+    def build(prefix_cache_on, slots, num_blocks=0):
+        prev = paddle.get_flags(["FLAGS_serving_prefix_cache"])
+        paddle.set_flags(
+            {"FLAGS_serving_prefix_cache": int(prefix_cache_on)})
+        try:
+            return PagedLlamaDecodeEngine(
+                model, max_slots=slots, max_seq=max_seq,
+                block_size=bs, num_blocks=num_blocks,
+                prefill_chunk=64)
+        finally:
+            paddle.set_flags(prev)
+
+    def serve_all(eng):
+        """Sequential single-slot serve of every request (prefill +
+        decode + release) — the wall clock covers the whole request
+        lifecycle, which is where prefix reuse pays."""
+        eng.generate(warm_prefix + [1] * tail_len,
+                     max_new_tokens=new_tok)     # warm both buckets
+        streams = []
+        t0 = time.perf_counter()
+        for p in prompts:
+            streams.append(eng.generate(p, max_new_tokens=new_tok))
+        dt = time.perf_counter() - t0
+        return streams, n_req * new_tok / dt
+
+    off_streams, off_tok_s = serve_all(build(False, slots=2))
+    on_eng = build(True, slots=2)
+    on_streams, on_tok_s = serve_all(on_eng)
+    assert on_streams == off_streams, (
+        "prefix-shared streams diverge from the unshared oracle")
+    st = on_eng._kv.stats()
+    assert st["prefix_hits"] >= n_req - 1, st
+    speedup = on_tok_s / max(off_tok_s, 1e-9)
+
+    # -- admissions on a FIXED pool: shared blocks charge once ----------
+    pool = 64                     # unshared: 17 blocks/request -> 3 fit
+    probe_off = build(False, slots=n_req, num_blocks=pool)
+    admitted_off = 0
+    for s in range(n_req):
+        if not probe_off.begin_request(s, prompts[s], new_tok):
+            break
+        admitted_off += 1
+    probe_on = build(True, slots=n_req, num_blocks=pool)
+    probe_on.prefill(0, prompts[0], budget=new_tok)  # seed the tree
+    admitted_on = 1
+    for s in range(1, n_req):
+        if not probe_on.begin_request(s, prompts[s], new_tok):
+            break
+        admitted_on += 1
+    ratio_adm = admitted_on / max(admitted_off, 1)
+
+    _emit("prefix_sharing_kv", speedup, "x", speedup / 1.5, {
+        "requests": n_req, "prefix_tokens": prefix_len,
+        "tail_tokens": tail_len, "new_tokens": new_tok,
+        "block_size": bs,
+        "tokens_per_sec_shared": round(on_tok_s, 1),
+        "tokens_per_sec_unshared": round(off_tok_s, 1),
+        "prefix_hits": st["prefix_hits"],
+        "prefix_tokens_reused": st["prefix_tokens_reused"],
+        "pool_blocks": pool,
+        "admitted_shared": admitted_on,
+        "admitted_unshared": admitted_off,
+        "admitted_ratio": round(ratio_adm, 2),
+        "streams_bit_equal": True,
+        "bar": ">=1.5x tokens/s AND >=2x admitted slots vs "
+               "FLAGS_serving_prefix_cache=0, streams bit-equal",
+        "backend": jax.default_backend()})
+    assert speedup >= 1.5, (
+        f"prefix sharing served only {speedup:.2f}x the unshared "
+        f"tokens/s ({on_tok_s:.1f} vs {off_tok_s:.1f})")
+    assert ratio_adm >= 2.0, (
+        f"prefix sharing admitted only {admitted_on} slots vs "
+        f"{admitted_off} unshared on a {pool}-block pool")
+
+
 def bench_llama_decode_speculative():
     """Speculative paged decode vs plain paged decode, same geometry
     (ISSUE 12). The draft is the truncated-layer view with the
@@ -2004,6 +2106,7 @@ _SUITE = [
     ("bench_moe_dispatch", "bench_moe_dispatch"),
     ("bench_llama_decode", "bench_llama_decode"),
     ("llama_decode_paged_tokens_per_sec", "bench_llama_decode_paged"),
+    ("prefix_sharing_kv", "bench_prefix_sharing_kv"),
     ("llama_decode_speculative_tokens_per_sec",
      "bench_llama_decode_speculative"),
     ("paged_attention_paths", "bench_paged_attention_paths"),

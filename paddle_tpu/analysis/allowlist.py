@@ -152,6 +152,16 @@ CAPTURE_ALLOWLIST = [
      "through the normal prefill path) all advance while NO captured "
      "program is in flight — the dead loop is fenced first, the new "
      "loop replays the same pure compiled programs after"),
+    # -- prefix-sharing KV (ISSUE 16): precise row first, same
+    #    pattern as the hot-start/self-healing rows above ------------
+    ("PTC002", "*`self.prefix_hit_tokens` inside the step*",
+     "prefix-sharing admission bookkeeping: the radix-tree match, "
+     "block aliasing and refcount bumps all run host-side in the "
+     "allocator at admission — the capture boundary BY DESIGN; the "
+     "captured prefill/decode programs see only the resulting block "
+     "tables, and the one device-side effect (cloning the shared "
+     "boundary block before its first write) is its own tiny jitted "
+     "copy program (serving.prefix_cow), dispatched between steps"),
     ("PTC002", "paddle_tpu/serving.py*",
      "slot/block bookkeeping (pos/last_ids/active, block-table "
      "extension, prefill staging, speculative accept/rollback — "

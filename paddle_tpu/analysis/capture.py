@@ -684,6 +684,14 @@ REPO_STEPS: List[Tuple[str, str, Tuple[str, ...]]] = [
      ()),
     ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine.prefill_chunk",
      ()),
+    # prefix-sharing admission (ISSUE 16): the radix match/alias/COW
+    # decision runs host-side at admission — begin_request is the
+    # capture boundary, _device_cow dispatches the one jitted
+    # boundary-block copy program
+    ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine.begin_request",
+     ()),
+    ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine._device_cow",
+     ()),
     ("paddle_tpu/serving.py", "PagedLlamaDecodeEngine._propose_impl",
      ("params", "kv", "last_ids", "pos", "tables", "act")),
     ("paddle_tpu/serving.py",

@@ -13,7 +13,11 @@ interpreter:
   ``elementwise`` (shape and dtype preserved), ``broadcast`` (numpy
   broadcasting + dtype promotion), ``reduce`` (axis/keepdim/optional
   dtype attrs), ``matmul`` / ``linear`` (contraction arithmetic),
-  ``cast`` (dtype from attrs). :func:`abstract_eval` evaluates one op.
+  ``cast`` (dtype from attrs), ``attention`` (q/k/v ``[B, S, H, D]``
+  — the output follows the QUERY aval; flash_attention /
+  flash_attention_segmented / ring_attention, so a transformer step
+  plans through its attention instead of treating it as an opaque
+  boundary). :func:`abstract_eval` evaluates one op.
 - **Golden-run validation** — :func:`validate_specs` grades every
   declared spec against the LIVE fusion impl
   (``core.fusion.infer_output_aval`` — ``jax.eval_shape`` of the
@@ -208,6 +212,31 @@ def _cast_eval(avals, attrs):
     return AVal(avals[0].shape, np.dtype(kw["dtype"]))
 
 
+def _attention_eval(avals, attrs):
+    """attention: q/k/v ``[B, S, H, D]`` (plus optional integer
+    segment ids ``[B, S]`` — the varlen-packing variant). The output
+    follows the QUERY aval exactly: same shape, same dtype (the
+    in-tree kernels take uniform q/k/v dtypes and cast the context
+    product back to the query's). KV length may differ from the query
+    length (cache decode verifies short queries over long keys)."""
+    if len(avals) not in (3, 4):
+        return None
+    q, k, v = avals[0], avals[1], avals[2]
+    if len(q.shape) != 4 or k.shape != v.shape or len(k.shape) != 4:
+        return None
+    # batch, heads and head_dim must agree; only the sequence axis may
+    # differ between query and key/value
+    if (q.shape[0], q.shape[2], q.shape[3]) != \
+            (k.shape[0], k.shape[2], k.shape[3]):
+        return None
+    if len(avals) == 4:
+        seg = avals[3]
+        if seg.dtype.kind not in "iu" or \
+                seg.shape != (q.shape[0], q.shape[1]):
+            return None
+    return AVal(q.shape, q.dtype)
+
+
 _EVALUATORS = {
     "elementwise": _ew_eval,
     "broadcast": _bcast_eval,
@@ -215,6 +244,7 @@ _EVALUATORS = {
     "matmul": _matmul_eval,
     "linear": _linear_eval,
     "cast": _cast_eval,
+    "attention": _attention_eval,
 }
 
 
@@ -293,6 +323,23 @@ def _sample_cases(op: str, spec: str) -> List[Tuple[list, Any]]:
         return [([((3, 4), _F32)], (("dtype", _BF16),)),
                 ([((2,), _BF16)], (("dtype", _F32),)),
                 ([((3,), _F32)], (("dtype", np.dtype("int32")),))]
+    if spec == "attention":
+        # uniform q/k/v dtypes (the kernel contract); graded through
+        # the registered parametric impls (the real entry points)
+        _I32 = np.dtype("int32")
+        if op == "flash_attention_segmented":
+            return [([((2, 8, 4, 16), _F32)] * 3 + [((2, 8), _I32)],
+                     ()),
+                    ([((1, 16, 2, 8), _BF16)] * 3 + [((1, 16), _I32)],
+                     ())]
+        cases = [([((2, 8, 4, 16), _F32)] * 3, ()),
+                 ([((1, 16, 2, 8), _BF16)] * 3, ())]
+        if op == "flash_attention":
+            # cache-decode geometry: 1 query row over a longer KV
+            cases.append(([((2, 1, 4, 16), _F32),
+                           ((2, 8, 4, 16), _F32),
+                           ((2, 8, 4, 16), _F32)], ()))
+        return cases
     return []
 
 

@@ -219,6 +219,22 @@ define_flag("serving_supervisor_stall_seconds", 0.0,
             "holding work is fenced and restarted like a crash (0 = "
             "stall detection off; an idle loop parked on the empty "
             "queue never counts as stalled)")
+define_flag("serving_prefix_cache", True,
+            "Content-addressed prefix sharing in the paged serving KV "
+            "cache: committed prompt blocks enter a host-side radix "
+            "tree keyed by their token ids, admission matches new "
+            "prompts against it at block granularity, matched blocks "
+            "are aliased into the slot's table with refcount bumps and "
+            "their prefill is SKIPPED. Released prefixes stay cached "
+            "(refcount 0) and are LRU-evicted under pool pressure. "
+            "0 = kill switch: the allocator behaves byte-identically "
+            "to the private-blocks-only design")
+define_flag("serving_prefix_cache_blocks", 0,
+            "Upper bound on KV blocks the prefix radix tree may hold "
+            "(shared + cached); committing past the bound evicts "
+            "refcount-0 LRU entries first and stops caching when "
+            "nothing is evictable. 0 (default) = unbounded within the "
+            "pool — the free-list/LRU pressure path is the only limit")
 define_flag("serving_shed_queue", 0,
             "Load-shedding queue bound for the paged GenerationServer: "
             "when the KV block pool has no available blocks AND more "

@@ -102,3 +102,21 @@ def ring_self_attention(q, k, v, mesh, axis: str = "sp", causal: bool = True,
     return apply_op(
         lambda a, b, c: ring_attention(a, b, c, mesh, axis, causal, scale),
         q, k, v, op_name="ring_attention")
+
+
+# analysis-plane aval registration (the flash_attention pattern, see
+# ops/pallas/flash_attention.py): ring attention computes EXACT causal
+# attention — the ring is a memory/comm schedule, not a different
+# function — so its aval reference is the sdpa oracle cast back to the
+# query dtype, exactly what the sharded entry point returns.
+def _ring_attention_aval_ref(q, k, v):
+    from ..ops.pallas.flash_attention import _sdpa_xla
+    return _sdpa_xla(q, k, v, causal=True).astype(q.dtype)
+
+
+def _register_aval_impls() -> None:
+    from ..core.fusion import register_param_impl
+    register_param_impl("ring_attention", _ring_attention_aval_ref)
+
+
+_register_aval_impls()

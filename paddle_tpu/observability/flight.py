@@ -23,7 +23,12 @@ and the per-request serving lifecycle (submit → queued → admitted →
 ``trace_id``), plus the paged KV block pool's allocator
 (``block_alloc`` / ``block_free`` / ``block_exhausted`` — a pool
 running dry reads straight out of a dump next to the starved
-requests' queue time), the hot-start plane (``warmup`` category:
+requests' queue time) and its prefix-sharing radix cache
+(``prefix_hit`` with the tokens a request's admission skipped,
+``prefix_cow`` for each boundary-block copy-on-write clone,
+``prefix_evict`` when LRU pressure reclaims a cached prefix block —
+how much prefill the tree absorbed, and what it cost, per request),
+the hot-start plane (``warmup`` category:
 cache_configured / bundle_exported / bundle_failed-by-reason /
 prewarm summary / per-program captured_step+serving_program replays
 — a boot that compiled fresh instead of hitting the executable cache

@@ -103,7 +103,7 @@ def _load_yaml() -> list:
         return _parse_yaml_fallback(text)
 
 
-_FUSABLE_CLASSES = (False, True, "reduce", "epilogue")
+_FUSABLE_CLASSES = (False, True, "reduce", "epilogue", "attention")
 
 # The shape-spec vocabulary for the analysis plane's abstract
 # interpreter (analysis/shapes.py declares one evaluator per id and
@@ -112,7 +112,7 @@ _FUSABLE_CLASSES = (False, True, "reduce", "epilogue")
 # import-light, loaded with the table — so a typo'd spec fails at
 # import, not at the first capture plan.
 SHAPE_SPECS = ("elementwise", "broadcast", "reduce", "matmul", "linear",
-               "cast")
+               "cast", "attention")
 
 
 def _norm_fusable(name: str, v):
@@ -165,8 +165,11 @@ def _register_all():
             # lazy-eager fusion class (core/fusion.py): False (not
             # fusable), True (elementwise chain member), "reduce"
             # (reduction terminator), "epilogue" (contraction/epilogue
-            # host). Python-mirror-only — the native descriptor layout
-            # predates the field
+            # host), "attention" (analysis-plane-only: the eager DAG
+            # never defers it, but the capture planner's abstract
+            # interpreter reads its shape spec instead of treating
+            # attention as an opaque boundary). Python-mirror-only —
+            # the native descriptor layout predates the field
             "fusable": _norm_fusable(name, entry.get("fusable", False)),
         }
         # analysis-plane shape/dtype spec (see SHAPE_SPECS above):

@@ -867,3 +867,19 @@ def _flash_seg_vjp_bwd(causal, scale, residuals, g):
 
 
 flash_attention_segmented.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)
+
+
+# analysis-plane aval registration (ops.yaml `fusable: attention` +
+# `shape: attention`): the eager fusion DAG never defers attention —
+# try_fuse returns None for the class — but the capture planner's
+# abstract interpreter grades its `shape:` spec against these REAL
+# entry points via jax.eval_shape (core.fusion.infer_output_aval), so
+# the declared arithmetic can't drift from what actually runs.
+def _register_aval_impls() -> None:
+    from ...core.fusion import register_param_impl
+    register_param_impl("flash_attention", flash_attention)
+    register_param_impl("flash_attention_segmented",
+                        flash_attention_segmented)
+
+
+_register_aval_impls()

@@ -152,6 +152,19 @@ _M_pa_fallback = _M.counter(
     "paged_attention_fallback_steps_total",
     "Engine steps whose attention ran the pure-jnp tile walk (the "
     "CPU/oracle fallback)")
+# content-addressed prefix sharing (PagedKVCache radix tree):
+# hits/reuse counted at TARGET admission only — an attached draft
+# mirrors every admission, so counting both engines would double
+# every hit (draft engines run with _prefix_metrics = False)
+_M_prefix_hits = _M.counter(
+    "prefix_hits_total",
+    "Paged admissions whose prompt matched a cached prefix in the "
+    "radix tree: matched blocks aliased with refcount bumps, their "
+    "prefill skipped")
+_M_prefix_reused = _M.counter(
+    "prefix_tokens_reused_total",
+    "Prompt tokens served from shared prefix blocks instead of "
+    "being re-prefilled (the prefill work the radix cache saved)")
 
 # process-unique request trace ids: every lifecycle event of a request
 # carries one, so a flight dump (or GenerationServer.trace) replays a
@@ -787,6 +800,9 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
     """
 
     paged = True
+    # process-registry prefix metrics are target-engine only; an
+    # attached draft mirrors every admission (attach_draft flips this)
+    _prefix_metrics = True
 
     def __init__(self, model, max_slots: int = 4, max_seq: int = 256,
                  int8: bool = False, eos_id: Optional[int] = None,
@@ -854,6 +870,11 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         self._decode_collect = None
         self._prefills: Dict[int, object] = {}
         self._prefill_state: Dict[int, dict] = {}
+        # prefix-sharing state: the boundary copy-on-write program is
+        # built lazily (first block-aligned hit), per-request hit
+        # accounting feeds the server's req["prefix_hit_tokens"]
+        self._cow = None
+        self.prefix_hit_tokens: Dict[int, int] = {}
 
     def _warm_geo(self) -> Dict[str, object]:
         return {"layout": "paged", "slots": self.max_slots,
@@ -870,6 +891,12 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         attached draft resets in the same call (mirrored slots)."""
         for s in range(self.max_slots):
             self._kv.release(s, evicted=True)
+        # the pool pytree is about to be rebuilt as ZEROS: every
+        # cached radix node's block content dies with it, so the tree
+        # must empty in the same breath (releasing all slots above
+        # drove every refcount to 0 — reset cannot throw here)
+        self._kv.reset_prefix_cache()
+        self.prefix_hit_tokens.clear()
         self._prefill_state.clear()
         self.pos[:] = 0
         self.active[:] = False
@@ -907,6 +934,16 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
             out["k"] = self._sc.write_kv_tokens(kvl["k"], phys, off, kf)
             out["v"] = self._sc.write_kv_tokens(kvl["v"], phys, off, vf)
         return out
+
+    def _cow_impl(self, params, kvs, src, dst):
+        """Boundary copy-on-write: clone physical block ``src`` into
+        ``dst`` across every pool leaf (per-layer K/V + int8 scales).
+        One captured executable with the pool pytree donated — the
+        copy lands in place in HBM like every other pool write."""
+        del params
+        return {name: [self._sc.copy_block(p, src, dst)
+                       for p in pools]
+                for name, pools in kvs.items()}
 
     def _block_paged(self, lp, h, kvl, positions, tables, n_tiles,
                      wmask):
@@ -1091,7 +1128,7 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
                 "must match the target's — the two advance in "
                 "lockstep over mirrored slot state")
         if self.active.any() or self._prefill_state \
-                or self._kv.used_blocks():
+                or self._kv.occupied_slots():
             raise ValueError(
                 "attach_draft requires an IDLE engine: requests "
                 "admitted before attachment were reserved without the "
@@ -1100,6 +1137,10 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
                 "what admission reservations exist to prevent. Drain "
                 "or release every slot first")
         self._draft = draft
+        # every admission mirrors into the draft's pool/tree: counting
+        # its prefix hits in the process registry would double every
+        # hit (the draft keeps its own per-instance stats() view)
+        draft._prefix_metrics = False
         self._spec_k = k
         draft._spec_propose_k = k
         self._spec_propose = draft._capture_jit(
@@ -1114,6 +1155,43 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
             warm={"program": "spec_verify", "k": k,
                   **self._warm_geo()})
         return self
+
+    def _device_cow(self, slot: int, src: int, dst: int) -> None:
+        """Run the boundary copy-on-write on device: block ``src`` ->
+        ``dst`` in every pool leaf, remapped by the allocator before
+        this call. Dispatched synchronously with admission/step
+        bookkeeping, so program order guarantees the clone reads the
+        shared content before any later pool write can touch it."""
+        if self._cow is None:
+            self._cow = self._capture_jit(
+                self._cow_impl, donate_argnums=(1,),
+                name="serving.prefix_cow",
+                warm={"program": "prefix_cow", **self._warm_geo()})
+        self.kvs = self._cow(self.params, self.kvs, jnp.int32(src),
+                             jnp.int32(dst))
+        if self._prefix_metrics:
+            _flight.record("serving", "prefix_cow", slot=slot,
+                           src=src, dst=dst)
+
+    def _apply_cow(self, slot: int) -> None:
+        """Consume the admission-recorded boundary COW (block-aligned
+        full-prefix hit: the last matched block is cloned so the
+        re-prefilled final prompt token writes privately)."""
+        mv = self._kv.take_cow(slot)
+        if mv is not None:
+            self._device_cow(slot, *mv)
+
+    def _shared_write_guard(self, slot: int) -> None:
+        """Decode/spec writes land at ``pos >= len(prompt)``, past
+        every shared block by construction (``commit_prefix`` only
+        caches full PROMPT blocks) — but a write that DID land inside
+        the shared prefix would corrupt every sharer's stream, so the
+        boundary is guarded, not trusted: ``cow_for_write`` detaches
+        the block (and raises loudly on a mid-prefix write) before
+        the table ships to the device."""
+        mv = self._kv.cow_for_write(slot, int(self.pos[slot]))
+        if mv is not None:
+            self._device_cow(slot, *mv)
 
     def begin_request(self, slot: int, prompt_ids,
                       max_new_tokens: int) -> bool:
@@ -1133,7 +1211,7 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
                 f"prompt length {n} not in [1, {self.max_seq - 1}]")
         budget = max(int(max_new_tokens), 1) + self._spec_k
         total = min(n + budget, self.max_seq)
-        if not self._kv.admit(slot, n, total):
+        if not self._kv.admit(slot, n, total, token_ids=prompt_ids):
             return False
         if self._draft is not None:
             # both pools or neither: a draft that cannot cover the
@@ -1148,7 +1226,20 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
             if not ok:
                 self._kv.release(slot)
                 return False
-        self._prefill_state[slot] = {"ids": prompt_ids, "next": 0}
+        # prefix hit: matched tokens are already resident in aliased
+        # blocks — prefill starts at the first unmatched token (a
+        # block-aligned FULL match re-prefills only the last prompt
+        # token, into its COW'd boundary clone, to seed the first
+        # generated token)
+        skip = self._kv.matched_tokens(slot)
+        self._apply_cow(slot)
+        self.prefix_hit_tokens[slot] = skip
+        if skip and self._prefix_metrics:
+            _M_prefix_hits.inc()
+            _M_prefix_reused.inc(skip)
+            _flight.record("serving", "prefix_hit", slot=slot,
+                           tokens=skip, prompt=n)
+        self._prefill_state[slot] = {"ids": prompt_ids, "next": skip}
         self.pos[slot] = 0
         self.active[slot] = False
         return True
@@ -1181,6 +1272,12 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
             self.params, self.kvs, jnp.asarray(padded), row,
             jnp.int32(start), jnp.int32(c), jnp.int32(n))
         st["next"] = start + c
+        # publish every fully-written prompt block into the radix
+        # tree as soon as its last token lands: a concurrent
+        # admission can hit a prefix whose OWNER is still prefilling
+        # its tail (content-identical blocks dedupe against existing
+        # nodes, remapping the table to the cached copy)
+        self._kv.commit_prefix(slot, ids, st["next"])
         if st["next"] < n:
             # draft prefill rides the same interleave budget: one
             # draft chunk per target chunk (same chunk length — a
@@ -1231,6 +1328,7 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         reservation, so this cannot fail)."""
         for s in range(self.max_slots):
             if self.active[s]:
+                self._shared_write_guard(s)
                 self._kv.ensure_token(s, int(self.pos[s]))
 
     def step(self) -> np.ndarray:
@@ -1250,6 +1348,7 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         if draft is not None:
             for s in range(self.max_slots):
                 if self.active[s]:
+                    draft._shared_write_guard(s)
                     draft._kv.ensure_token(s, int(self.pos[s]))
             _, draft.kvs = draft._decode(
                 draft.params, draft.kvs, ids, pos,
@@ -1317,7 +1416,12 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
             if self.active[s]:
                 # window pre-extension, drawn from the +spec_k
                 # admission margin: target writes [pos, pos+k],
-                # draft writes [pos, pos+k-1]
+                # draft writes [pos, pos+k-1]. Both engines COW-guard
+                # the shared prefix first — a spec write must never
+                # land in an aliased block (rollback would then rip
+                # tokens out of every sharer's stream)
+                self._shared_write_guard(s)
+                draft._shared_write_guard(s)
                 self._kv.reserve_through(s, int(self.pos[s]) + k)
                 draft._kv.reserve_through(s, int(self.pos[s]) + k - 1)
         last = jnp.asarray(self.last_ids)
@@ -1374,6 +1478,7 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
                 f"{self.max_seq}-token capacity (max pos "
                 f"{int(self.pos.max())})")
         for s in range(self.max_slots):
+            self._shared_write_guard(s)
             self._kv.reserve_through(s, int(self.pos[s]) + n - 1)
         if self._decode_collect is None:
             self._decode_collect = self._capture_jit(
@@ -1419,6 +1524,7 @@ class PagedLlamaDecodeEngine(LlamaDecodeEngine):
         self.active[slot] = False
         self.pos[slot] = 0
         self._prefill_state.pop(slot, None)
+        self.prefix_hit_tokens.pop(slot, None)
         self._kv.release(slot, evicted=evicted)
         if self._draft is not None:
             self._draft.release(slot, evicted=evicted)
@@ -2011,11 +2117,18 @@ class GenerationServer:
         # t_queue0 = recovery rebase (see _admit_one)
         _M_queue_s.observe(req["t_admit"] - req.get("t_queue0",
                                                     req["t0"]))
+        # per-request prefix accounting: tokens this admission served
+        # from shared radix blocks (0 = cold prompt), readable off the
+        # finished request next to its tokens/latency (getattr:
+        # duck-typed fake engines keep the bare paged contract)
+        req["prefix_hit_tokens"] = getattr(
+            eng, "prefix_hit_tokens", {}).get(slot, 0)
         self._prefilling[slot] = req
         self.admitted += 1
         _M_admitted.inc()
         _flight.record("serving", "admitted",
-                       trace_id=req.get("trace_id"), slot=slot)
+                       trace_id=req.get("trace_id"), slot=slot,
+                       prefix_hit=req["prefix_hit_tokens"])
         return "admitted"
 
     def _admit(self):

@@ -19,7 +19,16 @@ Three pieces live here, deliberately factored apart:
   boundaries can never fail mid-decode), per-slot block tables, and
   the block-pool telemetry (``serving.blocks_free`` /
   ``blocks_used`` gauges, ``block_evictions_total`` counter, flight
-  events for alloc/free/exhaustion).
+  events for alloc/free/exhaustion). With
+  ``FLAGS_serving_prefix_cache`` (default on) it additionally keeps a
+  **content-addressed radix tree** over committed prompt blocks:
+  nodes are keyed by ``block_size``-token id chunks and own
+  refcounted physical blocks, so admission can alias a hot prefix
+  into a new slot's table instead of re-prefilling it (see
+  :class:`_PrefixNode` and ``PagedKVCache.admit``'s ``token_ids``).
+  Released prefixes stay cached at refcount 0 and are LRU-evicted
+  when the free list runs dry (``block_evictions_total``, flight
+  ``prefix_evict``).
 - :func:`paged_attention` — the DEVICE side: a tiled, online-softmax
   streaming attention step that walks a slot's block list one
   ``block_size`` tile at a time, never materializing a dense
@@ -36,7 +45,8 @@ Three pieces live here, deliberately factored apart:
 """
 from __future__ import annotations
 
-from typing import Dict, List
+import itertools
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +56,7 @@ from .observability import flight as _flight
 from .observability import metrics as _om
 
 __all__ = ["PagedKVCache", "paged_attention", "write_kv_tokens",
-           "absmax_quantize", "use_kernel_default"]
+           "absmax_quantize", "use_kernel_default", "copy_block"]
 
 _M = _om.scope("serving")
 _G_blocks_free = _M.gauge(
@@ -63,6 +73,33 @@ _M_evictions = _M.counter(
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-int(a) // int(b))
+
+
+class _PrefixNode:
+    """One radix-tree node: the edge from ``parent`` is labeled by a
+    full ``block_size``-token id chunk (``key``) and owns exactly one
+    physical block holding that chunk's K/V rows. ``ref`` counts the
+    slot tables currently aliasing the block (NOT including the cache
+    itself): ref 0 means *cached* — still matchable, reclaimable by
+    the LRU eviction pass when the free list runs dry. ``stamp`` is a
+    monotonic last-release tick, so eviction is leaf-first
+    least-recently-released.
+
+    Invariant (every match/release refs the WHOLE path root->node):
+    ``parent.ref >= child.ref`` — a ref-0 node's entire subtree is
+    ref 0, so counting ref-0 nodes counts exactly the reclaimable
+    supply."""
+
+    __slots__ = ("key", "parent", "children", "block", "ref", "stamp")
+
+    def __init__(self, key: Optional[tuple], parent: "_PrefixNode",
+                 block: int = -1):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[tuple, "_PrefixNode"] = {}
+        self.block = block
+        self.ref = 0
+        self.stamp = 0
 
 
 class PagedKVCache:
@@ -84,7 +121,9 @@ class PagedKVCache:
     """
 
     def __init__(self, max_slots: int, max_seq: int, block_size: int,
-                 num_blocks: int):
+                 num_blocks: int,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_blocks: Optional[int] = None):
         self.block_size = int(block_size)
         if self.block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
@@ -103,17 +142,50 @@ class PagedKVCache:
         self._reserved: Dict[int, int] = {}
         self._reserved_total = 0
         self.evictions = 0
+        # -- prefix radix cache (FLAGS_serving_prefix_cache) ----------
+        from .core.flags import flag_value
+        self.prefix_enabled = bool(
+            flag_value("serving_prefix_cache") if prefix_cache is None
+            else prefix_cache)
+        self.prefix_cap = int(
+            flag_value("serving_prefix_cache_blocks")
+            if prefix_cache_blocks is None else prefix_cache_blocks)
+        self._root = _PrefixNode(None, None)  # type: ignore[arg-type]
+        self._by_block: Dict[int, _PrefixNode] = {}
+        self._evictable = 0                # tree nodes at ref 0
+        self._stamp = itertools.count(1)   # LRU release ticks
+        self._shared: Dict[int, List[int]] = {}   # slot -> aliased blocks
+        self._tail: Dict[int, _PrefixNode] = {}   # slot -> deepest node
+        self._matched: Dict[int, int] = {}        # slot -> skip tokens
+        self._cow_pending: Dict[int, Tuple[int, int]] = {}
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
         from .analysis.locks import make_lock
         self._lock = make_lock("serving.kv_pool")
         self._sync_gauges()
 
     # -- accounting ---------------------------------------------------------
     def available_blocks(self) -> int:
-        """Blocks an admission may still claim (free minus reserved)."""
-        return len(self._free) - self._reserved_total
+        """Blocks an admission may still claim: free plus the ref-0
+        cached prefix blocks the LRU pass can reclaim, minus
+        outstanding reservations. Shared (aliased) blocks count
+        exactly once — aliasing a cached prefix consumes no supply."""
+        return len(self._free) + self._evictable - self._reserved_total
 
     def used_blocks(self) -> int:
-        return self.num_blocks - len(self._free)
+        """Blocks doing LIVE work — held privately by a slot or
+        aliased by at least one (ref > 0). Ref-0 cached prefix blocks
+        are NOT used: they are reclaimable supply the LRU pass hands
+        back under pressure (``blocks_cached`` counts them)."""
+        return self.num_blocks - len(self._free) - self._evictable
+
+    def cached_blocks(self) -> int:
+        """Blocks held by the prefix radix tree (shared + ref-0)."""
+        return len(self._by_block)
+
+    def occupied_slots(self) -> int:
+        """Slots currently holding blocks (private or aliased)."""
+        return len(set(self._owned) | set(self._shared))
 
     def stats(self) -> Dict[str, int]:
         return {"num_blocks": self.num_blocks,
@@ -122,24 +194,105 @@ class PagedKVCache:
                 "blocks_available": self.available_blocks(),
                 "blocks_used": self.used_blocks(),
                 "blocks_reserved": self._reserved_total,
+                "blocks_cached": len(self._by_block),
+                "blocks_evictable": self._evictable,
+                "prefix_hits": self.prefix_hits,
+                "prefix_tokens_reused": self.prefix_tokens_reused,
                 "evictions": self.evictions}
 
     def _sync_gauges(self) -> None:
         _G_blocks_free.set(self.available_blocks())
         _G_blocks_used.set(self.used_blocks())
 
+    # -- prefix radix tree (lock held for every _-helper) -------------------
+    def _incref(self, node: _PrefixNode) -> None:
+        if node.ref == 0:
+            self._evictable -= 1
+        node.ref += 1
+
+    def _decref(self, node: _PrefixNode) -> None:
+        node.ref -= 1
+        assert node.ref >= 0, "prefix refcount underflow"
+        if node.ref == 0:
+            node.stamp = next(self._stamp)
+            self._evictable += 1
+
+    def _match_path(self, token_ids) -> List[_PrefixNode]:
+        """Walk the tree with consecutive full-block token chunks;
+        returns the matched node path (possibly empty)."""
+        ids = [int(t) for t in token_ids]
+        node, path = self._root, []
+        for i in range(len(ids) // self.block_size):
+            child = node.children.get(
+                tuple(ids[i * self.block_size:(i + 1) * self.block_size]))
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        return path
+
+    def _evict_one(self) -> Optional[int]:
+        """Reclaim the least-recently-released ref-0 LEAF (children
+        keep their parent's block reachable; the parent becomes a leaf
+        once they go). Returns the freed physical block, or None when
+        nothing is evictable."""
+        best = None
+        for node in self._by_block.values():
+            if node.ref == 0 and not node.children and \
+                    (best is None or node.stamp < best.stamp):
+                best = node
+        if best is None:
+            return None
+        del best.parent.children[best.key]
+        del self._by_block[best.block]
+        self._evictable -= 1
+        self.evictions += 1
+        _M_evictions.inc()
+        _flight.record("serving", "prefix_evict", block=best.block,
+                       depth_key_tokens=len(best.key))
+        return best.block
+
+    def _pop_block(self) -> int:
+        """One free block, evicting a cached prefix block if the free
+        list is dry. Exhaustion here is a caller bug — every draw is
+        covered by an admission-time reservation, and reservations are
+        only granted against ``free + evictable``."""
+        if self._free:
+            return self._free.pop()
+        b = self._evict_one()
+        if b is None:
+            raise RuntimeError(
+                "KV block pool over-drawn: no free block and no "
+                "evictable cached prefix — a reservation was granted "
+                "against supply that no longer exists")
+        return b
+
     # -- allocator ----------------------------------------------------------
     def admit(self, slot: int, prompt_tokens: int,
-              total_tokens: int) -> bool:
+              total_tokens: int, token_ids=None) -> bool:
         """Admit a request into ``slot``: map blocks for its
         ``prompt_tokens`` now and reserve the rest of its
         ``total_tokens`` worst case. Returns False (request should
         wait) when the pool cannot cover the reservation; raises
         ValueError when it NEVER could (need exceeds the whole pool),
         so an impossible request fails loudly instead of queueing
-        forever."""
+        forever.
+
+        With ``token_ids`` (the prompt) and the prefix cache on, the
+        prompt is first matched against the radix tree: matched blocks
+        are ALIASED into the slot's table with refcount bumps and the
+        admission charges only the unshared remainder — the caller
+        reads ``matched_tokens(slot)`` to skip their prefill. A match
+        covering the whole (block-aligned) prompt keeps its last block
+        only as a copy-on-write source: prefill must still produce the
+        first generated token from position n-1, whose K/V write may
+        not land in a shared block — the boundary block is copied at
+        admission (one extra charged block; ``take_cow`` hands the
+        (src, dst) pair to the engine's device-copy seam) and the
+        match is credited as n-1 tokens."""
         slot = int(slot)
-        now = _ceil_div(max(int(prompt_tokens), 1), self.block_size)
+        prompt_tokens = int(prompt_tokens)
+        now = _ceil_div(max(prompt_tokens, 1), self.block_size)
         total = min(max(_ceil_div(total_tokens, self.block_size), now),
                     self.max_blocks_per_slot)
         with self._lock:
@@ -150,26 +303,197 @@ class PagedKVCache:
                     f"{self.block_size}) but the pool holds only "
                     f"{self.num_blocks}; raise FLAGS_serving_num_blocks "
                     f"or shrink the request")
-            if slot in self._owned:
+            if slot in self._owned or slot in self._shared:
                 raise ValueError(f"slot {slot} already holds KV blocks")
-            if total > self.available_blocks():
-                avail = self.available_blocks()
+            path: List[_PrefixNode] = []
+            if self.prefix_enabled and token_ids is not None:
+                path = self._match_path(token_ids)
+            matched = len(path)
+            # a full block-aligned match still re-runs the LAST prompt
+            # token (its logits seed generation), so the boundary block
+            # needs a private copy-on-write clone
+            cow = matched > 0 and matched * self.block_size \
+                >= prompt_tokens
+            # incref BEFORE allocating: the allocation below may evict
+            # ref-0 nodes, which must never include our matched path
+            for node in path:
+                self._incref(node)
+            reserved = total - now
+            need_now = now - matched + (1 if cow else 0)
+            if need_now + reserved > len(self._free) + self._evictable \
+                    - self._reserved_total:
+                avail = len(self._free) + self._evictable \
+                    - self._reserved_total
+                for node in path:
+                    self._decref(node)
             else:
-                blocks = [self._free.pop() for _ in range(now)]
-                self._owned[slot] = blocks
-                self._reserved[slot] = total - now
-                self._reserved_total += total - now
-                self.block_tables[slot, :now] = blocks
+                blocks = [self._pop_block() for _ in range(need_now)]
+                shared = [n.block for n in path]
+                if cow:
+                    # remap the boundary to its fresh clone; the engine
+                    # device-copies src -> dst before any write
+                    src = shared.pop()
+                    self._decref(path[-1])
+                    self._cow_pending[slot] = (src, blocks[0])
+                for i, b in enumerate(shared):
+                    self.block_tables[slot, i] = b
+                for i, b in enumerate(blocks):
+                    self.block_tables[slot, len(shared) + i] = b
+                self._owned[slot] = list(blocks)
+                self._shared[slot] = shared
+                self._tail[slot] = path[len(shared) - 1] if shared \
+                    else self._root
+                skip = (prompt_tokens - 1) if cow \
+                    else matched * self.block_size
+                self._matched[slot] = skip
+                if skip:
+                    self.prefix_hits += 1
+                    self.prefix_tokens_reused += skip
+                self._reserved[slot] = reserved
+                self._reserved_total += reserved
                 self._sync_gauges()
                 avail = None
         if avail is not None:
             _flight.record("serving", "block_exhausted", slot=slot,
-                           need=total, available=avail)
+                           need=need_now + reserved, available=avail)
             return False
         _flight.record("serving", "block_alloc", slot=slot,
-                       blocks=now, reserved=total - now,
+                       blocks=need_now, shared=matched,
+                       reserved=total - now,
                        available=self.available_blocks())
         return True
+
+    def matched_tokens(self, slot: int) -> int:
+        """Prompt tokens admission matched for ``slot`` — the prefill
+        may start at this offset (positions below it are already
+        resident in aliased / copied blocks)."""
+        return self._matched.get(int(slot), 0)
+
+    def take_cow(self, slot: int) -> Optional[Tuple[int, int]]:
+        """Pop the pending boundary copy-on-write ``(src, dst)`` pair
+        recorded by ``admit`` (None when the match was not
+        block-aligned). The caller MUST device-copy block ``src`` ->
+        ``dst`` in every pool leaf before the slot's next write."""
+        return self._cow_pending.pop(int(slot), None)
+
+    def cow_for_write(self, slot: int, pos: int) -> \
+            Optional[Tuple[int, int]]:
+        """Defensive copy-on-write seam for decode/speculative writers:
+        if the block covering position ``pos`` of ``slot`` is a SHARED
+        prefix block, detach it — allocate a clone, remap the table,
+        decref the tree node — and return ``(src, dst)`` for the
+        caller's device copy. Returns None on the (universal in
+        production) private-block path: admission caps matches below
+        the prompt length, so every write position >= len(prompt)
+        lands past the shared prefix by construction."""
+        slot, pos = int(slot), int(pos)
+        shared = self._shared.get(slot)
+        if not shared:
+            return None
+        bidx = pos // self.block_size
+        with self._lock:
+            shared = self._shared.get(slot)
+            if not shared or bidx >= len(shared):
+                return None
+            if bidx != len(shared) - 1:
+                raise RuntimeError(
+                    f"write at pos {pos} targets block {bidx} INSIDE "
+                    f"slot {slot}'s shared prefix ({len(shared)} "
+                    f"blocks) — only the boundary block may be "
+                    f"copy-on-written; truncate the slot first")
+            src = shared.pop()
+            node = self._by_block[src]
+            dst = self._pop_block()
+            self._decref(node)
+            self._tail[slot] = node.parent
+            self.block_tables[slot, bidx] = dst
+            self._owned.setdefault(slot, []).append(dst)
+            self._sync_gauges()
+        return src, dst
+
+    def commit_prefix(self, slot: int, token_ids,
+                      tokens_written: int) -> int:
+        """Publish ``slot``'s fully-written prompt blocks into the
+        radix tree (called after each prefill chunk, so hot prefixes
+        become matchable while their first writer is still
+        prefilling). Only FULL blocks whose every token is already
+        written commit — a half-written block must never be aliased.
+        Private blocks become tree nodes (ownership transfers, the
+        slot keeps an aliased ref); a block whose key already exists
+        in the tree dedupes — the slot remaps onto the cached block
+        and its private copy returns to the free list. Returns the
+        number of blocks committed."""
+        if not self.prefix_enabled:
+            return 0
+        slot = int(slot)
+        ids = [int(t) for t in token_ids]
+        full = min(int(tokens_written), len(ids)) // self.block_size
+        done = 0
+        with self._lock:
+            shared = self._shared.get(slot)
+            owned = self._owned.get(slot)
+            if shared is None or owned is None:
+                return 0
+            tail = self._tail.get(slot, self._root)
+            for bidx in range(len(shared), full):
+                key = tuple(ids[bidx * self.block_size:
+                               (bidx + 1) * self.block_size])
+                b = int(self.block_tables[slot, bidx])
+                node = tail.children.get(key)
+                if node is not None:
+                    # dedupe: a concurrent writer (or this slot's own
+                    # COW clone) re-created cached content — alias the
+                    # tree's block, free the private duplicate
+                    self._incref(node)
+                    owned.remove(b)
+                    self._free.append(b)
+                    self.block_tables[slot, bidx] = node.block
+                else:
+                    if self.prefix_cap and \
+                            len(self._by_block) >= self.prefix_cap:
+                        freed = self._evict_one()
+                        if freed is None:
+                            break  # bound hit, nothing reclaimable:
+                            # the suffix simply stays private
+                        self._free.append(freed)
+                    node = _PrefixNode(key, tail, b)
+                    tail.children[key] = node
+                    node.ref = 1
+                    self._by_block[b] = node
+                    owned.remove(b)
+                shared.append(node.block)
+                tail = node
+                done += 1
+            self._tail[slot] = tail
+            if done:
+                self._sync_gauges()
+        return done
+
+    def reset_prefix_cache(self) -> int:
+        """Drop the whole radix tree, returning every cached block to
+        the free list — the crash-recovery (`reset_state`) seam: the
+        device pools are rebuilt as zeros, so cached content is no
+        longer backed by real K/V. Requires every slot released first
+        (a live alias would dangle). Returns the blocks reclaimed."""
+        with self._lock:
+            if any(n.ref for n in self._by_block.values()):
+                raise RuntimeError(
+                    "reset_prefix_cache with live shared blocks — "
+                    "release every slot first (reset_state does)")
+            n = len(self._by_block)
+            self._free.extend(sorted(self._by_block, reverse=True))
+            self._by_block.clear()
+            self._root.children.clear()
+            self._evictable = 0
+            self._shared.clear()
+            self._tail.clear()
+            self._matched.clear()
+            self._cow_pending.clear()
+            self._sync_gauges()
+        if n:
+            _flight.record("serving", "prefix_evict", block=-1,
+                           reset=True, blocks=n)
+        return n
 
     def ensure_token(self, slot: int, pos: int) -> None:
         """Map the block covering position ``pos`` of ``slot`` if it
@@ -194,7 +518,7 @@ class PagedKVCache:
                     f"slot {slot} has no KV reservation left at pos "
                     f"{pos} — the generation budget passed at admission "
                     f"was too small")
-            b = self._free.pop()
+            b = self._pop_block()
             self._reserved[slot] -= 1
             self._reserved_total -= 1
             self._owned[slot].append(b)
@@ -225,12 +549,32 @@ class PagedKVCache:
         back."""
         slot, tokens = int(slot), int(tokens)
         keep = _ceil_div(tokens, self.block_size) if tokens > 0 else 0
-        rolled = 0
+        rolled = unshared = 0
         with self._lock:
             owned = self._owned.get(slot)
             if owned is None:
                 return 0
-            for bidx in range(keep, self.max_blocks_per_slot):
+            shared = self._shared.get(slot, [])
+            if keep < len(shared):
+                # rolling back INTO the shared prefix (never the spec
+                # path — committed streams cover the whole prompt —
+                # but direct truncate may): decref, don't free, and do
+                # NOT re-credit the reservation (aliased blocks were
+                # never charged against it)
+                for b in shared[keep:]:
+                    self._decref(self._by_block[b])
+                    unshared += 1
+                self.block_tables[slot, keep:len(shared)] = -1
+                del shared[keep:]
+                tail = self._root
+                for b in shared:
+                    tail = self._by_block[b]
+                self._tail[slot] = tail
+                self._matched[slot] = min(
+                    self._matched.get(slot, 0),
+                    keep * self.block_size)
+            for bidx in range(max(keep, len(shared)),
+                              self.max_blocks_per_slot):
                 b = int(self.block_tables[slot, bidx])
                 if b < 0:
                     continue
@@ -244,21 +588,32 @@ class PagedKVCache:
                 self._reserved[slot] = self._reserved.get(slot, 0) \
                     + rolled
                 self._reserved_total += rolled
+            if rolled or unshared:
                 self._sync_gauges()
-        if rolled:
+        if rolled or unshared:
             _flight.record("serving", "block_rollback", slot=slot,
-                           blocks=rolled, kept_tokens=tokens,
+                           blocks=rolled, unshared=unshared,
+                           kept_tokens=tokens,
                            available=self.available_blocks())
         return rolled
 
     def release(self, slot: int, evicted: bool = False) -> int:
-        """Return all of ``slot``'s blocks and cancel its reservation.
-        ``evicted=True`` marks a reclaim (deadline expiry, failure,
-        cancellation) and bumps ``serving.block_evictions_total``;
+        """Return all of ``slot``'s private blocks, decref its shared
+        prefix (the tree KEEPS those blocks cached at ref 0, where
+        they stay matchable until LRU pressure reclaims them) and
+        cancel its reservation. ``evicted=True`` marks a reclaim
+        (deadline expiry, failure, cancellation) and bumps
+        ``serving.block_evictions_total`` for the private blocks;
         normal completion leaves the counter alone."""
         slot = int(slot)
         with self._lock:
             blocks = self._owned.pop(slot, [])
+            shared = self._shared.pop(slot, [])
+            for b in shared:
+                self._decref(self._by_block[b])
+            self._tail.pop(slot, None)
+            self._matched.pop(slot, None)
+            self._cow_pending.pop(slot, None)
             resv = self._reserved.pop(slot, 0)
             self._reserved_total -= resv
             self._free.extend(blocks)
@@ -268,11 +623,58 @@ class PagedKVCache:
             self._sync_gauges()
         if evicted and blocks:
             _M_evictions.inc(len(blocks))
-        if blocks or resv:
+        if blocks or shared or resv:
             _flight.record("serving", "block_free", slot=slot,
-                           blocks=len(blocks), evicted=bool(evicted),
+                           blocks=len(blocks), unshared=len(shared),
+                           evicted=bool(evicted),
                            available=self.available_blocks())
         return len(blocks)
+
+    def check_invariants(self) -> None:
+        """Assert the allocator's global invariants (the tests'
+        step-boundary probe; not on any hot path):
+
+        - free / privately-owned / tree blocks PARTITION the pool;
+        - every node's refcount equals the number of slots aliasing
+          its block, and never exceeds its parent's;
+        - the evictable count equals the ref-0 node count;
+        - each slot's shared blocks are a contiguous table prefix;
+        - ``free + evictable - reserved_total >= 0`` (reservations
+          can always be honored without touching a live block).
+        """
+        with self._lock:
+            free = list(self._free)
+            owned_all = [b for bs in self._owned.values() for b in bs]
+            tree = list(self._by_block)
+            assert len(set(free)) == len(free), "free-list duplicates"
+            assert len(set(owned_all)) == len(owned_all), \
+                "block owned by two slots"
+            union = free + owned_all + tree
+            assert sorted(union) == list(range(self.num_blocks)), (
+                f"pool partition broken: free={sorted(free)} "
+                f"owned={sorted(owned_all)} tree={sorted(tree)}")
+            want_ref: Dict[int, int] = {}
+            for slot, shared in self._shared.items():
+                for i, b in enumerate(shared):
+                    assert int(self.block_tables[slot, i]) == b, \
+                        f"slot {slot} shared prefix not contiguous"
+                    want_ref[b] = want_ref.get(b, 0) + 1
+            zero = 0
+            for b, node in self._by_block.items():
+                assert node.block == b
+                assert node.ref == want_ref.get(b, 0), (
+                    f"block {b}: ref {node.ref} != "
+                    f"{want_ref.get(b, 0)} aliasing slots")
+                assert node.parent is self._root \
+                    or node.parent.ref >= node.ref, \
+                    f"block {b}: child outrefs its parent"
+                zero += node.ref == 0
+            assert zero == self._evictable, \
+                f"evictable count {self._evictable} != {zero} ref-0 nodes"
+            assert self._reserved_total == sum(self._reserved.values())
+            assert len(free) + zero - self._reserved_total >= 0, (
+                f"reservation invariant broken: free={len(free)} "
+                f"evictable={zero} reserved={self._reserved_total}")
 
     def active_tokens(self, pos: np.ndarray,
                       active: np.ndarray) -> int:
@@ -299,6 +701,15 @@ def absmax_quantize(x, bits: int = 8):
     codes = jnp.clip(jnp.round(a / scale[..., None]),
                      -qmax, qmax).astype(jnp.int8)
     return codes, scale
+
+
+def copy_block(pool, src, dst):
+    """Device-copy one whole physical block (all ``block_size`` rows)
+    ``pool[src] -> pool[dst]`` — the copy-on-write data move, riding
+    the same scatter seam as :func:`write_kv_tokens` (an ``.at[]``
+    update the engine runs with the pool donated, so the copy lands in
+    place in HBM)."""
+    return pool.at[dst].set(pool[src])
 
 
 def write_kv_tokens(pool, phys, off, vals):

@@ -542,6 +542,39 @@ class TestLlamaPlanConsistency:
         assert not [b for b in plan.breaks
                     if b["classification"] == "bucket"]
 
+    def test_flash_attention_step_plans_capturable(self):
+        """ISSUE 16 satellite (ROADMAP item-3 step-one residue): a
+        transformer step routed through the REAL flash-attention entry
+        point (LlamaConfig.tiny() defaults use_flash_attention=True,
+        so llama_attention dispatches ops.pallas.flash_attention)
+        produces a consistent capture plan — and the planner's
+        abstract interpreter resolves the attention aval through the
+        declared `shape: attention` spec instead of treating the op
+        as an opaque boundary."""
+        from paddle_tpu.analysis import shapes
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny()
+        assert cfg.use_flash_attention
+        paddle.seed(0)
+        net = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            (np.arange(32, dtype=np.int64) % 64).reshape(2, 16))
+
+        def step():
+            out = net(ids)
+            logits = out[0] if isinstance(out, (tuple, list)) else out
+            return paddle.mean(logits)
+
+        plan = analysis.capture_plan(step, warmup=2)
+        assert plan.consistent(), plan.unaccounted()
+        assert not [b for b in plan.breaks
+                    if b["classification"] == "unaccounted"]
+        # non-vacuous spec resolution: q/k/v avals in, query aval out
+        got = shapes.abstract_eval(
+            "flash_attention", [((2, 16, 4, 8), "float32")] * 3, ())
+        assert got is not None and got.shape == (2, 16, 4, 8)
+        assert str(got.dtype) == "float32"
+
     def test_captured_fit_step_runs_dispatch_free(self):
         """ISSUE 10 acceptance, audit as the assertion engine: a
         steady-state captured llama train step is ONE executable call
@@ -620,10 +653,18 @@ class TestRepoStepFixtures:
             "PagedLlamaDecodeEngine.step": {"PTC002": 2, "PTC003": 1},
             "PagedLlamaDecodeEngine.decode_steps":
                 {"PTC002": 1, "PTC003": 1},
+            # begin_request: admission bookkeeping only — slot
+            # activation (pos/active), prefill staging, and the
+            # prefix-sharing hit record; the radix match/alias/COW
+            # decision is allocator method calls, not step-state
+            # mutation, so it adds NO findings beyond the hit record
+            "PagedLlamaDecodeEngine.begin_request": {"PTC002": 4},
             # prefill_chunk: program-cache insert, prompt staging into
             # the padded host buffer, slot activation bookkeeping
             # (pos/active/last_ids), the draft-mirror last_ids seed +
-            # the final-chunk first-token fetch
+            # the final-chunk first-token fetch (the radix
+            # commit_prefix after each chunk is an allocator call —
+            # no new finding)
             "PagedLlamaDecodeEngine.prefill_chunk":
                 {"PTC002": 6, "PTC003": 1},
             # spec_step: commit bookkeeping (pos/last_ids) between the

@@ -109,12 +109,19 @@ def _toy_batch():
 def _pool_invariants(kv):
     st = kv.stats()
     owned = sum(len(b) for b in kv._owned.values())
-    assert st["blocks_free"] + owned == kv.num_blocks
+    shared = sum(len(b) for b in kv._shared.values())
+    # three-way partition: free / privately-owned / held by the
+    # prefix radix tree (aliased blocks live in the tree, counted
+    # once however many slots map them)
+    assert st["blocks_free"] + owned + st["blocks_cached"] \
+        == kv.num_blocks
     assert st["blocks_reserved"] == sum(kv._reserved.values())
     mapped = int((kv.block_tables >= 0).sum())
-    assert mapped == owned
-    phys = kv.block_tables[kv.block_tables >= 0]
-    assert len(set(phys.tolist())) == len(phys)
+    assert mapped == owned + shared
+    for row in kv.block_tables:
+        row = row[row >= 0]
+        assert len(set(row.tolist())) == len(row)
+    kv.check_invariants()
 
 
 # ---------------------------------------------------------------------------

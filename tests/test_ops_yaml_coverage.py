@@ -707,10 +707,11 @@ def test_fallback_parser_agrees_with_pyyaml():
 
 def test_fusable_field_validation():
     """`fusable` is a CLASS marker — true (elementwise), `reduce`
-    (reduction terminator), `epilogue` (contraction) — with per-class
-    structural constraints, a registered VJP (grads flow through the
-    fused program's jax.vjp), and a registered fusion impl, so the YAML
-    can't drift from the runtime."""
+    (reduction terminator), `epilogue` (contraction), `attention`
+    (analysis-plane-only: planned through, never eagerly deferred) —
+    with per-class structural constraints, a registered VJP (grads flow
+    through the fused program's jax.vjp), and a registered fusion impl,
+    so the YAML can't drift from the runtime."""
     import inspect
 
     from paddle_tpu.core import fusion
@@ -720,12 +721,25 @@ def test_fusable_field_validation():
     fusable = [o for o in d if o.get("fusable")]
     by_class = {}
     for o in fusable:
-        assert o.get("fusable") in (True, "reduce", "epilogue"), \
+        assert o.get("fusable") in (True, "reduce", "epilogue",
+                                    "attention"), \
             f"op {o['name']}: unknown fusable class {o.get('fusable')!r}"
         by_class.setdefault(o["fusable"], []).append(o)
     assert len(by_class.get(True, [])) >= 40   # elementwise families
     assert len(by_class.get("reduce", [])) >= 8
     assert len(by_class.get("epilogue", [])) >= 2
+    # the attention family (ROADMAP item-3 step-one residue): exactly
+    # the three kernel entry points, q/k/v(+seg) arity, and the eager
+    # fusion DAG must NEVER defer them — try_fuse rejects the class
+    attn = by_class.get("attention", [])
+    assert sorted(o["name"] for o in attn) == [
+        "flash_attention", "flash_attention_segmented",
+        "ring_attention"]
+    for o in attn:
+        assert int(o["nin"]) in (3, 4), \
+            f"attention-fusable {o['name']} has nin={o['nin']}"
+        assert fusion.try_fuse(o["name"], lambda *a: None, (), {},
+                               attrs=()) is None
     for o in fusable:
         name = o["name"]
         assert o.get("vjp", True) is True, \
@@ -799,9 +813,26 @@ def test_shape_spec_coverage_and_golden_run():
     fusable_names = {o["name"] for o in d if o.get("fusable")}
     for name in fusable_names:
         assert OP_TABLE[name]["shape_spec"] in SHAPE_SPECS
-    # golden run: abstract spec == live impl on sample avals, all ops
+    # golden run: abstract spec == live impl on sample avals, all ops.
+    # The attention entry points register their aval impls at their
+    # (lazily imported) definition sites — import them first so their
+    # validation is NON-vacuous (infer_output_aval would otherwise
+    # return None and skip the grading)
+    import paddle_tpu.distributed.ring_attention  # noqa: F401
+    import paddle_tpu.ops.pallas.flash_attention  # noqa: F401
+    from paddle_tpu.core import fusion
+    for name in ("flash_attention", "flash_attention_segmented",
+                 "ring_attention"):
+        assert name in fusion._PIMPLS, \
+            f"{name} registered no aval impl — its spec would grade " \
+            f"vacuously"
     diags = shapes.validate_specs()
     assert diags == [], "\n".join(x.render() for x in diags)
+    # the attention detector detects too: a deliberately wrong spec
+    # over the real impl must fail its golden run
+    assert any(x.rule == "PTC005"
+               for x in shapes.validate_op("flash_attention",
+                                           "elementwise"))
     # the detector detects: a wrong spec must fail the golden run...
     assert any(x.rule == "PTC005"
                for x in shapes.validate_op("mean", "broadcast"))

@@ -68,13 +68,24 @@ def spec_eng(model):
 def _pool_invariants(kv):
     st = kv.stats()
     owned = sum(len(b) for b in kv._owned.values())
-    assert st["blocks_free"] + owned == kv.num_blocks
+    shared = sum(len(b) for b in kv._shared.values())
+    # physical partition: every block is free, privately owned, or
+    # held by the prefix radix tree (aliased shared blocks live in
+    # the tree, counted once however many slots map them)
+    assert st["blocks_free"] + owned + st["blocks_cached"] \
+        == kv.num_blocks
     assert st["blocks_reserved"] == sum(kv._reserved.values())
-    assert st["blocks_free"] >= st["blocks_reserved"]
+    assert st["blocks_available"] >= 0
     mapped = int((kv.block_tables >= 0).sum())
-    assert mapped == owned
-    phys = kv.block_tables[kv.block_tables >= 0]
-    assert len(set(phys.tolist())) == len(phys)
+    assert mapped == owned + shared
+    # private blocks are exclusive; aliasing may repeat a PHYSICAL
+    # block across slots but never within one slot's table
+    privs = [b for blks in kv._owned.values() for b in blks]
+    assert len(set(privs)) == len(privs)
+    for row in kv.block_tables:
+        row = row[row >= 0]
+        assert len(set(row.tolist())) == len(row)
+    kv.check_invariants()
 
 
 class TestSpecBitEquality:
