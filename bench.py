@@ -1940,6 +1940,106 @@ def bench_hot_start():
                      "hits (0 fresh XLA compiles, counters pinned)"})
 
 
+def bench_fleet_failover():
+    """fleet_failover_recovery_seconds: SIGKILL one of 2 real replica
+    processes mid-decode (armed fleet.apply site — the kill lands the
+    moment the router applies that replica's first streamed batch) and
+    measure (a) kill -> every accepted stream finished (failover
+    recovery; the survivors absorb the re-dispatched work) and
+    (b) kill -> the replacement replica rejoined AND served tokens,
+    A/B: warm resurrection (shared executable cache + warm bundle,
+    misses pinned at 0) vs cold (no cache, no bundle: the replacement
+    re-compiles before it is useful). vs_baseline = cold time-to-
+    serving / warm (the resurrection speedup the warm plane buys)."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    from paddle_tpu.serving_fleet import (ReplicaClient, ReplicaHandle,
+                                          launch_replica, spawn_fleet)
+    from paddle_tpu.utils import fault_injection as fi
+
+    base = {"model": {"kind": "tiny_llama", "seed": 7, "config": dict(
+                vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, use_flash_attention=False)},
+            "max_slots": 2, "max_seq": 64, "block_size": 8,
+            "prefill_chunk": 8, "supervised": True}
+    cache = tempfile.mkdtemp(prefix="fleet_bench_cache_")
+    try:
+        bundle = os.path.join(cache, "warm.npz")
+        env = {"FLAGS_executable_cache_dir": cache}
+        # one cold boot seeds the shared cache + seals the bundle
+        proc, port, _boot = launch_replica(
+            dict(base, prime=[1, 2, 3, 4], export_bundle=bundle),
+            env=env)
+        ReplicaHandle(0, "127.0.0.1", port, pid=proc.pid,
+                      proc=proc).call({"op": "shutdown", "drain": True})
+        proc.wait(timeout=120)
+
+        def run(warm):
+            cfg = dict(base, warm_bundle=bundle) if warm else dict(base)
+            router = spawn_fleet(
+                2, cfg, env=(env if warm else None),
+                router_kwargs=dict(policy="rr", heartbeat_seconds=0.2,
+                                   heartbeat_misses=2,
+                                   restart_backoff=0.05,
+                                   max_restarts=6))
+            try:
+                victim = router.replicas[0]
+                fi.inject(f"fleet.apply.r{victim.idx}", times=1)
+                reqs = [router.submit([i + 1, i + 2, i + 3], 24)
+                        for i in range(4)]
+                deadline = time.monotonic() + 120
+                while victim.proc.poll() is None \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.002)
+                assert victim.proc.poll() is not None, \
+                    "armed SIGKILL never fired (streams too short?)"
+                t_kill = time.monotonic()
+                for r in reqs:
+                    assert r["done"].wait(300), "stream stalled"
+                    assert r["error"] is None, r["error"]
+                recovery = time.monotonic() - t_kill
+                while router.stats()["live"] < 2 \
+                        and time.monotonic() - t_kill < 300:
+                    time.sleep(0.05)
+                assert router.stats()["live"] == 2, "no resurrection"
+                # "rejoined" means USEFUL: the reborn replica serves
+                # tokens (a cold one pays its compiles right here)
+                cli = ReplicaClient(victim.host, victim.port,
+                                    timeout=300)
+                toks = cli.generate([9, 9], 4, timeout=300)
+                cli.close()
+                assert len(toks) == 4
+                tts = time.monotonic() - t_kill
+                cache_stats = victim.call(
+                    {"op": "cache_stats"})["cache"]
+                return recovery, tts, cache_stats, router.stats()
+            finally:
+                fi.clear()
+                router.shutdown(drain=False, timeout=60)
+
+        w_rec, w_tts, w_cache, w_stats = run(warm=True)
+        c_rec, c_tts, _c_cache, _ = run(warm=False)
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+    assert w_cache["misses"] == 0, w_cache  # warm rejoin: 0 compiles
+    speedup = c_tts / max(w_tts, 1e-9)
+    assert speedup >= 1.0, (c_tts, w_tts)
+    _emit("fleet_failover_recovery_seconds", w_rec, "s", speedup, {
+        "warm_recovery_s": round(w_rec, 3),
+        "cold_recovery_s": round(c_rec, 3),
+        "warm_time_to_serving_s": round(w_tts, 2),
+        "cold_time_to_serving_s": round(c_tts, 2),
+        "resurrection_speedup": round(speedup, 2),
+        "warm_cache": w_cache,
+        "failovers": w_stats["failovers"],
+        "bar": "every accepted stream survives a replica SIGKILL; "
+               "warm resurrection rejoins with 0 fresh XLA compiles "
+               "and >= 1x cold time-to-serving"})
+
+
 def bench_analysis_selfcheck():
     """analysis_selfcheck: the analysis plane's seeded-bug smoke
     (python -m paddle_tpu.analysis --self-check in-process): one bug
@@ -2097,6 +2197,7 @@ _SUITE = [
     ("amp_captured_step_us", "bench_amp_captured_step"),
     ("dist_overlap_dryrun", "bench_dist_overlap_dryrun"),
     ("hot_start_time_to_first_step", "bench_hot_start"),
+    ("fleet_failover_recovery_seconds", "bench_fleet_failover"),
     ("analysis_selfcheck", "bench_analysis_selfcheck"),
     ("bench_llama", "bench_llama"),
     ("bench_llama7b_geometry", "bench_llama7b_geometry"),

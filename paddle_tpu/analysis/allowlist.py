@@ -162,6 +162,16 @@ CAPTURE_ALLOWLIST = [
      "tables, and the one device-side effect (cloning the shared "
      "boundary block before its first write) is its own tiny jitted "
      "copy program (serving.prefix_cow), dispatched between steps"),
+    # -- fleet serving fabric (ISSUE 17): the router is a pure HOST
+    #    control plane across process boundaries — precise row so the
+    #    broad serving glob below can't absorb it --------------------
+    ("PTC002", "paddle_tpu/serving_fleet.py*",
+     "fleet dispatch/fencing bookkeeping (the in-flight table, the "
+     "epoch bump, failover/quarantine tallies) is the capture "
+     "boundary BY DESIGN: the router never holds a tensor — replicas "
+     "run the captured programs in their own processes, and every "
+     "mutation here happens between RPC frames, with the zombie "
+     "epoch's responses discarded rather than replayed"),
     ("PTC002", "paddle_tpu/serving.py*",
      "slot/block bookkeeping (pos/last_ids/active, block-table "
      "extension, prefill staging, speculative accept/rollback — "

@@ -711,6 +711,12 @@ REPO_STEPS: List[Tuple[str, str, Tuple[str, ...]]] = [
     ("paddle_tpu/serving_supervisor.py",
      "AdaptiveAdmissionPolicy.on_step", ()),
     ("paddle_tpu/serving_supervisor.py", "rollout", ()),
+    # fleet serving fabric (ISSUE 17): router placement and failover
+    # are the HOST control plane between replica processes — scanned
+    # so a tensor fetch or captured-state mutation sneaking into the
+    # dispatch/fencing path fails tier-1
+    ("paddle_tpu/serving_fleet.py", "FleetRouter._dispatch", ()),
+    ("paddle_tpu/serving_fleet.py", "FleetRouter._replica_down", ()),
     ("paddle_tpu/jit/sot.py", "CapturedStep.prewarm", ()),
     ("paddle_tpu/distributed/dist_train.py", "DistTrainStep.__call__",
      ("batch_and_labels",)),

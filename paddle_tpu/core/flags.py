@@ -243,6 +243,37 @@ define_flag("serving_shed_queue", 0,
             "immediately (rejected reason=shed) instead of deferring "
             "unboundedly. 0 (default) disables shedding — exhaustion "
             "queues forever, the pre-policy behavior")
+define_flag("serving_fleet_heartbeat_seconds", 0.5,
+            "Fleet router heartbeat period: every replica's /health "
+            "RPC is probed this often on a dedicated short-timeout "
+            "connection, and the returned gauges (blocks_free, "
+            "backlog, admission pressure level) feed KV-pressure-"
+            "aware placement")
+define_flag("serving_fleet_heartbeat_misses", 3,
+            "Consecutive failed heartbeats before the fleet router "
+            "declares a replica dead: its epoch is fenced (late "
+            "responses discarded), in-flight requests fail over to "
+            "healthy replicas seeded with their committed tokens, "
+            "and resurrection begins. A data-plane connection error "
+            "fences immediately without waiting for misses")
+define_flag("serving_fleet_restart_backoff", 0.05,
+            "Base seconds of the fleet router's bounded exponential "
+            "resurrection backoff: relaunch attempt N of a dead "
+            "replica waits backoff * 2^(N-1) (capped, full-jittered "
+            "under FLAGS_backoff_full_jitter) before spawning the "
+            "replacement process from the shared executable cache + "
+            "warm bundle")
+define_flag("serving_fleet_max_restarts", 8,
+            "Resurrection attempts per dead replica before the fleet "
+            "router gives up on it and degrades to the surviving "
+            "replicas (the router itself never crashes; a degraded "
+            "slot is journaled and counted)")
+define_flag("serving_fleet_retry_after", 1.0,
+            "Seconds clients are told to wait (the retry_after hint "
+            "on the fleet-shed error) when every live replica reports "
+            "admission pressure level 3 — fleet-level shed fires only "
+            "after per-replica brownout has already been exhausted "
+            "everywhere")
 define_flag("use_bf16_matmul", True, "Prefer bfloat16 matmul accumulation defaults")
 define_flag("log_level", 0, "Framework verbosity")
 define_flag("benchmark", False, "Synchronize after each op for timing")

@@ -21,6 +21,7 @@ from typing import Optional
 
 from .._native import lib as _lib
 from ..observability import metrics as _om
+from ..utils import backoff as _backoff
 from ..utils import fault_injection as _fi
 
 __all__ = ["TCPStore"]
@@ -114,8 +115,14 @@ class TCPStore:
                         f"{type(e).__name__}: {e}") from e
                 self.op_retries += 1
                 _M_retries.inc(op=op)
-                sleep = min(self.backoff * (2 ** (attempt - 1)),
-                            self.backoff_max, max(remaining, 0.0))
+                # full jitter spreads a worker herd retrying the same
+                # coordinator restart; the remaining-deadline cap stays
+                # OUTSIDE the jitter so the op deadline is still honored
+                sleep = min(
+                    _backoff.full_jitter(
+                        min(self.backoff * (2 ** (attempt - 1)),
+                            self.backoff_max)),
+                    max(remaining, 0.0))
                 if sleep > 0:
                     time.sleep(sleep)
 

@@ -425,7 +425,8 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
           eos_id=None, speculative: bool = False,
           spec_tokens: Optional[int] = None,
           spec_draft_layers: Optional[int] = None,
-          warm_bundle=None, supervised: bool = False):
+          warm_bundle=None, supervised: bool = False,
+          fleet: int = 0):
     """Minimal predictor server (ref: the reference ships its predictor
     behind paddle_serving / the C API server loop; this is the
     batteries-included analog). Concurrent requests are micro-batched
@@ -467,6 +468,15 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     in-flight generations bit-equal from their committed tokens —
     repeat-offender requests are quarantined instead of crash-looping
     the replica.
+
+    ``fleet=N`` (N >= 2, with ``generate=True``) serves /generate
+    through a :class:`serving_fleet.FleetRouter` over N supervised
+    replica SUBPROCESSES instead of one in-process engine: KV-
+    pressure-aware placement, failover with bit-equal stream
+    recovery, and warm-bundle resurrection of dead replicas (see
+    ``serving_fleet``). The replicas share this process's
+    ``FLAGS_executable_cache_dir`` and ``warm_bundle``, so a recycled
+    replica rejoins without a compile storm.
     """
     import io
     import threading
@@ -479,9 +489,17 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     batcher = _MicroBatcher(predictor, max_batch=max_batch,
                             window_ms=batch_window_ms)
     gen_server = None
+    fleet_router = None
     if warm_bundle is None:
         warm_bundle = flag_value("warmup_bundle") or None
-    if generate:
+    if generate and int(fleet) >= 2:
+        from .serving_fleet import spawn_fleet
+        fleet_router = spawn_fleet(int(fleet), {
+            "model": {"kind": "inference_model", "path": model_path},
+            "max_slots": max_slots, "max_seq": max_seq, "int8": int8,
+            "eos_id": eos_id, "warm_bundle": warm_bundle,
+            "supervised": True})
+    elif generate:
         from .serving import GenerationServer, PagedLlamaDecodeEngine
         # reuse the predictor's already-loaded Layer (a second
         # load_inference_model would hold the weights twice at startup)
@@ -523,7 +541,8 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
                 self.send_response(404)
                 self.end_headers()
                 return
-            if self.path == "/generate" and gen_server is None:
+            if self.path == "/generate" and gen_server is None \
+                    and fleet_router is None:
                 msg = b"serve(generate=True) not enabled"
                 self.send_response(404)
                 self.send_header("Content-Length", str(len(msg)))
@@ -538,7 +557,8 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
                     ids = np.asarray(data["input_ids"]).reshape(-1)
                     mnt = int(data["max_new_tokens"]) \
                         if "max_new_tokens" in data else 32
-                    toks = gen_server.generate(ids, mnt)
+                    toks = (fleet_router or gen_server).generate(
+                        ids, mnt)
                     outs = [np.asarray(toks, np.int32)]
                     buf = io.BytesIO()
                     np.savez(buf, output_ids=outs[0])
@@ -570,6 +590,7 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866,
     server = ThreadingHTTPServer((host, port), Handler)
     server.batcher = batcher  # introspection (tests, metrics)
     server.gen_server = gen_server
+    server.fleet_router = fleet_router
     if block:
         server.serve_forever()
         return None

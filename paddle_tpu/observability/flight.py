@@ -47,7 +47,12 @@ adaptive-admission decision with the evidence it was decided on, and
 ``rollout`` events (begin / canary_probe with the divergence /
 stage_ok / rollback / halted-by-reason / end) journal a canary weight
 rollout stage by stage — a bad deploy reads straight out of the
-canary's dump.
+canary's dump. The fleet fabric journals as ``fleet`` events
+(router_up / submit / dispatch with replica+epoch / finished/failed/
+shed terminals — exactly one per request / replica_dead with reason /
+failover with the committed-token count / stale_drop — a fenced
+zombie's late answer / quarantined / resurrect_attempt / resurrected /
+degraded): a replica SIGKILL and its recovery read as one trace.
 
 Recording is on by default (``FLAGS_flight_recorder``) because an
 append costs the same class of work as a ``Counter`` bump — one cached

@@ -1786,13 +1786,17 @@ class GenerationServer:
     def metrics_endpoint(self, port: int = 0, host: str = "127.0.0.1"):
         """Serve the process metrics registry over HTTP: ``GET /metrics``
         (Prometheus text exposition) + ``/metrics.json`` (the nested
-        snapshot). Idempotent per server; the endpoint is closed by
-        ``shutdown()``. Returns the handle (``.url``, ``.port``,
-        ``.close()``)."""
+        snapshot) + ``/healthz`` (readiness: decode loop alive,
+        supervisor not given up, admission pressure — the same snapshot
+        the fleet router's probe reads). Idempotent per server; the
+        endpoint is closed by ``shutdown()``. Returns the handle
+        (``.url``, ``.port``, ``.close()``)."""
         if self._metrics_server is None:
             from .observability.http import start_metrics_server
-            self._metrics_server = start_metrics_server(port=port,
-                                                        host=host)
+            from .serving_fleet import health_snapshot
+            self._metrics_server = start_metrics_server(
+                port=port, host=host,
+                health_cb=lambda: health_snapshot(self))
         return self._metrics_server
 
     def submit(self, prompt_ids, max_new_tokens: int = 32,

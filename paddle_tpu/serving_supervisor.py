@@ -57,6 +57,7 @@ import numpy as np
 from .core.flags import flag_value
 from .observability import flight as _flight
 from .observability import metrics as _om
+from .utils import backoff as _backoff
 
 __all__ = ["ServingSupervisor", "supervise", "StaticShedPolicy",
            "AdaptiveAdmissionPolicy", "default_policy", "RolloutPolicy",
@@ -524,8 +525,9 @@ class ServingSupervisor:
         # submit order: _admit drains _waiting before the queue (and
         # holds the line), so nothing newer overtakes a resumed stream
         srv._waiting = recovered + srv._waiting
-        delay = min(self.backoff * (2 ** (self._streak - 1)),
-                    self.backoff_cap)
+        delay = _backoff.full_jitter(
+            min(self.backoff * (2 ** (self._streak - 1)),
+                self.backoff_cap))
         if delay > 0:
             time.sleep(delay)
         self.restarts += 1
@@ -682,7 +684,12 @@ def _divergence(a: List[int], b: List[int]) -> float:
 
 def _count_nonfinite(prepared) -> int:
     """Non-finite values across a prepared weight tree (int8 code
-    leaves cast clean; their float scales are what can go NaN)."""
+    leaves cast clean; their float scales are what can go NaN). A
+    fleet ``RemotePrepared`` handle carries the replica-side scan as
+    ``.nonfinite`` — the tree lives in another process, so the count
+    rides the handle instead of a tree walk."""
+    if hasattr(prepared, "nonfinite"):
+        return int(prepared.nonfinite)
     import jax
     import jax.numpy as jnp
     bad = 0

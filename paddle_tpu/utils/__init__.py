@@ -1,3 +1,4 @@
+from . import backoff  # noqa: F401
 from . import clip_grad  # noqa: F401
 from . import custom_op  # noqa: F401
 from . import download  # noqa: F401

@@ -1,0 +1,55 @@
+"""Full-jitter for bounded-exponential backoffs.
+
+Every retry loop in the repo (ServingSupervisor restarts, TCPStore
+client ops, the fleet router's replica resurrection) backs off as
+``base * 2^(attempt-1)`` capped at a bound. Without jitter, a shared
+failure — the coordinator restarting, one replica dying under N
+routers — synchronizes every retrier onto the same schedule and they
+stampede the recovering component in waves. Full jitter (the AWS
+architecture-blog result): sleep ``uniform(0, bound)`` instead of
+``bound`` — the expected extra latency is half a bound, the herd is
+spread across the whole window, and the worst case never exceeds the
+un-jittered sleep.
+
+``FLAGS_backoff_full_jitter=0`` is the kill switch (restores the
+deterministic schedule — what the pre-jitter tests pinned), and
+:func:`seed` makes the draw reproducible for tests that assert on the
+jittered path itself.
+"""
+from __future__ import annotations
+
+import random
+import threading
+
+from ..core.flags import define_flag, flag_value
+
+__all__ = ["full_jitter", "seed"]
+
+define_flag(
+    "backoff_full_jitter", True,
+    "Full jitter on every bounded-exponential backoff (supervisor "
+    "restarts, TCPStore retries, fleet replica resurrection): sleep "
+    "uniform(0, bound) instead of the deterministic bound, so "
+    "correlated failures do not synchronize retriers into a stampede. "
+    "0 restores the deterministic schedule; utils.backoff.seed(n) "
+    "makes the jittered draws reproducible for tests")
+
+_lock = threading.Lock()
+_rng = random.Random()
+
+
+def seed(n: int) -> None:
+    """Re-seed the jitter RNG (tests pinning the jittered schedule)."""
+    with _lock:
+        _rng.seed(n)
+
+
+def full_jitter(bound: float) -> float:
+    """The sleep for one backoff step whose un-jittered value is
+    ``bound``: ``uniform(0, bound)`` under the flag (default), else
+    ``bound`` unchanged. Never negative."""
+    bound = max(float(bound), 0.0)
+    if bound == 0.0 or not flag_value("backoff_full_jitter"):
+        return bound
+    with _lock:
+        return _rng.uniform(0.0, bound)

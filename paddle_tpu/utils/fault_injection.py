@@ -15,12 +15,16 @@ simulated preemption — only the test harness catches it.
 """
 from __future__ import annotations
 
+import os
+import signal
 import threading
+import time
 from contextlib import contextmanager
 from typing import Dict, Optional
 
 __all__ = ["KillPoint", "InjectedFault", "inject", "clear", "fire",
-           "write_bytes", "injected", "stats", "armed"]
+           "write_bytes", "injected", "stats", "armed",
+           "inject_transport", "FlakyTransport", "kill_pid"]
 
 
 class KillPoint(BaseException):
@@ -47,8 +51,21 @@ class _Fault:
         self.fired = 0
 
 
+class _TransportFault:
+    __slots__ = ("drop", "duplicate", "delay", "times", "skip", "fired")
+
+    def __init__(self, drop, duplicate, delay, times, skip):
+        self.drop = drop
+        self.duplicate = duplicate
+        self.delay = delay
+        self.times = times
+        self.skip = skip
+        self.fired = 0
+
+
 _lock = threading.Lock()
 _sites: Dict[str, _Fault] = {}
+_transport_sites: Dict[str, _TransportFault] = {}
 _fired_total: Dict[str, int] = {}
 
 
@@ -88,12 +105,15 @@ def inject(site: str, exc: Optional[BaseException] = None, times: int = 1,
 
 
 def clear(site: Optional[str] = None) -> None:
-    """Disarm one site, or every site when called with no argument."""
+    """Disarm one site, or every site when called with no argument
+    (transport perturbations included)."""
     with _lock:
         if site is None:
             _sites.clear()
+            _transport_sites.clear()
         else:
             _sites.pop(site, None)
+            _transport_sites.pop(site, None)
 
 
 def armed(site: str) -> bool:
@@ -210,3 +230,112 @@ class FlakyStore:
             return target(*a, **kw)
 
         return flaky
+
+
+# ---------------------------------------------------------------------------
+# transport-level perturbation (fleet RPC chaos)
+# ---------------------------------------------------------------------------
+def inject_transport(site: str, drop: bool = False, duplicate: bool = False,
+                     delay: float = 0.0, times: int = 1,
+                     skip: int = 0) -> None:
+    """Arm ``site`` to perturb its next ``times`` frames (after ``skip``
+    clean ones) as they pass through a :class:`FlakyTransport`.
+
+    drop:      the frame vanishes — a send is never written, a received
+               frame is discarded and the NEXT one delivered instead.
+    duplicate: the frame arrives twice (at-least-once delivery the
+               receiver's dedup path must absorb).
+    delay:     sleep this many seconds before the frame moves (reorder /
+               heartbeat-stall pressure without wall-clock test sleeps
+               elsewhere).
+    """
+    with _lock:
+        _transport_sites[site] = _TransportFault(
+            bool(drop), bool(duplicate), float(delay), int(times),
+            int(skip))
+
+
+def _consume_transport(site: str) -> Optional[_TransportFault]:
+    with _lock:
+        f = _transport_sites.get(site)
+        if f is None:
+            return None
+        if f.skip > 0:
+            f.skip -= 1
+            return None
+        if f.times <= 0:
+            return None
+        f.times -= 1
+        f.fired += 1
+        _fired_total[site] = _fired_total.get(site, 0) + 1
+        if f.times <= 0:
+            del _transport_sites[site]
+        return f
+
+
+class FlakyTransport:
+    """Wraps a frame transport — any object with ``send(obj)`` and
+    ``recv()`` (the fleet RPC connection) — and perturbs whole frames at
+    armed transport sites. Sends consult ``<site>.send``, receives
+    ``<site>.recv``; arm them with :func:`inject_transport`. Unarmed
+    frames cost one dict lookup; everything else (close, fileno, ...)
+    passes straight through, so production code can thread every
+    connection through this wrapper unconditionally.
+    """
+
+    def __init__(self, transport, site: str):
+        self._t = transport
+        self.site = site
+        self._replay = []  # frames queued by a recv-side duplicate
+
+    def send(self, obj):
+        f = (_consume_transport(self.site + ".send")
+             if _transport_sites else None)
+        if f is not None:
+            if f.delay > 0:
+                time.sleep(f.delay)
+            if f.drop:
+                return None  # the peer never sees this frame
+            if f.duplicate:
+                self._t.send(obj)
+        return self._t.send(obj)
+
+    def recv(self):
+        if self._replay:
+            return self._replay.pop(0)
+        f = (_consume_transport(self.site + ".recv")
+             if _transport_sites else None)
+        if f is not None and f.delay > 0:
+            time.sleep(f.delay)
+        obj = self._t.recv()
+        if f is not None:
+            if f.drop:
+                return self._t.recv()  # discard; deliver the next frame
+            if f.duplicate:
+                self._replay.append(obj)
+        return obj
+
+    def __getattr__(self, name):
+        return getattr(self._t, name)
+
+
+def kill_pid(site: str, pid: int) -> bool:
+    """SIGKILL ``pid`` when ``site`` is armed; no-op (False) otherwise.
+
+    The deterministic chaos trigger for fleet tests: production code
+    calls this at a well-defined point (the router just applied the
+    k-th streamed token, a replica just acked admission) and an armed
+    test turns exactly that point into a real child-process death — no
+    sleep-and-hope timing. The unarmed fast path is one dict lookup.
+    Refuses to signal the calling process itself.
+    """
+    if site not in _sites:
+        return False
+    f = _consume(site)
+    if f is None:
+        return False
+    pid = int(pid)
+    if pid == os.getpid() or pid <= 0:
+        return False
+    os.kill(pid, signal.SIGKILL)
+    return True

@@ -135,13 +135,42 @@ class TestStoreRetry:
     def test_backoff_is_exponential_and_capped(self, store):
         """Four retries at backoff=0.01 cap 0.05 sleep ~0.01+0.02+0.04
         +0.05 — the op takes noticeably longer than a clean one but far
-        less than 4x the cap."""
+        less than 4x the cap. Jitter is disabled so the deterministic
+        schedule stays pinned (the jittered path has its own test)."""
+        import paddle_tpu as paddle
+        paddle.set_flags({"FLAGS_backoff_full_jitter": 0})
+        try:
+            fi.inject("store.add", exc=ConnectionResetError("flake"),
+                      times=4)
+            t0 = time.monotonic()
+            store.add("c", 1)
+            dt = time.monotonic() - t0
+            assert 0.05 < dt < 2.0, dt
+        finally:
+            paddle.set_flags({"FLAGS_backoff_full_jitter": 1})
+
+    def test_backoff_full_jitter_spreads_retries(self, store):
+        """With jitter on (the default) each sleep draws uniform(0,
+        bound): a seeded run totals strictly LESS than the
+        deterministic 0.12s schedule yet the op still succeeds after
+        the same four injected failures."""
+        from paddle_tpu.utils import backoff as bk
+        bk.seed(1234)
         fi.inject("store.add", exc=ConnectionResetError("flake"),
                   times=4)
         t0 = time.monotonic()
         store.add("c", 1)
         dt = time.monotonic() - t0
-        assert 0.05 < dt < 2.0, dt
+        # deterministic schedule is 0.01+0.02+0.04+0.05 = 0.12s before
+        # syscall overhead; a jittered run undercuts it w.h.p. and the
+        # worst case never exceeds it
+        assert dt < 0.5, dt
+        # the draw sequence is reproducible after re-seeding
+        bk.seed(1234)
+        a = [bk.full_jitter(0.05) for _ in range(4)]
+        bk.seed(1234)
+        b = [bk.full_jitter(0.05) for _ in range(4)]
+        assert a == b and all(0.0 <= x <= 0.05 for x in a)
 
     def test_blocking_get_fails_bounded_on_shutdown(self, store):
         """A blocking get interrupted by server shutdown fails within
